@@ -1,0 +1,47 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI, so sharding tests use XLA's
+host-platform device-count override (SURVEY.md §4c). Must run before the
+first ``import jax`` anywhere in the test session. x64 is enabled so parity
+tests can compare float64-exact against sklearn.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+REFERENCE_ROOT = "/root/reference"
+
+
+@pytest.fixture(scope="session")
+def reference_models_dir():
+    path = os.path.join(REFERENCE_ROOT, "models")
+    if not os.path.isdir(path):
+        pytest.skip("reference checkpoints not available")
+    return path
+
+
+@pytest.fixture(scope="session")
+def reference_datasets_dir():
+    path = os.path.join(REFERENCE_ROOT, "datasets")
+    if not os.path.isdir(path):
+        pytest.skip("reference datasets not available")
+    return path
+
+
+@pytest.fixture(scope="session")
+def flow_dataset(reference_datasets_dir):
+    from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
+
+    return load_reference_datasets(reference_datasets_dir)

@@ -1,0 +1,71 @@
+"""Importer shape tests against SURVEY.md §2.2's verified inventory."""
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+
+
+@pytest.fixture(scope="module")
+def imported(reference_models_dir):
+    return {
+        name: ski.IMPORTERS[name](
+            f"{reference_models_dir}/{ski.REFERENCE_CHECKPOINTS[name]}"
+        )
+        for name in ski.IMPORTERS
+    }
+
+
+def test_logreg_shapes(imported):
+    d = imported["logreg"]
+    assert d["coef"].shape == (4, 12)
+    assert d["intercept"].shape == (4,)
+    # 4-class era checkpoint (SURVEY.md §2.2)
+    assert list(d["classes"]) == ["dns", "ping", "telnet", "voice"]
+
+
+def test_gnb_shapes(imported):
+    d = imported["gnb"]
+    assert d["theta"].shape == (6, 12)
+    assert d["var"].shape == (6, 12)
+    np.testing.assert_allclose(d["class_prior"].sum(), 1.0)
+    assert list(d["classes"]) == ["dns", "game", "ping", "quake", "telnet", "voice"]
+
+
+def test_kmeans_shapes(imported):
+    assert imported["kmeans"]["cluster_centers"].shape == (4, 12)
+
+
+def test_svc_shapes(imported):
+    d = imported["svc"]
+    assert d["support_vectors"].shape == (2281, 12)
+    assert d["dual_coef"].shape == (5, 2281)
+    assert d["intercept"].shape == (15,)
+    assert list(d["n_support"]) == [579, 516, 759, 115, 199, 113]
+    assert d["gamma"] == pytest.approx(5.5169e-09, rel=1e-3)
+
+
+def test_knn_shapes(imported):
+    d = imported["knn"]
+    assert d["fit_X"].shape == (4448, 12)
+    assert d["y"].shape == (4448,)
+    assert d["n_neighbors"] == 5
+
+
+def test_forest_shapes(imported):
+    d = imported["forest"]
+    assert d["left"].shape[0] == 100
+    assert d["values"].shape[2] == 6
+    assert d["max_depth"] == 14
+    # padded leaves are inert: left == -1 and zero values
+    pad = d["left"] == -1
+    assert pad.any()
+
+
+def test_forest_node_stats(imported):
+    """Node-count min/mean/max from SURVEY.md §2.2: 25/53.1/101."""
+    d = imported["forest"]
+    counts = (d["left"] != -1).sum(axis=1) * 2 + 1  # internal*2+1 == nodes
+    assert counts.min() == 25
+    assert counts.max() == 101
+    assert abs(counts.mean() - 53.1) < 0.5

@@ -1,0 +1,30 @@
+"""traffic_classifier_sdn_tpu — a TPU-native (JAX/XLA/Pallas/pjit) framework
+with the capabilities of ashwinn-v/Traffic-classifier-SDN.
+
+The reference system classifies live SDN network flows into six traffic
+classes (dns, game, ping, quake, telnet, voice) by polling Open vSwitch flow
+statistics through a Ryu OpenFlow-1.3 controller, engineering 12 per-flow
+rate/delta features, and calling pickled scikit-learn estimators one flow at a
+time (reference: traffic_classifier.py:98-170, simple_monitor_13.py:31-66).
+
+This framework inverts that shape for TPU hardware: flow state lives in a
+device-resident structure-of-arrays, the six classifiers are pure jit/vmap
+functions over explicit parameter pytrees, batches are sharded over a
+`jax.sharding.Mesh` with XLA collectives (psum/all_gather) doing the
+cross-chip merges, and the host side is a thin async ingest shell speaking
+the reference's `data\t` line protocol.
+
+Layout:
+  core/      flow state + feature engineering as arrays (+ golden Python port)
+  models/    six predictors as pure functions over param pytrees
+  io/        sklearn-pickle importer, dataset pipeline, checkpointing
+  parallel/  mesh, batch-sharded predict, state-sharded KNN/forest
+  train/     on-device (re)training for all six model families
+  ingest/    line-protocol parsing, replay + live collectors, batching
+  ops/       Pallas kernels and tensorized tree evaluation
+  utils/     table rendering, config, logging/metrics
+"""
+
+__version__ = "0.1.0"
+
+from .core.features import CLASSES_6 as TRAFFIC_CLASSES  # noqa: E402

@@ -1,0 +1,72 @@
+"""The six classifier families of the reference, as pure JAX functions.
+
+Registry keys mirror the reference's CLI subcommands
+(traffic_classifier.py:189: logistic, kmeans, knearest, svm, Randomforest,
+gaussiannb) under normalized names; ``load_reference_model`` is the TPU-era
+replacement for the pickle-loading if-chain at traffic_classifier.py:229-243
+(including fixing the knearest/kneighbors dispatch bug noted in SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from ..io import sklearn_import
+from . import forest, gnb, kmeans, knn, logreg, svc
+from .base import ClassList
+
+MODEL_MODULES = {
+    "logreg": logreg,
+    "gnb": gnb,
+    "kmeans": kmeans,
+    "knn": knn,
+    "svc": svc,
+    "forest": forest,
+}
+
+# reference CLI subcommand → normalized model name (traffic_classifier.py:189;
+# both 'knearest' and 'kneighbors' accepted — the reference advertises the
+# former but dispatches on the latter, a defect we fix rather than replicate).
+SUBCOMMAND_ALIASES = {
+    "logistic": "logreg",
+    "kmeans": "kmeans",
+    "knearest": "knn",
+    "kneighbors": "knn",
+    "svm": "svc",
+    "Randomforest": "forest",
+    "randomforest": "forest",
+    "gaussiannb": "gnb",
+}
+
+
+@dataclass(frozen=True)
+class LoadedModel:
+    name: str
+    params: Any
+    classes: ClassList
+    predict: Callable
+    scores: Callable
+
+
+def load_reference_model(
+    name: str, checkpoint_path: str, dtype=jnp.float32
+) -> LoadedModel:
+    """Import a reference sklearn pickle and return params + predict fns."""
+    name = SUBCOMMAND_ALIASES.get(name, name)
+    mod = MODEL_MODULES[name]
+    raw = sklearn_import.IMPORTERS[name](checkpoint_path)
+    params = mod.from_numpy(raw, dtype=dtype)
+    if name == "kmeans":
+        classes = ClassList(kmeans.CLUSTER_LABELS_CHECKPOINT)
+    else:
+        classes = ClassList.from_array(raw["classes"])
+    return LoadedModel(
+        name=name,
+        params=params,
+        classes=classes,
+        predict=mod.predict,
+        scores=mod.scores,
+    )

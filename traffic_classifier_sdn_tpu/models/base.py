@@ -1,0 +1,45 @@
+"""Common protocol for the six classifier families.
+
+Every model family is a module exposing:
+
+  ``Params``                 a NamedTuple pytree of device arrays
+  ``from_numpy(d, dtype)``   build Params from an importer dict (io/sklearn_import)
+  ``scores(params, X)``      (N, C)-ish per-class score matrix (model-specific
+                             semantics: logits, log-probs, votes, −distances)
+  ``predict(params, X)``     (N,) int32 indices into the model's class list
+
+``predict`` is a pure function of (params, X) with static shapes — safe to
+``jax.jit``, ``vmap`` and ``shard_map`` as-is. Class *labels* (strings) never
+enter device code; ``ClassList`` decodes indices on the host.
+
+This replaces the reference's per-flow ``model.predict(List[List[float]])``
+call (reference: traffic_classifier.py:104-106) with batched device-resident
+math.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ClassList:
+    """Host-side label decode. The reference remaps int cluster ids through a
+    hardcoded 6-entry dict (traffic_classifier.py:109-114); here every model
+    carries its own checkpoint-era class list (4-class vs 6-class pickles are
+    mutually inconsistent in the reference — SURVEY.md §2.2)."""
+
+    names: tuple
+
+    @classmethod
+    def from_array(cls, arr) -> "ClassList":
+        return cls(tuple(str(x) for x in np.asarray(arr).tolist()))
+
+    def decode(self, indices) -> list:
+        idx = np.asarray(indices).ravel()
+        return [self.names[i] for i in idx]
+
+    def __len__(self) -> int:
+        return len(self.names)

@@ -1,0 +1,57 @@
+"""Random-forest predict via tensorized lockstep tree traversal.
+
+Replaces sklearn's ``RandomForestClassifier.predict`` (reference checkpoint
+``models/RandomForestClassifier``: 100 gini trees, node counts 25-101, depth
+5-14, fitted in ``3_RandomForest.ipynb``; loaded at
+traffic_classifier.py:241-243 — the reference's most accurate model at
+99.87%, SURVEY.md §6). Prediction is argmax of the mean per-tree class
+distribution, computed by ops/tree_eval.py's gather-based traversal.
+
+Trees shard across chips for big ensembles — parallel/forest_sharded.py
+psums the per-chip distribution sums.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops import tree_eval
+
+
+class Params(struct.PyTreeNode):
+    left: jax.Array  # (T, M) int32
+    right: jax.Array  # (T, M) int32
+    feature: jax.Array  # (T, M) int32
+    threshold: jax.Array  # (T, M)
+    values: jax.Array  # (T, M, C) leaf class counts
+    max_depth: int = struct.field(pytree_node=False)  # static under jit
+
+
+def from_numpy(d: dict, dtype=jnp.float32) -> Params:
+    return Params(
+        left=jnp.asarray(d["left"]),
+        right=jnp.asarray(d["right"]),
+        feature=jnp.asarray(d["feature"]),
+        threshold=jnp.asarray(d["threshold"], dtype=dtype),
+        values=jnp.asarray(d["values"], dtype=dtype),
+        max_depth=int(d["max_depth"]),
+    )
+
+
+def scores(params: Params, X: jax.Array) -> jax.Array:
+    """Ensemble-averaged class probabilities, (N, C)."""
+    return tree_eval.forest_proba(
+        params.left,
+        params.right,
+        params.feature,
+        params.threshold,
+        params.values,
+        X,
+        params.max_depth,
+    )
+
+
+def predict(params: Params, X: jax.Array) -> jax.Array:
+    return jnp.argmax(scores(params, X), axis=-1).astype(jnp.int32)

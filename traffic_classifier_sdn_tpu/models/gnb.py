@@ -1,0 +1,50 @@
+"""Gaussian naive Bayes predict as closed-form batched log-probability.
+
+Replaces sklearn's ``GaussianNB.predict`` (reference checkpoint
+``models/GaussianNB``, fitted in notebook ``5_GaussianNB.ipynb``; loaded at
+traffic_classifier.py:238-239). Joint log likelihood per class c:
+
+    log P(c) − ½ Σ_f [ log(2π σ²_cf) + (x_f − θ_cf)² / σ²_cf ]
+
+(SURVEY.md §2.2). The per-class constant ½Σ log(2πσ²) and the reciprocal
+variances are folded at import time, so predict is two broadcast multiplies
+and a reduction — fully fused by XLA.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Params(NamedTuple):
+    theta: jax.Array  # (C, F) per-class feature means
+    inv_var: jax.Array  # (C, F) 1/σ²
+    log_const: jax.Array  # (C,)  log prior − ½ Σ log(2π σ²)
+
+
+def from_numpy(d: dict, dtype=jnp.float32) -> Params:
+    theta = np.asarray(d["theta"], dtype=np.float64)
+    var = np.asarray(d["var"], dtype=np.float64)
+    prior = np.asarray(d["class_prior"], dtype=np.float64)
+    log_const = np.log(prior) - 0.5 * np.sum(np.log(2.0 * math.pi * var), axis=1)
+    return Params(
+        theta=jnp.asarray(theta, dtype=dtype),
+        inv_var=jnp.asarray(1.0 / var, dtype=dtype),
+        log_const=jnp.asarray(log_const, dtype=dtype),
+    )
+
+
+def scores(params: Params, X: jax.Array) -> jax.Array:
+    """Joint log likelihood, (N, C)."""
+    diff = X[:, None, :] - params.theta[None, :, :]  # (N, C, F)
+    quad = jnp.sum(diff * diff * params.inv_var[None, :, :], axis=-1)
+    return params.log_const[None, :] - 0.5 * quad
+
+
+def predict(params: Params, X: jax.Array) -> jax.Array:
+    return jnp.argmax(scores(params, X), axis=-1).astype(jnp.int32)

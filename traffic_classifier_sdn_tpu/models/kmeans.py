@@ -1,0 +1,54 @@
+"""KMeans nearest-centroid assignment as a batched matmul + argmin.
+
+Replaces sklearn's ``KMeans.predict`` (reference checkpoint
+``models/KMeans_Clustering`` — 4 clusters, from the 4-class data era; loaded
+at traffic_classifier.py:231-232). Assignment is argmin of squared L2 to the
+centers; ``‖x‖²`` is constant across centers so the argmin only needs
+``−2 x·μᵀ + ‖μ‖²`` — one MXU matmul plus a broadcast add (SURVEY.md §2.2).
+
+Cluster→label remapping is a host-side concern: the reference's online remap
+(traffic_classifier.py:109-114) assumes 6 clusters and disagrees with the
+notebook-derived 4-cluster map (0=dns, 1=ping, 2=telnet, 3=voice from
+``1_log_Kmeans.ipynb`` cell 116) — a known reference defect we do not
+replicate (SURVEY.md §2, defects list). Both maps are provided.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.features import CLASSES_6
+
+
+class Params(NamedTuple):
+    centers: jax.Array  # (K, F)
+
+
+# Checkpoint-era (correct) map, derived in 1_log_Kmeans.ipynb cell 116 by
+# matching cluster modes on the 4-class training data.
+CLUSTER_LABELS_CHECKPOINT = ("dns", "ping", "telnet", "voice")
+# The reference's (buggy) online remap at traffic_classifier.py:109-114,
+# kept only for behavioral documentation.
+CLUSTER_LABELS_REFERENCE_ONLINE = CLASSES_6
+
+
+def from_numpy(d: dict, dtype=jnp.float32) -> Params:
+    return Params(centers=jnp.asarray(d["cluster_centers"], dtype=dtype))
+
+
+def scores(params: Params, X: jax.Array) -> jax.Array:
+    """Negated squared distance, (N, K): higher = closer.
+
+    Difference form, not the dot-product expansion: features reach ~8e8 so
+    ‖x‖²-scale terms catastrophically cancel in float32 (measured for the
+    RBF kernel — see models/svc.py numerical notes). K is 4, so the (N, K, F)
+    intermediate is trivially small and XLA fuses the whole thing."""
+    diff = X[:, None, :] - params.centers[None, :, :]
+    return -jnp.sum(diff * diff, axis=-1)
+
+
+def predict(params: Params, X: jax.Array) -> jax.Array:
+    return jnp.argmax(scores(params, X), axis=-1).astype(jnp.int32)

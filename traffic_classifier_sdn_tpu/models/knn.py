@@ -1,0 +1,85 @@
+"""k-nearest-neighbors predict as brute-force batched L2 + top-k.
+
+Replaces sklearn's ``KNeighborsClassifier.predict`` (reference checkpoint
+``models/KNeighbors``: k=5, Euclidean, KDTree; loaded at
+traffic_classifier.py:234-236). TPUs have no KDTree; the idiomatic
+replacement is a dense (N, S) distance computation — one MXU matmul —
+followed by ``lax.top_k`` and a one-hot vote reduction (SURVEY.md §2.3).
+Majority vote ties resolve to the lowest class index, matching numpy/scipy
+mode semantics used by sklearn.
+
+Numerical design (measured — see models/svc.py notes): features reach ~8e8,
+so the dot-product expansion ``x·s − ½‖s‖²`` can cancel catastrophically in
+float32 when two neighbors of different classes are nearly equidistant. The
+fast path keeps the matmul form (with precision='highest'); passing ``X_lo``
+(from ``svc.split_hilo``) switches to the exact two-float difference form
+for parity-critical use.
+
+The training matrix shards across chips for large corpora — see
+parallel/knn_sharded.py for the all_gather-merged global top-k.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+
+class Params(struct.PyTreeNode):
+    fit_X: jax.Array  # (S, F) training matrix, hi part in f32 mode
+    fit_X_lo: jax.Array  # (S, F) two-float residual (zeros in f64 mode)
+    fit_y: jax.Array  # (S,) int32 class indices
+    half_sq_norms: jax.Array  # (S,) ½‖x_s‖²
+    n_neighbors: int = struct.field(pytree_node=False)  # static under jit
+    n_classes: int = struct.field(pytree_node=False)  # static under jit
+
+
+def from_numpy(d: dict, dtype=jnp.float32) -> Params:
+    from .svc import split_hilo  # shared two-float helper
+
+    fit_hi, fit_lo = split_hilo(d["fit_X"], dtype=dtype)
+    return Params(
+        fit_X=fit_hi,
+        fit_X_lo=fit_lo,
+        fit_y=jnp.asarray(d["y"], dtype=jnp.int32),
+        half_sq_norms=0.5 * jnp.sum(fit_hi * fit_hi, axis=1),
+        n_neighbors=int(d["n_neighbors"]),
+        n_classes=int(len(d["classes"])),
+    )
+
+
+def _neighbor_sim(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
+    """(N, S) similarity whose argmax order is ascending-distance order."""
+    if X_lo is None:
+        # argmin_s ‖x−s‖² == argmax_s (x·s − ½‖s‖²); ‖x‖² is row-constant.
+        # precision='highest': default matmul precision on this XLA build is
+        # bf16-like (see models/svc.py numerical notes).
+        return (
+            jnp.matmul(X, params.fit_X.T, precision=lax.Precision.HIGHEST)
+            - params.half_sq_norms[None, :]
+        )
+    # Exact two-float difference form.
+    diff = (X[:, None, :] - params.fit_X[None, :, :]) + (
+        X_lo[:, None, :] - params.fit_X_lo[None, :, :]
+    )
+    return -jnp.sum(diff * diff, axis=-1)
+
+
+def neighbor_votes(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
+    """(N, C) neighbor counts per class from the k nearest training points."""
+    sim = _neighbor_sim(params, X, X_lo)
+    _, nbr_idx = lax.top_k(sim, params.n_neighbors)  # (N, k)
+    nbr_y = params.fit_y[nbr_idx]  # (N, k)
+    return jnp.sum(
+        jax.nn.one_hot(nbr_y, params.n_classes, dtype=jnp.int32), axis=1
+    )
+
+
+def scores(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
+    return neighbor_votes(params, X, X_lo)
+
+
+def predict(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
+    return jnp.argmax(scores(params, X, X_lo), axis=-1).astype(jnp.int32)

@@ -1,0 +1,45 @@
+"""Multinomial logistic-regression predict as one batched matmul.
+
+Replaces sklearn's ``LogisticRegression.predict`` (reference checkpoint
+``models/LogisticRegression``, fitted in notebook ``1_log_Kmeans.ipynb``;
+loaded at traffic_classifier.py:230). sklearn's predict is argmax of the
+decision function ``X @ coef.T + intercept`` — softmax is monotonic so the
+argmax needs no normalization (SURVEY.md §2.2).
+
+The reference calls this once per flow on a (1, 12) matrix inside a Python
+loop; here it is a single (N, 12) @ (12, C) matmul on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Params(NamedTuple):
+    coef: jax.Array  # (C, F)
+    intercept: jax.Array  # (C,)
+
+
+def from_numpy(d: dict, dtype=jnp.float32) -> Params:
+    return Params(
+        coef=jnp.asarray(d["coef"], dtype=dtype),
+        intercept=jnp.asarray(d["intercept"], dtype=dtype),
+    )
+
+
+def scores(params: Params, X: jax.Array) -> jax.Array:
+    """Decision function, (N, C).
+
+    precision='highest' because this XLA build's DEFAULT matmul precision is
+    bf16-like even on CPU (see models/svc.py numerical notes)."""
+    return (
+        jnp.matmul(X, params.coef.T, precision=jax.lax.Precision.HIGHEST)
+        + params.intercept
+    )
+
+
+def predict(params: Params, X: jax.Array) -> jax.Array:
+    return jnp.argmax(scores(params, X), axis=-1).astype(jnp.int32)

@@ -1,0 +1,134 @@
+"""RBF-kernel SVC predict as one kernel computation + one vote matmul.
+
+Replaces libsvm's ``SVC.predict`` (reference checkpoint ``models/SVC``:
+RBF, C=1, gamma=scale→5.5169e-9, 2281 support vectors, 6 classes, 15
+one-vs-one pairs, fitted in ``2_SVM.ipynb``; loaded at
+traffic_classifier.py:233-234; SURVEY.md §2.2).
+
+libsvm walks support vectors per class-pair in C++; here the ragged
+per-pair/per-class coefficient structure is flattened at import time into a
+dense (P, S) matrix, so the whole ovo decision is
+
+    K = exp(−γ · ‖x − sv‖²)            (N, S)
+    D = K @ pair_coef.T + intercept     (N, P)
+    votes: D[p] > 0 → class i(p), else class j(p); argmax of vote counts
+
+with libsvm's tie-break (lowest class index among vote-count maxima).
+
+Numerical design (SURVEY.md §7 hard part b — measured, not guessed):
+- Feature values reach ~8e8, so ‖x−sv‖² spans [0, ~1e16]. The dot-product
+  expansion of d² catastrophically cancels in float32, and even casting the
+  *query* to float32 perturbs d² enough to flip ovo votes (decision margins
+  on this checkpoint go down to ~0.04). Remedy: a two-float (hi/lo) split of
+  both support vectors and queries; the difference form
+  ``(x_hi−s_hi)+(x_lo−s_lo)`` is then exact-to-f32-rounding, giving
+  argmax parity with float64 at float32 speed.
+- On this XLA build, DEFAULT matmul precision is bf16-like (max error ~0.2 on
+  the vote matmul — larger than the decision margins), so every matmul here
+  pins ``precision='highest'``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+class Params(struct.PyTreeNode):
+    sv_hi: jax.Array  # (S, F) support vectors, hi part
+    sv_lo: jax.Array  # (S, F) residual (sv − f32(sv)); zeros in f64 mode
+    pair_coef: jax.Array  # (P, S) dense ovo dual coefficients
+    intercept: jax.Array  # (P,)
+    vote_i: jax.Array  # (P,) int32 class voted when D > 0
+    vote_j: jax.Array  # (P,) int32 class voted otherwise
+    gamma: jax.Array  # () scalar
+    n_classes: int = struct.field(pytree_node=False)  # static under jit
+
+
+def _pairs(n_classes: int):
+    return [(i, j) for i in range(n_classes) for j in range(i + 1, n_classes)]
+
+
+def split_hilo(X, dtype=jnp.float32):
+    """Two-float split of a float64 array: X ≈ hi + lo with hi = f32(X).
+
+    Host-side helper for parity-exact float32 queries; in float64 mode lo
+    is identically zero.
+    """
+    X = np.asarray(X, dtype=np.float64)
+    if dtype == jnp.float64:
+        return jnp.asarray(X), jnp.zeros_like(jnp.asarray(X))
+    hi = X.astype(np.float32)
+    lo = (X - hi).astype(np.float32)
+    return jnp.asarray(hi, dtype=dtype), jnp.asarray(lo, dtype=dtype)
+
+
+def from_numpy(d: dict, dtype=jnp.float32) -> Params:
+    sv = np.asarray(d["support_vectors"], dtype=np.float64)
+    dual = np.asarray(d["dual_coef"], dtype=np.float64)  # (C-1, S)
+    n_support = np.asarray(d["n_support"], dtype=np.int64)
+    n_classes = len(n_support)
+    starts = np.concatenate([[0], np.cumsum(n_support)])
+    pairs = _pairs(n_classes)
+
+    # Dense (P, S) ovo coefficients: for pair (i, j), class-i SVs contribute
+    # dual[j-1] and class-j SVs contribute dual[i] (libsvm sv_coef layout).
+    pair_coef = np.zeros((len(pairs), sv.shape[0]), dtype=np.float64)
+    for p, (i, j) in enumerate(pairs):
+        si, ei = starts[i], starts[i + 1]
+        sj, ej = starts[j], starts[j + 1]
+        pair_coef[p, si:ei] = dual[j - 1, si:ei]
+        pair_coef[p, sj:ej] = dual[i, sj:ej]
+
+    sv_hi, sv_lo = split_hilo(sv, dtype=dtype)
+    return Params(
+        sv_hi=sv_hi,
+        sv_lo=sv_lo,
+        pair_coef=jnp.asarray(pair_coef, dtype=dtype),
+        intercept=jnp.asarray(d["intercept"], dtype=dtype),
+        vote_i=jnp.asarray([i for i, _ in pairs], dtype=jnp.int32),
+        vote_j=jnp.asarray([j for _, j in pairs], dtype=jnp.int32),
+        gamma=jnp.asarray(d["gamma"], dtype=dtype),
+        n_classes=n_classes,
+    )
+
+
+def rbf_kernel(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
+    """exp(−γ‖x−sv‖²), (N, S), difference form with optional lo correction.
+
+    Pass ``X_lo`` (from ``split_hilo``) for float64-equivalent accuracy when
+    the raw features exceed float32's 24-bit integer range.
+    """
+    diff = X[:, None, :] - params.sv_hi[None, :, :]
+    if X_lo is not None:
+        diff = diff + (X_lo[:, None, :] - params.sv_lo[None, :, :])
+    else:
+        diff = diff - params.sv_lo[None, :, :]
+    d2 = jnp.sum(diff * diff, axis=-1)
+    return jnp.exp(-params.gamma * d2)
+
+
+def decision_ovo(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
+    """Per-pair ovo decision values, (N, P)."""
+    K = rbf_kernel(params, X, X_lo)
+    return (
+        jnp.matmul(K, params.pair_coef.T, precision=_HI)
+        + params.intercept[None, :]
+    )
+
+
+def scores(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
+    """Vote counts per class, (N, C)."""
+    D = decision_ovo(params, X, X_lo)
+    pos = D > 0
+    votes_i = jax.nn.one_hot(params.vote_i, params.n_classes, dtype=D.dtype)
+    votes_j = jax.nn.one_hot(params.vote_j, params.n_classes, dtype=D.dtype)
+    return jnp.where(pos[:, :, None], votes_i, votes_j).sum(axis=1)
+
+
+def predict(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
+    return jnp.argmax(scores(params, X, X_lo), axis=-1).astype(jnp.int32)

