@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Metric (BASELINE.json): flows classified per second per chip on the flagship
+6-class model (the tensorized random forest, the reference's most accurate
+classifier at 99.87%), plus p50 per-batch predict latency.
+
+Baseline: the reference's compute path is sklearn's Cython
+``RandomForestClassifier.predict`` on CPU — measured here on the same host
+for an honest vs_baseline ratio (the reference itself publishes no
+throughput numbers; it actually calls predict per flow on a (1,12) matrix,
+traffic_classifier.py:104-106, which is far slower still — we baseline
+against sklearn's *batched* predict, the strongest CPU configuration).
+
+Timing methodology (this rig's remote-TPU tunnel makes naive timing lie —
+``block_until_ready`` returns without waiting and transfers run ~12 MB/s):
+K dependent predict iterations run inside one jitted ``fori_loop`` with a
+loop-carried perturbation (defeats loop-invariant hoisting) and a scalar
+reduction output; the scalar is fetched with ``np.asarray`` (a real sync),
+an empty-kernel round trip is measured separately and subtracted, and the
+remainder is divided by K. Medians over repeats.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 1 << 20  # ~1M concurrent flows (the BASELINE.json north star)
+LOOP_ITERS = 16
+REPEATS = 5
+
+
+def _sync_scalar(x) -> float:
+    return float(np.asarray(x))
+
+
+def _roundtrip_seconds() -> float:
+    """Median cost of dispatch + scalar fetch for a trivial kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a: jnp.sum(a) * 0.0)
+    a = jnp.ones((8,), jnp.float32)
+    _sync_scalar(f(a))
+    times = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        _sync_scalar(f(a))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def _device_seconds_per_call(make_loop, *args) -> float:
+    """Time K dependent on-device iterations, subtract round trip, ÷ K."""
+    loop = make_loop(LOOP_ITERS)
+    _sync_scalar(loop(*args))  # compile + warm
+    rtt = _roundtrip_seconds()
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        _sync_scalar(loop(*args))
+        times.append(time.perf_counter() - t0)
+    total = float(np.median(times))
+    return max(total - rtt, 1e-12) / LOOP_ITERS
+
+
+def bench_tpu_forest(X_np: np.ndarray) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+    from traffic_classifier_sdn_tpu.models import forest
+
+    params = forest.from_numpy(
+        ski.import_forest("/root/reference/models/RandomForestClassifier")
+    )
+    X = jnp.asarray(X_np, jnp.float32)
+
+    def make_loop(k):
+        @jax.jit
+        def loop(params, X):
+            def body(i, acc):
+                # loop-carried input perturbation: forces a fresh predict
+                # each iteration (no loop-invariant hoisting)
+                Xi = X.at[0, 0].set(acc * 1e-9 + jnp.float32(i))
+                pred = forest.predict(params, Xi)
+                return acc + jnp.sum(pred).astype(jnp.float32)
+
+            return lax.fori_loop(0, k, body, jnp.float32(0.0))
+
+        return loop
+
+    sec = _device_seconds_per_call(make_loop, params, X)
+
+    # e2e single-batch p50: one predict + scalar fetch (includes the host
+    # round trip a real serving loop would pay once per batch)
+    @jax.jit
+    def one(params, X):
+        return jnp.sum(forest.predict(params, X))
+
+    _sync_scalar(one(params, X))
+    times = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        _sync_scalar(one(params, X))
+        times.append(time.perf_counter() - t0)
+    e2e_p50 = float(np.median(times))
+
+    return {
+        "device_seconds_per_batch": sec,
+        "flows_per_sec": X_np.shape[0] / sec,
+        "e2e_p50_seconds": e2e_p50,
+    }
+
+
+def bench_sklearn_forest(X_np: np.ndarray, sample: int = 65536) -> float:
+    """Reference-path baseline: sklearn RF batched predict, flows/sec.
+    Refit on the reference data (the 1.0.1 pickle no longer unpickles);
+    same 100-tree configuration as the checkpoint."""
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    from sklearn.ensemble import RandomForestClassifier
+
+    from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
+
+    ds = load_reference_datasets("/root/reference/datasets")
+    clf = RandomForestClassifier(n_estimators=100, random_state=0)
+    clf.fit(ds.X, ds.y)
+    Xs = X_np[:sample]
+    t0 = time.perf_counter()
+    clf.predict(Xs)
+    t1 = time.perf_counter()
+    clf.predict(Xs)
+    t2 = time.perf_counter()
+    return sample / min(t1 - t0, t2 - t1)
+
+
+def main() -> None:
+    rng = np.random.RandomState(0)
+    # Feature-realistic magnitudes (deltas, pps/bps rates up to ~1e6).
+    X_np = np.abs(rng.gamma(1.5, 200.0, (BATCH, 12))).astype(np.float32)
+
+    tpu = bench_tpu_forest(X_np)
+    baseline_fps = bench_sklearn_forest(X_np)
+
+    print(
+        json.dumps(
+            {
+                "metric": "flows_classified_per_sec_per_chip",
+                "value": round(tpu["flows_per_sec"], 1),
+                "unit": "flows/s",
+                "vs_baseline": round(tpu["flows_per_sec"] / baseline_fps, 2),
+                "device_batch_ms": round(
+                    tpu["device_seconds_per_batch"] * 1e3, 3
+                ),
+                "e2e_p50_batch_ms": round(tpu["e2e_p50_seconds"] * 1e3, 3),
+                "batch_size": BATCH,
+                "model": "random_forest_100x6class",
+                "baseline": "sklearn RandomForestClassifier.predict (batched, same host CPU)",
+                "baseline_flows_per_sec": round(baseline_fps, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

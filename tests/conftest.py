@@ -17,6 +17,10 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax
 
+# A sitecustomize on this machine force-prepends the axon TPU platform to
+# jax_platforms regardless of JAX_PLATFORMS; override it after import (the
+# backend is not yet initialized at conftest time).
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402

@@ -1,0 +1,261 @@
+"""Property tests: the device flow table vs the golden Python Flow port.
+
+SURVEY.md §4d — the Flow delta/rate math is checked against the closed-form
+definitions at traffic_classifier.py:63-96, here via the GoldenFlow oracle
+driven by identical record sequences.
+"""
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.core.flow import GoldenFlow
+from traffic_classifier_sdn_tpu.core import flow_table as ft
+from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+from traffic_classifier_sdn_tpu.ingest.protocol import (
+    TelemetryRecord,
+    format_line,
+    parse_line,
+    stable_flow_key,
+)
+from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows, iter_capture
+
+
+def _rec(t, src, dst, pkts, byts, dp="1"):
+    return TelemetryRecord(
+        time=t, datapath=dp, in_port="1", eth_src=src, eth_dst=dst,
+        out_port="2", packets=pkts, bytes=byts,
+    )
+
+
+def test_protocol_roundtrip():
+    r = _rec(7, "aa:bb", "cc:dd", 123, 45678)
+    assert parse_line(format_line(r)) == r
+    assert parse_line(b"unrelated log line\n") is None
+    assert parse_line(b"data\tmalformed\n") is None
+
+
+def test_stable_key_direction_and_separators():
+    assert stable_flow_key("1", "a", "b") != stable_flow_key("1", "b", "a")
+    # the reference's bare concat would collide these (SURVEY.md §2 defect)
+    assert stable_flow_key("1", "ab", "c") != stable_flow_key("1", "a", "bc")
+    # stable across calls (unlike Python hash())
+    assert stable_flow_key("1", "a", "b") == stable_flow_key("1", "a", "b")
+
+
+def _golden_run(ticks):
+    """Drive GoldenFlows with the reference's exact routing logic."""
+    flows = {}
+    for tick in ticks:
+        for r in tick:
+            key = stable_flow_key(r.datapath, r.eth_src, r.eth_dst)
+            rev = stable_flow_key(r.datapath, r.eth_dst, r.eth_src)
+            if key in flows:
+                flows[key].update_forward(r.packets, r.bytes, r.time)
+            elif rev in flows:
+                flows[rev].update_reverse(r.packets, r.bytes, r.time)
+            else:
+                flows[key] = GoldenFlow.create(
+                    r.time, r.datapath, r.eth_src, r.eth_dst, r.packets, r.bytes
+                )
+    return flows
+
+
+def _engine_run(ticks, capacity=128):
+    eng = FlowStateEngine(capacity)
+    for tick in ticks:
+        eng.ingest(tick)
+        eng.step()
+    return eng
+
+
+def _compare(eng, flows):
+    X = np.asarray(eng.features())
+    # map golden flows to slots via the engine's index
+    for key, gf in flows.items():
+        slot = eng.index.key_to_slot[key]
+        want = np.asarray(gf.features12(), dtype=np.float64)
+        got = X[slot].astype(np.float64)
+        # deltas exact; rates to f32 rounding
+        np.testing.assert_array_equal(got[[0, 1, 6, 7]], want[[0, 1, 6, 7]])
+        np.testing.assert_allclose(got, want.astype(np.float32), rtol=2e-7, atol=0)
+        # status bits
+        assert bool(np.asarray(eng.table.fwd.active)[slot]) == gf.forward.active
+        assert bool(np.asarray(eng.table.rev.active)[slot]) == gf.reverse.active
+
+
+def test_single_flow_lifecycle():
+    ticks = [
+        [_rec(1, "a", "b", 10, 1000)],          # create
+        [_rec(2, "a", "b", 25, 2500)],          # forward update
+        [_rec(2, "b", "a", 5, 500)],            # reverse update
+        [_rec(3, "a", "b", 25, 2500)],          # idle forward → INACTIVE
+        [_rec(4, "b", "a", 9, 900)],            # reverse active again
+    ]
+    eng = _engine_run(ticks)
+    flows = _golden_run(ticks)
+    assert len(flows) == 1
+    _compare(eng, flows)
+
+
+def test_zero_time_gap_guard():
+    """Two updates at the same timestamp: inst rates must keep old values
+    (reference :67 guard), not divide by zero."""
+    ticks = [
+        [_rec(1, "a", "b", 10, 1000)],
+        [_rec(2, "a", "b", 20, 2000)],
+        [_rec(2, "a", "b", 30, 3000)],  # same second again
+    ]
+    eng = _engine_run(ticks)
+    flows = _golden_run(ticks)
+    _compare(eng, flows)
+    X = np.asarray(eng.features())
+    assert np.isfinite(X).all()
+
+
+def test_update_at_start_time():
+    """curr_time == time_start: avg rates must keep old values
+    (reference :66 guard)."""
+    ticks = [
+        [_rec(5, "a", "b", 10, 1000)],
+        [_rec(5, "a", "b", 30, 3000)],
+    ]
+    eng = _engine_run(ticks)
+    flows = _golden_run(ticks)
+    _compare(eng, flows)
+
+
+def test_counter_wrap_32bit():
+    """Cumulative counters past 2^32: deltas stay exact via mod-2^32
+    wraparound (the golden oracle uses Python ints)."""
+    base = 2**32 - 500
+    ticks = [
+        [_rec(1, "a", "b", 100, base)],
+        [_rec(2, "a", "b", 200, base + 1500)],  # crosses the wrap
+    ]
+    eng = _engine_run(ticks)
+    flows = _golden_run(ticks)
+    key = stable_flow_key("1", "a", "b")
+    gf = flows[key]
+    assert gf.forward.delta_bytes == 1500
+    slot = eng.index.key_to_slot[key]
+    assert int(np.asarray(eng.table.fwd.delta_bytes)[slot]) == 1500
+
+
+def test_randomized_against_golden():
+    """Fuzz: many flows, random per-tick subsets, both directions, stalls."""
+    rng = np.random.RandomState(42)
+    n_flows, n_ticks = 40, 25
+    cums = np.zeros((n_flows, 2, 2), dtype=np.int64)  # (flow, dir, pkts/bytes)
+    ticks = []
+    for t in range(1, n_ticks + 1):
+        tick = []
+        for i in range(n_flows):
+            for d in range(2):
+                if rng.rand() < 0.6:
+                    dp = rng.randint(0, 50)
+                    db = dp * rng.randint(60, 1500)
+                    cums[i, d, 0] += dp
+                    cums[i, d, 1] += db
+                    src, dst = f"h{i}a", f"h{i}b"
+                    if d == 1:
+                        src, dst = dst, src
+                    tick.append(_rec(t, src, dst, int(cums[i, d, 0]), int(cums[i, d, 1])))
+        if tick:
+            ticks.append(tick)
+    eng = _engine_run(ticks)
+    flows = _golden_run(ticks)
+    _compare(eng, flows)
+
+
+def test_create_and_reverse_same_tick():
+    """Both directions of a brand-new flow arrive in one poll tick (the
+    monitor's normal behavior): the reverse update must survive the
+    create's reverse-side zeroing (regression: create applied after
+    updates clobbered it)."""
+    ticks = [
+        [_rec(1, "a", "b", 10, 1000), _rec(1, "b", "a", 7, 700)],
+        [_rec(2, "a", "b", 15, 1500), _rec(2, "b", "a", 9, 900)],
+    ]
+    eng = _engine_run(ticks)
+    flows = _golden_run(ticks)
+    gf = flows[stable_flow_key("1", "a", "b")]
+    assert gf.reverse.delta_packets == 2  # 9-7, not 9-0
+    _compare(eng, flows)
+
+
+def test_create_then_update_same_tick_same_direction():
+    """Two same-direction records for one flow in one tick (e.g. two
+    switch entries for the same host pair): reference semantics are
+    create(10) then update(25) → delta 15 (regression: dedup collapsed
+    them into a create with delta 0)."""
+    ticks = [
+        [_rec(1, "a", "b", 10, 1000), _rec(1, "a", "b", 25, 2500)],
+        [_rec(2, "a", "b", 30, 3000)],
+    ]
+    eng = _engine_run(ticks)
+    flows = _golden_run(ticks)
+    gf = flows[stable_flow_key("1", "a", "b")]
+    assert gf.forward.delta_packets == 5  # after tick 2
+    _compare(eng, flows)
+
+
+def test_three_updates_same_tick_splits_batch():
+    """A third same-direction record forces a mid-tick flush; deltas must
+    match the reference's fully sequential processing."""
+    ticks = [
+        [_rec(1, "a", "b", 10, 1000)],
+        [
+            _rec(2, "a", "b", 20, 2000),
+            _rec(2, "a", "b", 30, 3000),
+            _rec(2, "a", "b", 45, 4500),
+        ],
+    ]
+    eng = _engine_run(ticks)
+    flows = _golden_run(ticks)
+    gf = flows[stable_flow_key("1", "a", "b")]
+    assert gf.forward.delta_packets == 15  # 45-30, sequential
+    _compare(eng, flows)
+
+
+def test_capacity_overflow_drops():
+    eng = FlowStateEngine(capacity=2)
+    eng.ingest([
+        _rec(1, "a", "b", 1, 10),
+        _rec(1, "c", "d", 1, 10),
+        _rec(1, "e", "f", 1, 10),  # table full → dropped
+    ])
+    eng.step()
+    assert eng.batcher.dropped == 1
+    assert np.asarray(eng.table.in_use)[:2].all()
+
+
+def test_bucketed_padding_no_recompile():
+    """Batch sizes within one bucket reuse the same executable."""
+    import jax
+
+    eng = FlowStateEngine(capacity=512)
+    # two different batch sizes below the smallest bucket
+    eng.ingest([_rec(1, f"s{i}", f"d{i}", 1, 100) for i in range(10)])
+    eng.step()
+    eng.ingest([_rec(2, f"s{i}", f"d{i}", 2, 200) for i in range(200)])
+    with jax.checking_leaks():
+        eng.step()
+    X = np.asarray(eng.features())
+    assert X.shape == (512, 12)
+
+
+def test_synthetic_replay_roundtrip(tmp_path):
+    """Synthetic source → capture file → replay → identical feature state."""
+    syn = SyntheticFlows(n_flows=8, seed=3)
+    ticks = [syn.tick() for _ in range(4)]
+    path = tmp_path / "capture.tsv"
+    with open(path, "wb") as f:
+        f.write(b"header line to be ignored\n")
+        for tick in ticks:
+            for r in tick:
+                f.write(format_line(r))
+    replayed = list(iter_capture(str(path)))
+    assert sum(map(len, replayed)) == sum(map(len, ticks))
+    e1 = _engine_run(ticks, capacity=32)
+    e2 = _engine_run(replayed, capacity=32)
+    np.testing.assert_array_equal(np.asarray(e1.features()), np.asarray(e2.features()))

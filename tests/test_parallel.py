@@ -1,0 +1,81 @@
+"""Sharding tests on the virtual 8-device CPU mesh (SURVEY.md §4c):
+sharded results must equal the single-device reference exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+from traffic_classifier_sdn_tpu.models import forest, gnb, knn, logreg
+from traffic_classifier_sdn_tpu.parallel import (
+    forest_sharded,
+    knn_sharded,
+    mesh as meshlib,
+    predict as par_predict,
+)
+
+
+@pytest.fixture(scope="module")
+def X256(flow_dataset):
+    rng = np.random.RandomState(0)
+    idx = rng.choice(flow_dataset.n, size=256, replace=False)
+    return jnp.asarray(flow_dataset.X[idx], jnp.float32)
+
+
+def test_device_count():
+    assert len(jax.devices()) == 8, "conftest must provision 8 CPU devices"
+
+
+def test_mesh_shapes():
+    m = meshlib.make_mesh()
+    assert m.devices.shape == (8, 1)
+    m2 = meshlib.make_mesh(n_data=4, n_state=2)
+    assert m2.devices.shape == (4, 2)
+    with pytest.raises(ValueError):
+        meshlib.make_mesh(n_data=3, n_state=2)
+
+
+@pytest.mark.parametrize("model_name,mod", [("logreg", logreg), ("gnb", gnb)])
+def test_data_parallel_predict_matches(
+    reference_models_dir, X256, model_name, mod
+):
+    d = ski.IMPORTERS[model_name](
+        f"{reference_models_dir}/{ski.REFERENCE_CHECKPOINTS[model_name]}"
+    )
+    params = mod.from_numpy(d, dtype=jnp.float32)
+    want = np.asarray(mod.predict(params, X256))
+    m = meshlib.make_mesh()  # 8-way data parallel
+    dp = par_predict.data_parallel(m, mod.predict)
+    got = np.asarray(dp(params, X256))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_knn_state_sharded_matches(reference_models_dir, X256):
+    d = ski.import_knn(f"{reference_models_dir}/KNeighbors")
+    single = knn.from_numpy(d, dtype=jnp.float32)
+    want = np.asarray(knn.predict(single, X256))
+
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    dpad = knn_sharded.pad_corpus(d, 8)
+    params = knn.from_numpy(dpad, dtype=jnp.float32)
+    fn = knn_sharded.sharded_predict(m, params, pad_mask=dpad.get("pad_mask"))
+    got = np.asarray(fn(X256))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_forest_tree_sharded_matches(reference_models_dir, X256):
+    d = ski.import_forest(f"{reference_models_dir}/RandomForestClassifier")
+    single = forest.from_numpy(d, dtype=jnp.float32)
+    want = np.asarray(forest.predict(single, X256))
+
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    dpad = forest_sharded.pad_trees(d, 8)
+    params = forest.from_numpy(dpad, dtype=jnp.float32)
+    fn = forest_sharded.sharded_predict(
+        m, params, n_real_trees=dpad.get("n_real_trees", 100)
+    )
+    got = np.asarray(fn(X256))
+    np.testing.assert_array_equal(got, want)

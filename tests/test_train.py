@@ -1,0 +1,84 @@
+"""Training accuracy-parity gates vs the notebook baselines (SURVEY.md §6).
+
+The notebooks trained 6 classes on 8897 rows, but the quake CSV is absent
+from the repository (SURVEY.md §2 C14), so these gates run the identical
+pipeline on the 5 available classes (7653 rows, 50/50 split) and assert
+accuracy at-or-above the 6-class notebook numbers minus a small slack —
+the data is, if anything, easier with the hardest class missing.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from traffic_classifier_sdn_tpu.io.datasets import train_test_split
+from traffic_classifier_sdn_tpu.models import gnb as gnb_model
+from traffic_classifier_sdn_tpu.models import logreg as logreg_model
+from traffic_classifier_sdn_tpu.train import gnb as gnb_train
+from traffic_classifier_sdn_tpu.train import kmeans as kmeans_train
+from traffic_classifier_sdn_tpu.train import logreg as logreg_train
+
+
+@pytest.fixture(scope="module")
+def split(flow_dataset):
+    return train_test_split(flow_dataset, test_size=0.5, seed=101)
+
+
+def _acc(pred, y):
+    return (np.asarray(pred) == y).mean()
+
+
+def test_logreg_training_accuracy(split):
+    tr, te = split
+    n_classes = len(tr.classes)
+    params = logreg_train.fit(tr.X, tr.y, n_classes, max_iter=200)
+    acc = _acc(logreg_model.predict(params, jnp.asarray(te.X, jnp.float32)), te.y)
+    # notebook lbfgs baseline: 96.47% on 6 classes (BASELINE.md)
+    assert acc >= 0.96, f"logreg accuracy {acc:.4f}"
+
+
+def test_gnb_training_accuracy_and_parity(split):
+    tr, te = split
+    n_classes = len(tr.classes)
+    params = gnb_train.fit(tr.X, tr.y, n_classes)
+    acc = _acc(gnb_model.predict(params, jnp.asarray(te.X, jnp.float32)), te.y)
+    # notebook baseline: 98.63% (BASELINE.md)
+    assert acc >= 0.98, f"gnb accuracy {acc:.4f}"
+
+    # closed-form moments must match sklearn's fit exactly
+    from sklearn.naive_bayes import GaussianNB
+
+    sk = GaussianNB().fit(tr.X, tr.y)
+    got = np.asarray(
+        gnb_model.predict(params, jnp.asarray(te.X, jnp.float64))
+    )
+    lut = sk.predict(te.X)
+    assert (got == lut).mean() > 0.999
+
+
+def test_kmeans_training_inertia(split):
+    tr, _ = split
+    params, inertia = kmeans_train.fit(tr.X, k=4, n_init=10, n_iter=50, seed=0)
+    from sklearn.cluster import KMeans
+
+    sk = KMeans(n_clusters=4, n_init=10, random_state=0).fit(tr.X)
+    # Lloyd quality parity: within 5% of sklearn's inertia
+    assert inertia <= sk.inertia_ * 1.05, (inertia, sk.inertia_)
+
+
+def test_logreg_sgd_step_decreases_loss(split):
+    tr, _ = split
+    n_classes = len(tr.classes)
+    init, train_step = logreg_train.make_sgd(learning_rate=1e-2)
+    state = init(n_classes, tr.X.shape[1])
+    # standardize for SGD conditioning (the streaming path's host shell
+    # normalizes; BFGS path handles raw features internally)
+    mu, sd = tr.X.mean(0), tr.X.std(0) + 1e-9
+    Xs = jnp.asarray((tr.X[:4096] - mu) / sd, jnp.float32)
+    y = jnp.asarray(tr.y[:4096], jnp.int32)
+    losses = []
+    for _ in range(100):
+        state, loss = train_step(state, Xs, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]

@@ -1,0 +1,55 @@
+"""The three forest-evaluation strategies must agree exactly:
+gather traversal (CPU-friendly), XLA GEMM form, and the fused Pallas kernel
+(interpreter mode here; compiled on real TPU by bench/verify runs).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+from traffic_classifier_sdn_tpu.models import forest
+from traffic_classifier_sdn_tpu.ops import pallas_forest, tree_gemm
+
+
+@pytest.fixture(scope="module")
+def forest_dict(reference_models_dir):
+    return ski.import_forest(f"{reference_models_dir}/RandomForestClassifier")
+
+
+@pytest.fixture(scope="module")
+def X(flow_dataset):
+    rng = np.random.RandomState(1)
+    idx = rng.choice(flow_dataset.n, size=1500, replace=False)
+    return jnp.asarray(flow_dataset.X[idx], jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def want(forest_dict, X):
+    return np.asarray(forest.predict(forest.from_numpy(forest_dict), X))
+
+
+def test_gemm_matches_gather(forest_dict, X, want):
+    g = tree_gemm.compile_forest(forest_dict)
+    got = np.asarray(tree_gemm.predict(g, X))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gemm_row_chunking(forest_dict, X, want):
+    g = tree_gemm.compile_forest(forest_dict, row_chunk=256)  # forces lax.map
+    got = np.asarray(tree_gemm.predict(g, X))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_interpret_matches(forest_dict, X, want):
+    g = pallas_forest.compile_forest(forest_dict, row_tile=256, tree_chunk=20)
+    got = np.asarray(pallas_forest.predict(g, X, interpret=True))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_row_padding(forest_dict, X, want):
+    """N not a multiple of row_tile exercises the pad/slice path."""
+    g = pallas_forest.compile_forest(forest_dict, row_tile=512, tree_chunk=10)
+    got = np.asarray(pallas_forest.predict(g, X[:777], interpret=True))
+    np.testing.assert_array_equal(got, want[:777])

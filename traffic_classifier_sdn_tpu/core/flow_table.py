@@ -1,0 +1,276 @@
+"""Device-resident flow table: the reference's ``flows = {}`` dict as a
+fixed-capacity structure-of-arrays updated by one jit-compiled scatter step.
+
+The reference keeps per-flow Python objects in a global dict and mutates them
+one telemetry line at a time (traffic_classifier.py:24,157-165). Inverted for
+TPU: all counters live in device arrays; each poll tick applies a *batch* of
+updates in one ``jit`` call (donated state, pure scatter/gather — no
+host↔device ping-pong), and the 12-feature matrix for the classifiers is a
+pure projection of the state.
+
+Numerical design — exact semantics without int64/float64 (neither is fast on
+TPU):
+
+- ``*_lo`` cumulative counters are uint32, i.e. the true counter mod 2^32.
+  A delta is ``int32(new_lo - old_lo)`` in wraparound arithmetic, which is
+  *exact* whenever the true per-poll delta is < 2^31 — so delta features and
+  the ACTIVE/INACTIVE zero-test match the reference's arbitrary-precision
+  Python ints exactly, even after the 4 GiB counter wrap.
+- ``*_f`` cumulative counters are float32 approximations of the full 64-bit
+  value (supplied by the host, which parses the telemetry as int64). Only the
+  average-rate features divide these, so their error is ≤1 ulp relative —
+  the same rounding the f32 feature matrix incurs anyway.
+- Slot assignment (key → row) is host-side control plane: a dict keyed by a
+  *stable* 64-bit hash (ingest/protocol.py) — deliberately not Python's
+  ``hash()``, whose per-process randomization the reference depends on
+  (defect list, SURVEY.md §2).
+
+Row ``capacity`` is reserved as a scratch row so fixed-shape update batches
+can pad harmlessly (no recompilation across variable batch sizes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .features import NUM_FEATURES
+
+
+class DirState(struct.PyTreeNode):
+    """Per-direction counters for every slot, shape (capacity+1,)."""
+
+    pkts_lo: jax.Array  # uint32, true packet count mod 2^32
+    pkts_f: jax.Array  # float32 ≈ true packet count
+    bytes_lo: jax.Array  # uint32
+    bytes_f: jax.Array  # float32
+    delta_pkts: jax.Array  # int32, exact
+    delta_bytes: jax.Array  # int32, exact
+    inst_pps: jax.Array  # float32
+    avg_pps: jax.Array  # float32
+    inst_bps: jax.Array  # float32
+    avg_bps: jax.Array  # float32
+    last_time: jax.Array  # int32
+    active: jax.Array  # bool
+
+
+class FlowTable(struct.PyTreeNode):
+    time_start: jax.Array  # int32 (capacity+1,)
+    in_use: jax.Array  # bool (capacity+1,)
+    fwd: DirState
+    rev: DirState
+
+    @property
+    def capacity(self) -> int:
+        return self.time_start.shape[0] - 1
+
+
+class UpdateBatch(struct.PyTreeNode):
+    """One poll tick's worth of telemetry, padded to a fixed length.
+
+    Padding rows use ``slot == capacity`` (the scratch row) with
+    ``is_create=False, is_fwd=True``. Duplicate (slot, direction) pairs
+    within one batch are not allowed (the host batcher deduplicates
+    last-wins), matching the reference's per-line sequential dict updates.
+    """
+
+    slot: jax.Array  # int32 (B,)
+    time: jax.Array  # int32 (B,) poll timestamp, seconds
+    pkts_lo: jax.Array  # uint32 (B,)
+    pkts_f: jax.Array  # float32 (B,)
+    bytes_lo: jax.Array  # uint32 (B,)
+    bytes_f: jax.Array  # float32 (B,)
+    is_fwd: jax.Array  # bool (B,)
+    is_create: jax.Array  # bool (B,)
+
+
+def _zeros_dir(n: int) -> DirState:
+    return DirState(
+        pkts_lo=jnp.zeros(n, jnp.uint32),
+        pkts_f=jnp.zeros(n, jnp.float32),
+        bytes_lo=jnp.zeros(n, jnp.uint32),
+        bytes_f=jnp.zeros(n, jnp.float32),
+        delta_pkts=jnp.zeros(n, jnp.int32),
+        delta_bytes=jnp.zeros(n, jnp.int32),
+        inst_pps=jnp.zeros(n, jnp.float32),
+        avg_pps=jnp.zeros(n, jnp.float32),
+        inst_bps=jnp.zeros(n, jnp.float32),
+        avg_bps=jnp.zeros(n, jnp.float32),
+        last_time=jnp.zeros(n, jnp.int32),
+        active=jnp.zeros(n, bool),
+    )
+
+
+def make_table(capacity: int) -> FlowTable:
+    n = capacity + 1  # last row is the padding scratch slot
+    return FlowTable(
+        time_start=jnp.zeros(n, jnp.int32),
+        in_use=jnp.zeros(n, bool),
+        fwd=_zeros_dir(n),
+        rev=_zeros_dir(n),
+    )
+
+
+def _updated_dir(
+    d: DirState, slot, time, pkts_lo, pkts_f, bytes_lo, bytes_f, time_start, apply_mask
+) -> DirState:
+    """Compute the reference's updateforward/updatereverse math
+    (traffic_classifier.py:63-96) for a batch of rows, then scatter."""
+    old_pkts_lo = d.pkts_lo[slot]
+    old_bytes_lo = d.bytes_lo[slot]
+    old_last = d.last_time[slot]
+
+    # Exact deltas via mod-2^32 wraparound (see module docstring).
+    delta_pkts = (pkts_lo - old_pkts_lo).astype(jnp.int32)
+    delta_bytes = (bytes_lo - old_bytes_lo).astype(jnp.int32)
+
+    age = (time - time_start).astype(jnp.float32)
+    gap = (time - old_last).astype(jnp.float32)
+    # Guards replicate reference :66-67: keep the old value when the
+    # denominator would be zero.
+    avg_pps = jnp.where(age != 0, pkts_f / age, d.avg_pps[slot])
+    avg_bps = jnp.where(age != 0, bytes_f / age, d.avg_bps[slot])
+    inst_pps = jnp.where(
+        gap != 0, delta_pkts.astype(jnp.float32) / gap, d.inst_pps[slot]
+    )
+    inst_bps = jnp.where(
+        gap != 0, delta_bytes.astype(jnp.float32) / gap, d.inst_bps[slot]
+    )
+    active = (delta_bytes != 0) & (delta_pkts != 0)  # reference :75-78
+
+    # Masked scatter: rows not applying to this direction are routed to the
+    # scratch row (last index). Never write identity values at the real slot —
+    # the same slot can appear in the batch for the *other* direction, and
+    # duplicate-index scatter order is undefined, so an identity write could
+    # clobber the real one.
+    scratch = d.pkts_lo.shape[0] - 1
+    eff_slot = jnp.where(apply_mask, slot, scratch)
+
+    def put(arr, new):
+        return arr.at[eff_slot].set(new, mode="drop")
+
+    return DirState(
+        pkts_lo=put(d.pkts_lo, pkts_lo),
+        pkts_f=put(d.pkts_f, pkts_f),
+        bytes_lo=put(d.bytes_lo, bytes_lo),
+        bytes_f=put(d.bytes_f, bytes_f),
+        delta_pkts=put(d.delta_pkts, delta_pkts),
+        delta_bytes=put(d.delta_bytes, delta_bytes),
+        inst_pps=put(d.inst_pps, inst_pps),
+        avg_pps=put(d.avg_pps, avg_pps),
+        inst_bps=put(d.inst_bps, inst_bps),
+        avg_bps=put(d.avg_bps, avg_bps),
+        last_time=put(d.last_time, time),
+        active=put(d.active, active),
+    )
+
+
+def _created_dir(
+    d: DirState, b: UpdateBatch, counters_from_batch: bool, active_init: bool
+) -> DirState:
+    """Initialize rows for newly created flows (reference :38-60): the
+    forward side gets the first counters and starts ACTIVE
+    (``counters_from_batch=True, active_init=True``), the reverse side
+    starts at zero INACTIVE. Both sides' last_time starts at time_start."""
+    # Route non-create rows to the scratch row (see _updated_dir on why
+    # identity writes at the real slot are unsafe).
+    scratch = d.pkts_lo.shape[0] - 1
+    eff_slot = jnp.where(b.is_create, b.slot, scratch)
+
+    def put(arr, new):
+        return arr.at[eff_slot].set(new, mode="drop")
+
+    if counters_from_batch:
+        pk_lo, pk_f, by_lo, by_f = b.pkts_lo, b.pkts_f, b.bytes_lo, b.bytes_f
+    else:
+        pk_lo = jnp.zeros_like(b.pkts_lo)
+        pk_f = jnp.zeros_like(b.pkts_f)
+        by_lo = jnp.zeros_like(b.bytes_lo)
+        by_f = jnp.zeros_like(b.bytes_f)
+    zero_i = jnp.zeros_like(b.slot)
+    zero_f = jnp.zeros_like(b.pkts_f)
+    return DirState(
+        pkts_lo=put(d.pkts_lo, pk_lo),
+        pkts_f=put(d.pkts_f, pk_f),
+        bytes_lo=put(d.bytes_lo, by_lo),
+        bytes_f=put(d.bytes_f, by_f),
+        delta_pkts=put(d.delta_pkts, zero_i),
+        delta_bytes=put(d.delta_bytes, zero_i),
+        inst_pps=put(d.inst_pps, zero_f),
+        avg_pps=put(d.avg_pps, zero_f),
+        inst_bps=put(d.inst_bps, zero_f),
+        avg_bps=put(d.avg_bps, zero_f),
+        last_time=put(d.last_time, b.time),
+        active=put(d.active, jnp.full_like(b.is_create, active_init)),
+    )
+
+
+@jax.jit
+def apply_batch(table: FlowTable, b: UpdateBatch) -> FlowTable:
+    """Apply one padded update batch. Donate ``table`` at the call site
+    (``jax.jit(apply_batch).lower`` …) or rely on XLA aliasing via the
+    wrapper in ingest/batcher.py for true in-place updates."""
+    slot = b.slot
+    create = b.is_create
+    upd_fwd = ~create & b.is_fwd
+    upd_rev = ~create & ~b.is_fwd
+
+    # Creation: shared fields. Non-create rows route to the scratch row
+    # (duplicate-slot safety — see _updated_dir).
+    scratch = table.time_start.shape[0] - 1
+    create_slot = jnp.where(create, slot, scratch)
+    time_start = table.time_start.at[create_slot].set(b.time, mode="drop")
+    in_use = table.in_use.at[create_slot].set(True, mode="drop")
+
+    # Creates BEFORE updates: a batch may contain both a flow's create row
+    # and a same-tick update row for either direction (the monitor reports
+    # both directions per poll). Updates must then read the freshly
+    # initialized counters, exactly like the reference's sequential
+    # per-line processing (create → updatereverse within one poll).
+    fwd = _created_dir(table.fwd, b, counters_from_batch=True, active_init=True)
+    rev = _created_dir(table.rev, b, counters_from_batch=False, active_init=False)
+
+    ts_for_rows = time_start[slot]
+    fwd = _updated_dir(
+        fwd, slot, b.time, b.pkts_lo, b.pkts_f, b.bytes_lo, b.bytes_f,
+        ts_for_rows, upd_fwd,
+    )
+    rev = _updated_dir(
+        rev, slot, b.time, b.pkts_lo, b.pkts_f, b.bytes_lo, b.bytes_f,
+        ts_for_rows, upd_rev,
+    )
+
+    return FlowTable(time_start=time_start, in_use=in_use, fwd=fwd, rev=rev)
+
+
+def features12(table: FlowTable) -> jax.Array:
+    """(capacity, 12) online feature matrix, order of
+    traffic_classifier.py:104 — rows for unused slots are zero."""
+    f, r = table.fwd, table.rev
+    cols = [
+        f.delta_pkts.astype(jnp.float32), f.delta_bytes.astype(jnp.float32),
+        f.inst_pps, f.avg_pps, f.inst_bps, f.avg_bps,
+        r.delta_pkts.astype(jnp.float32), r.delta_bytes.astype(jnp.float32),
+        r.inst_pps, r.avg_pps, r.inst_bps, r.avg_bps,
+    ]
+    X = jnp.stack(cols, axis=1)[:-1]  # drop the scratch row
+    X = jnp.where(table.in_use[:-1, None], X, 0.0)
+    assert X.shape[1] == NUM_FEATURES
+    return X
+
+
+def features16(table: FlowTable) -> jax.Array:
+    """(capacity, 16) training-row matrix, order of
+    traffic_classifier.py:124-141 / the CSV header at :217."""
+    f, r = table.fwd, table.rev
+    cols = [
+        f.pkts_f, f.bytes_f,
+        f.delta_pkts.astype(jnp.float32), f.delta_bytes.astype(jnp.float32),
+        f.inst_pps, f.avg_pps, f.inst_bps, f.avg_bps,
+        r.pkts_f, r.bytes_f,
+        r.delta_pkts.astype(jnp.float32), r.delta_bytes.astype(jnp.float32),
+        r.inst_pps, r.avg_pps, r.inst_bps, r.avg_bps,
+    ]
+    X = jnp.stack(cols, axis=1)[:-1]
+    return jnp.where(table.in_use[:-1, None], X, 0.0)

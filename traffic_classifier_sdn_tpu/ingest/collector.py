@@ -1,0 +1,92 @@
+"""Live telemetry collector: runs the OpenFlow monitor as a subprocess and
+streams its line protocol without blocking the classify loop.
+
+The reference blocks on ``p.stdout.readline()`` in its single thread
+(traffic_classifier.py:147-149), coupling telemetry arrival to classify
+latency. Here a reader thread drains the pipe into a queue and the classify
+loop takes whatever has arrived per tick — the device never waits on the
+pipe (SURVEY.md §2.3: eventlet green threads → host-side thread + device
+ring).
+
+Works with any command emitting the protocol: the real Ryu monitor
+(``sudo ryu run simple_monitor_13.py``, reference traffic_classifier.py:22),
+our fake monitor (tools/fake_monitor.py), or ``cat`` of a capture file.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import signal
+import subprocess
+import threading
+from typing import Iterable
+
+from .protocol import TelemetryRecord, parse_line
+
+# The reference's monitor launch command (traffic_classifier.py:22).
+DEFAULT_MONITOR_CMD = "sudo ryu run simple_monitor_13.py"
+
+
+class SubprocessCollector:
+    """Spawn a monitor command and iterate parsed records."""
+
+    def __init__(self, cmd: str = DEFAULT_MONITOR_CMD, queue_size: int = 1 << 16):
+        self.cmd = cmd
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._proc: subprocess.Popen | None = None
+        self._thread: threading.Thread | None = None
+        self.lines_dropped = 0
+
+    def start(self) -> None:
+        self._proc = subprocess.Popen(
+            self.cmd,
+            shell=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            preexec_fn=os.setsid,
+        )
+        self._thread = threading.Thread(target=self._reader, daemon=True)
+        self._thread.start()
+
+    def _reader(self) -> None:
+        assert self._proc is not None and self._proc.stdout is not None
+        for line in self._proc.stdout:
+            r = parse_line(line)
+            if r is None:
+                continue
+            try:
+                self._queue.put_nowait(r)
+            except queue.Full:
+                # back-pressure: drop oldest-style accounting, keep newest
+                self.lines_dropped += 1
+
+    def poll_records(self, max_records: int = 1 << 20) -> list[TelemetryRecord]:
+        """Drain whatever has arrived (non-blocking)."""
+        out = []
+        try:
+            while len(out) < max_records:
+                out.append(self._queue.get_nowait())
+        except queue.Empty:
+            pass
+        return out
+
+    def wait_record(self, timeout: float) -> TelemetryRecord | None:
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    @property
+    def running(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def stop(self) -> None:
+        """Terminate the monitor's process group (the reference's
+        ``os.killpg`` teardown at traffic_classifier.py:222)."""
+        if self._proc is not None and self._proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        self._proc = None

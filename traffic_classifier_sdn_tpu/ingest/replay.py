@@ -1,0 +1,95 @@
+"""Replay and synthetic telemetry sources — the first-class test seam the
+reference lacks (SURVEY.md §4b: the line protocol at simple_monitor_13.py:66
+is trivially fakeable; here it is an explicit interface).
+
+Sources yield ``TelemetryRecord`` batches grouped by poll tick, so the whole
+ingest→classify path runs without Mininet/OVS/Ryu: from a recorded monitor
+capture, or from a synthetic flow population (used by benchmarks to generate
+millions of concurrent flows).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+
+from .protocol import TelemetryRecord, parse_line
+
+
+def iter_capture(path: str) -> Iterator[list[TelemetryRecord]]:
+    """Replay a recorded monitor stdout capture, yielding one list of
+    records per poll timestamp (lines with equal time field)."""
+    tick: list[TelemetryRecord] = []
+    current_t = None
+    with open(path, "rb") as f:
+        for line in f:
+            r = parse_line(line)
+            if r is None:
+                continue
+            if current_t is not None and r.time != current_t and tick:
+                yield tick
+                tick = []
+            current_t = r.time
+            tick.append(r)
+    if tick:
+        yield tick
+
+
+@dataclass
+class SyntheticFlows:
+    """A population of bidirectional flows with per-class-like rate
+    characteristics, emitted in the monitor's line protocol semantics
+    (cumulative counters, 1 Hz polls).
+
+    Each conversation produces two records per tick (one per direction),
+    mimicking what the monitor logs for the two learned-switch flow entries
+    of a host pair (simple_monitor_13.py:49-66).
+    """
+
+    n_flows: int
+    seed: int = 0
+    start_time: int = 1
+
+    def __post_init__(self):
+        rng = np.random.RandomState(self.seed)
+        self.pps_fwd = rng.gamma(2.0, 50.0, self.n_flows)
+        self.pps_rev = rng.gamma(2.0, 40.0, self.n_flows)
+        self.bpp_fwd = rng.uniform(60, 1400, self.n_flows)
+        self.bpp_rev = rng.uniform(60, 1400, self.n_flows)
+        self.cum_pkts_fwd = np.zeros(self.n_flows, np.int64)
+        self.cum_bytes_fwd = np.zeros(self.n_flows, np.int64)
+        self.cum_pkts_rev = np.zeros(self.n_flows, np.int64)
+        self.cum_bytes_rev = np.zeros(self.n_flows, np.int64)
+        self.t = self.start_time
+        self._rng = rng
+
+    def _mac(self, i: int, side: int) -> str:
+        b = (i * 2 + side).to_bytes(6, "big")
+        return ":".join(f"{x:02x}" for x in b)
+
+    def tick(self) -> list[TelemetryRecord]:
+        dp = np.int64(self.pps_fwd * self._rng.poisson(1.0, self.n_flows))
+        self.cum_pkts_fwd += dp
+        self.cum_bytes_fwd += np.int64(dp * self.bpp_fwd)
+        dr = np.int64(self.pps_rev * self._rng.poisson(1.0, self.n_flows))
+        self.cum_pkts_rev += dr
+        self.cum_bytes_rev += np.int64(dr * self.bpp_rev)
+        out = []
+        for i in range(self.n_flows):
+            src, dst = self._mac(i, 0), self._mac(i, 1)
+            out.append(TelemetryRecord(
+                time=self.t, datapath="1", in_port="1", eth_src=src,
+                eth_dst=dst, out_port="2",
+                packets=int(self.cum_pkts_fwd[i]),
+                bytes=int(self.cum_bytes_fwd[i]),
+            ))
+            out.append(TelemetryRecord(
+                time=self.t, datapath="1", in_port="2", eth_src=dst,
+                eth_dst=src, out_port="1",
+                packets=int(self.cum_pkts_rev[i]),
+                bytes=int(self.cum_bytes_rev[i]),
+            ))
+        self.t += 1
+        return out

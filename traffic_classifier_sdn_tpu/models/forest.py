@@ -30,11 +30,20 @@ class Params(struct.PyTreeNode):
 
 
 def from_numpy(d: dict, dtype=jnp.float32) -> Params:
+    import numpy as np
+
+    from ..io.sklearn_import import f32_safe_thresholds
+
+    thr = np.asarray(d["threshold"], np.float64)
+    if dtype == jnp.float32:
+        # sklearn compares f32 features against f64 midpoint thresholds;
+        # round-down keeps every decision identical in pure f32.
+        thr = f32_safe_thresholds(thr)
     return Params(
         left=jnp.asarray(d["left"]),
         right=jnp.asarray(d["right"]),
         feature=jnp.asarray(d["feature"]),
-        threshold=jnp.asarray(d["threshold"], dtype=dtype),
+        threshold=jnp.asarray(thr, dtype=dtype),
         values=jnp.asarray(d["values"], dtype=dtype),
         max_depth=int(d["max_depth"]),
     )

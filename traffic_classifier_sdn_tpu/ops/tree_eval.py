@@ -2,14 +2,14 @@
 
 Replaces the 100 sequential Cython ``sklearn.tree._tree.Tree`` traversals
 inside ``RandomForestClassifier.predict`` (reference checkpoint
-``models/RandomForestClassifier``; SURVEY.md §2.3). Two strategies:
+``models/RandomForestClassifier``; SURVEY.md §2.3) with a lockstep gather
+traversal: all (sample, tree) pairs walk their tree in ``max_depth`` rounds
+of vectorized gathers over dense (T, M) node stacks.
 
-1. ``traverse_gather`` — all (sample, tree) pairs walk their tree in
-   lockstep: ``max_depth`` rounds of vectorized gathers. Work is
-   O(N·T·depth) with tiny constants; the node arrays live in VMEM-friendly
-   dense (T, M) stacks padded to the max node count.
-2. ``traverse_onehot`` — Hummingbird-style GEMM formulation (kept for
-   benchmarking; gather wins at these tree sizes).
+This is the CPU-friendly strategy (and the semantic reference the others
+are tested against). On TPU, per-element gathers serialize badly; the
+production paths are the GEMM formulation (ops/tree_gemm.py) and the fused
+Pallas kernel (ops/pallas_forest.py).
 
 Leaves are encoded sklearn-style: ``left == right == -1``; padded slots are
 leaves with zero value rows. A walker that reaches a leaf self-loops, so
@@ -50,18 +50,43 @@ def traverse_gather(
 
 
 def forest_proba(
-    left, right, feature, threshold, values, X, max_depth: int
+    left, right, feature, threshold, values, X, max_depth: int,
+    tree_chunk: int = 16,
 ) -> jax.Array:
     """Mean of per-tree normalized leaf class distributions, (N, C) — the
     exact quantity sklearn's ``RandomForestClassifier.predict_proba``
-    averages before argmax."""
+    averages before argmax.
+
+    Trees are accumulated in chunks of ``tree_chunk`` so the live
+    intermediate is (N, chunk, C), not (N, T, C) — a million-flow batch
+    against 100 trees would otherwise materialize ~25 GB in HBM."""
     leaf = traverse_gather(left, right, feature, threshold, X, max_depth)
     n_trees = left.shape[0]
-    tree_ar = jnp.arange(n_trees)[None, :]
-    leaf_vals = values[tree_ar, leaf]  # (N, T, C) class counts
-    norm = jnp.sum(leaf_vals, axis=-1, keepdims=True)
-    probs = leaf_vals / jnp.maximum(norm, 1e-30)
-    return jnp.mean(probs, axis=1)
+    n_classes = values.shape[-1]
+    # Normalize leaf count rows into distributions once (tiny: T·M·C).
+    norm = jnp.sum(values, axis=-1, keepdims=True)
+    values_n = values / jnp.maximum(norm, 1e-30)
+
+    chunk = min(tree_chunk, n_trees)
+    n_chunks, rem = divmod(n_trees, chunk)
+
+    def add_chunk(t0, probs):
+        idx = lax.dynamic_slice_in_dim(leaf, t0, chunk, axis=1)  # (N, c)
+        vals = lax.dynamic_slice_in_dim(values_n, t0, chunk, axis=0)  # (c,M,C)
+        picked = vals[jnp.arange(chunk)[None, :], idx]  # (N, c, C)
+        return probs + jnp.sum(picked, axis=1)
+
+    probs = jnp.zeros((X.shape[0], n_classes), values_n.dtype)
+    probs = lax.fori_loop(
+        0, n_chunks, lambda i, p: add_chunk(i * chunk, p), probs
+    )
+    if rem:
+        idx = leaf[:, n_trees - rem:]
+        vals = values_n[n_trees - rem:]
+        probs = probs + jnp.sum(
+            vals[jnp.arange(rem)[None, :], idx], axis=1
+        )
+    return probs / n_trees
 
 
 def tree_votes(left, right, feature, threshold, values, X, max_depth: int):

@@ -1,0 +1,85 @@
+"""Tree-sharded random forest: the ensemble split across chips, class
+distributions psum-merged over ICI.
+
+The reference evaluates 100 Cython trees sequentially on one CPU
+(SURVEY.md §2.3). Here each chip holds T/D trees (the dense padded node
+arrays shard cleanly on their leading axis), evaluates its sub-ensemble with
+the same lockstep traversal as the single-chip path (ops/tree_eval.py), and
+one ``psum`` of the (N, C) per-chip probability sums produces the exact
+ensemble average — bitwise-equal reduction order aside, the same math as
+sklearn's ``predict_proba`` mean.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..models import forest
+from ..ops import tree_eval
+from .mesh import STATE_AXIS
+
+
+def pad_trees(d: dict, n_shards: int) -> dict:
+    """Pad the ensemble to a multiple of the state-axis size with inert
+    single-leaf trees whose value rows are all-zero (they contribute zero
+    probability mass; the divisor uses the true tree count)."""
+    import numpy as np
+
+    T = d["left"].shape[0]
+    pad = (-T) % n_shards
+    if pad == 0:
+        return d
+    out = dict(d)
+    for name in ("left", "right"):
+        out[name] = np.concatenate(
+            [d[name], np.full((pad,) + d[name].shape[1:], -1, d[name].dtype)]
+        )
+    for name in ("feature", "threshold", "values"):
+        out[name] = np.concatenate(
+            [d[name], np.zeros((pad,) + d[name].shape[1:], d[name].dtype)]
+        )
+    out["n_real_trees"] = T
+    return out
+
+
+def sharded_predict(mesh, params: forest.Params, n_real_trees: int | None = None):
+    """Build a jit-compiled tree-sharded predict: ``fn(X) -> (N,) int32``."""
+    T = params.left.shape[0]
+    n_real = n_real_trees if n_real_trees is not None else T
+    max_depth = params.max_depth
+
+    def local_eval(left, right, feature, threshold, values, X):
+        leaf = tree_eval.traverse_gather(
+            left, right, feature, threshold, X, max_depth
+        )
+        tree_ar = jnp.arange(left.shape[0])[None, :]
+        leaf_vals = values[tree_ar, leaf]  # (N, T_local, C)
+        norm = jnp.sum(leaf_vals, axis=-1, keepdims=True)
+        # Padding trees have all-zero values → 0/max(0,eps) = 0 contribution.
+        probs = leaf_vals / jnp.maximum(norm, 1e-30)
+        local_sum = jnp.sum(probs, axis=1)  # (N, C)
+        total = lax.psum(local_sum, STATE_AXIS)
+        return jnp.argmax(total / n_real, axis=-1).astype(jnp.int32)
+
+    shmapped = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(
+            P(STATE_AXIS), P(STATE_AXIS), P(STATE_AXIS), P(STATE_AXIS),
+            P(STATE_AXIS), P(),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def fn(X):
+        return shmapped(
+            params.left, params.right, params.feature, params.threshold,
+            params.values, X,
+        )
+
+    return fn

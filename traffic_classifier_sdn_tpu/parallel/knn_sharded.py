@@ -1,0 +1,102 @@
+"""State-sharded k-NN: the training corpus split across chips, global top-k
+merged over ICI.
+
+The reference's KNN walks one KDTree on one CPU (SURVEY.md §2.3). The
+TPU-scale design (SURVEY.md §2.4): shard the (S, F) training matrix on the
+mesh's state axis; each chip computes distances to its local shard and takes
+a *local* top-k; the (devices × k) candidates are then ``all_gather``-merged
+and reduced to the global top-k. Communication is O(devices · k) per query —
+independent of corpus size S — so the corpus can grow with the mesh.
+
+Built on ``shard_map`` with explicit collectives, per the scaling-book
+recipe: pick the mesh, shard the state, let the collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..models import knn
+from .mesh import STATE_AXIS
+
+
+def pad_corpus(d: dict, n_shards: int) -> dict:
+    """Pad corpus length to a multiple of the state-axis size with
+    +inf-distance sentinels (zero rows never win because their half-norm
+    is replaced by +inf)."""
+    import numpy as np
+
+    S = d["fit_X"].shape[0]
+    pad = (-S) % n_shards
+    if pad == 0:
+        return d
+    out = dict(d)
+    out["fit_X"] = np.concatenate(
+        [d["fit_X"], np.zeros((pad, d["fit_X"].shape[1]))], axis=0
+    )
+    out["y"] = np.concatenate([d["y"], np.zeros(pad, d["y"].dtype)])
+    out["pad_mask"] = np.concatenate(
+        [np.zeros(S, bool), np.ones(pad, bool)]
+    )
+    return out
+
+
+def sharded_predict(mesh, params: knn.Params, pad_mask=None):
+    """Build a jit-compiled sharded predict: X replicated per-chip on the
+    state axis (each chip sees the full query batch), corpus sharded.
+
+    Returns ``fn(X) -> (N,) int32``.
+    """
+    n_classes = params.n_classes
+    k = params.n_neighbors
+
+    in_specs = (
+        P(STATE_AXIS),  # fit_X rows
+        P(STATE_AXIS),  # fit_y
+        P(STATE_AXIS),  # half_sq_norms (+inf at padding)
+        P(),  # X replicated
+    )
+
+    def local_topk(fit_X, fit_y, half_norms, X):
+        sim = (
+            jnp.matmul(X, fit_X.T, precision=lax.Precision.HIGHEST)
+            - half_norms[None, :]
+        )
+        val, idx = lax.top_k(sim, k)  # local (N, k)
+        lab = fit_y[idx]
+        # merge across the state axis: gather every chip's candidates
+        all_val = lax.all_gather(val, STATE_AXIS, axis=0)  # (D, N, k)
+        all_lab = lax.all_gather(lab, STATE_AXIS, axis=0)
+        D = all_val.shape[0]
+        N = all_val.shape[1]
+        merged_val = jnp.moveaxis(all_val, 0, 1).reshape(N, D * k)
+        merged_lab = jnp.moveaxis(all_lab, 0, 1).reshape(N, D * k)
+        gval, gidx = lax.top_k(merged_val, k)  # global top-k
+        glab = jnp.take_along_axis(merged_lab, gidx, axis=1)
+        votes = jnp.sum(
+            jax.nn.one_hot(glab, n_classes, dtype=jnp.int32), axis=1
+        )
+        return jnp.argmax(votes, axis=-1).astype(jnp.int32)
+
+    shmapped = jax.shard_map(
+        local_topk,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    half = params.half_sq_norms
+    if pad_mask is not None:
+        half = jnp.where(jnp.asarray(pad_mask), jnp.inf, half)
+
+    @jax.jit
+    def fn(X):
+        return shmapped(params.fit_X, params.fit_y, half, X)
+
+    return fn

@@ -1,0 +1,62 @@
+"""Device mesh construction — the framework's single parallelism substrate.
+
+The reference has no parallelism at all (SURVEY.md §2.4); every scaling axis
+here is expressed over one ``jax.sharding.Mesh``:
+
+- ``data``  — the flow batch N (the reference's per-flow Python loop axis)
+- ``state`` — model state: the KNN corpus, the forest's trees, SVC's support
+  vectors (the axes sklearn's Cython loops walk sequentially)
+
+Multi-host: call ``init_distributed`` first (jax.distributed handles the
+DCN rendezvous); the mesh then spans all hosts' devices and XLA routes
+collectives over ICI within a slice and DCN across slices.
+
+Tests exercise the same code on a virtual CPU mesh via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4c).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+STATE_AXIS = "state"
+
+
+def make_mesh(
+    n_data: int | None = None, n_state: int = 1, devices=None
+) -> Mesh:
+    """A (data, state) mesh. Default: all devices on the data axis."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = devices.size // n_state
+    if n_data * n_state != devices.size:
+        raise ValueError(
+            f"mesh {n_data}x{n_state} != {devices.size} devices"
+        )
+    return Mesh(devices.reshape(n_data, n_state), (DATA_AXIS, STATE_AXIS))
+
+
+def init_distributed(coordinator: str | None = None, **kw) -> None:
+    """Multi-host bring-up (the reference's closest analogue is the
+    OpenFlow TCP session at simple_monitor_13.py:43-47; ours is the XLA
+    runtime's DCN rendezvous)."""
+    if coordinator is not None:
+        kw["coordinator_address"] = coordinator
+    jax.distributed.initialize(**kw)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh) -> NamedSharding:
+    """Rows of an (N, …) batch split over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def state_sharded(mesh: Mesh) -> NamedSharding:
+    """Leading axis of model state split over the state axis."""
+    return NamedSharding(mesh, P(STATE_AXIS))

@@ -1,0 +1,53 @@
+"""Gaussian naive Bayes training as closed-form segment moments.
+
+Replaces sklearn's ``GaussianNB.fit`` (``5_GaussianNB.ipynb``; SURVEY.md §7
+step 4): per-class counts, means, and variances computed as three one-hot
+matmuls (MXU-friendly segment sums), plus sklearn's exact variance smoothing
+``var += var_smoothing · max(var over features)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models import gnb
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def moments(X, y, n_classes: int):
+    """Per-class (count, mean, var) via one-hot segment sums — the
+    psum-able building block for the data-parallel fit."""
+    onehot = jax.nn.one_hot(y, n_classes, dtype=X.dtype)  # (N, C)
+    counts = jnp.sum(onehot, axis=0)  # (C,)
+    sums = jnp.matmul(onehot.T, X, precision=_HI)  # (C, F)
+    mean = sums / counts[:, None]
+    # Two-pass variance: E[x²]−E[x]² cancels catastrophically on this data
+    # (x ~ 1e8 → x² ~ 1e16 vs small within-class variance); centering first
+    # keeps full relative precision and matches sklearn's np.var.
+    centered = X - mean[y]  # (N, F) per-row class-mean subtraction
+    sq_sums = jnp.matmul(onehot.T, centered * centered, precision=_HI)
+    var = sq_sums / counts[:, None]
+    return counts, mean, var
+
+
+def fit(X, y, n_classes: int, *, var_smoothing: float = 1e-9) -> gnb.Params:
+    X = jnp.asarray(X, jnp.float64)
+    y = jnp.asarray(y, jnp.int32)
+    counts, theta, var = moments(X, y, n_classes)
+    # sklearn's epsilon_ is var_smoothing × the largest *global* per-feature
+    # variance (GaussianNB.fit), not the largest per-class variance.
+    mu_all = jnp.mean(X, axis=0)
+    global_var = jnp.mean((X - mu_all) ** 2, axis=0)
+    var = var + var_smoothing * jnp.max(global_var)
+    prior = counts / jnp.sum(counts)
+    import numpy as np
+
+    return gnb.from_numpy(
+        {
+            "theta": np.asarray(theta),
+            "var": np.asarray(var),
+            "class_prior": np.asarray(prior),
+        }
+    )

@@ -1,0 +1,86 @@
+"""KMeans training: Lloyd iterations as jit matmul + argmin + segment-sum,
+with k-means++ seeding and the n_init restarts *vmapped* — all restarts run
+as one batched program instead of sklearn's sequential loop
+(SURVEY.md §2.3: replaces the Elkan/Lloyd Cython path).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..models import kmeans
+
+
+def _assign(X, centers):
+    # Difference form, matching models/kmeans.py: the dot-product expansion
+    # cancels catastrophically in f32 at this data's ~8e8 feature scale
+    # (and its d² can even go negative, corrupting the k-means++ weights).
+    diff = X[:, None, :] - centers[None, :, :]  # (N, K, F)
+    d2 = jnp.sum(diff * diff, axis=-1)
+    return jnp.argmin(d2, axis=1), d2
+
+
+def _plusplus_init(key, X, k: int):
+    """k-means++ seeding (jit-safe: fori over k)."""
+    n = X.shape[0]
+    key, sub = jax.random.split(key)
+    first = jax.random.randint(sub, (), 0, n)
+    centers = jnp.zeros((k, X.shape[1]), X.dtype).at[0].set(X[first])
+
+    def body(i, carry):
+        centers, key = carry
+        _, d2 = _assign(X, centers)
+        # distance to nearest already-chosen center (cols ≥ i are zeros rows:
+        # mask them out with +inf so they don't attract)
+        valid = jnp.arange(k) < i
+        dmin = jnp.min(jnp.where(valid[None, :], d2, jnp.inf), axis=1)
+        dmin = jnp.maximum(dmin, 0.0)
+        key, sub = jax.random.split(key)
+        probs = dmin / jnp.maximum(jnp.sum(dmin), 1e-30)
+        idx = jax.random.choice(sub, n, p=probs)
+        return centers.at[i].set(X[idx]), key
+
+    centers, _ = jax.lax.fori_loop(1, k, body, (centers, key))
+    return centers
+
+
+def _lloyd(X, centers0, n_iter: int):
+    def body(_, centers):
+        labels, _ = _assign(X, centers)
+        onehot = jax.nn.one_hot(labels, centers.shape[0], dtype=X.dtype)
+        counts = jnp.sum(onehot, axis=0)
+        sums = jnp.matmul(
+            onehot.T, X, precision=jax.lax.Precision.HIGHEST
+        )
+        new = sums / jnp.maximum(counts[:, None], 1.0)
+        # empty cluster: keep previous center (sklearn relocates; for this
+        # data empty clusters don't arise — documented simplification)
+        return jnp.where(counts[:, None] > 0, new, centers)
+
+    centers = jax.lax.fori_loop(0, n_iter, body, centers0)
+    labels, d2 = _assign(X, centers)
+    inertia = jnp.sum(jnp.take_along_axis(d2, labels[:, None], axis=1))
+    return centers, inertia
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def _fit_impl(key, X, k, n_init, n_iter):
+    keys = jax.random.split(key, n_init)
+    init_centers = jax.vmap(lambda kk: _plusplus_init(kk, X, k))(keys)
+    centers, inertia = jax.vmap(lambda c0: _lloyd(X, c0, n_iter))(init_centers)
+    best = jnp.argmin(inertia)
+    return centers[best], inertia[best]
+
+
+def fit(
+    X, k: int = 4, *, n_init: int = 10, n_iter: int = 50, seed: int = 0
+) -> tuple[kmeans.Params, float]:
+    X = jnp.asarray(X, jnp.float32)
+    centers, inertia = _fit_impl(jax.random.key(seed), X, k, n_init, n_iter)
+    import numpy as np
+
+    params = kmeans.from_numpy({"cluster_centers": np.asarray(centers)})
+    return params, float(inertia)
