@@ -73,40 +73,43 @@ def bench_tpu_forest(X_np: np.ndarray) -> dict:
     from jax import lax
 
     from traffic_classifier_sdn_tpu.io import sklearn_import as ski
-    from traffic_classifier_sdn_tpu.models import forest
+    from traffic_classifier_sdn_tpu.ops import tree_gemm
 
-    params = forest.from_numpy(
+    # The MXU-native GEMM formulation (ops/tree_gemm.py) — the production
+    # TPU path; the gather traversal is ~1000× slower on TPU and can wedge
+    # the worker at this batch size.
+    g = tree_gemm.compile_forest(
         ski.import_forest("/root/reference/models/RandomForestClassifier")
     )
     X = jnp.asarray(X_np, jnp.float32)
 
     def make_loop(k):
         @jax.jit
-        def loop(params, X):
+        def loop(g, X):
             def body(i, acc):
                 # loop-carried input perturbation: forces a fresh predict
                 # each iteration (no loop-invariant hoisting)
                 Xi = X.at[0, 0].set(acc * 1e-9 + jnp.float32(i))
-                pred = forest.predict(params, Xi)
+                pred = tree_gemm.predict(g, Xi)
                 return acc + jnp.sum(pred).astype(jnp.float32)
 
             return lax.fori_loop(0, k, body, jnp.float32(0.0))
 
         return loop
 
-    sec = _device_seconds_per_call(make_loop, params, X)
+    sec = _device_seconds_per_call(make_loop, g, X)
 
     # e2e single-batch p50: one predict + scalar fetch (includes the host
     # round trip a real serving loop would pay once per batch)
     @jax.jit
-    def one(params, X):
-        return jnp.sum(forest.predict(params, X))
+    def one(g, X):
+        return jnp.sum(tree_gemm.predict(g, X))
 
-    _sync_scalar(one(params, X))
+    _sync_scalar(one(g, X))
     times = []
     for _ in range(9):
         t0 = time.perf_counter()
-        _sync_scalar(one(params, X))
+        _sync_scalar(one(g, X))
         times.append(time.perf_counter() - t0)
     e2e_p50 = float(np.median(times))
 

@@ -42,7 +42,8 @@ def test_classify_replay_all_models(sub, capture_file, capsys, reference_models_
     )
     out = capsys.readouterr().out
     assert "Flow ID" in out and "Traffic Type" in out
-    assert "ACTIVE" in out
+    # " ACTIVE" (delimited) — bare "ACTIVE" is a substring of "INACTIVE"
+    assert " ACTIVE" in out
 
 
 def test_classify_synthetic(capsys, reference_models_dir):

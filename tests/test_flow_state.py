@@ -229,6 +229,25 @@ def test_capacity_overflow_drops():
     assert np.asarray(eng.table.in_use)[:2].all()
 
 
+def test_evict_idle_reclaims_slots():
+    eng = FlowStateEngine(capacity=2)
+    eng.ingest([_rec(1, "a", "b", 1, 10), _rec(1, "c", "d", 1, 10)])
+    eng.step()
+    eng.ingest([_rec(5, "a", "b", 2, 20)])  # keep a↔b fresh
+    eng.step()
+    assert eng.evict_idle(now=10, idle_seconds=6) == 1  # c↔d stale
+    in_use = np.asarray(eng.table.in_use)[:-1]
+    assert in_use.sum() == 1
+    # the freed slot is reusable by a new flow
+    eng.ingest([_rec(11, "e", "f", 1, 10)])
+    eng.step()
+    assert np.asarray(eng.table.in_use)[:-1].sum() == 2
+    assert eng.batcher.dropped == 0
+    # evicted flow's features are zeroed
+    key_cd = stable_flow_key("1", "c", "d")
+    assert key_cd not in eng.index.key_to_slot
+
+
 def test_bucketed_padding_no_recompile():
     """Batch sizes within one bucket reuse the same executable."""
     import jax

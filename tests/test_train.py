@@ -67,6 +67,52 @@ def test_kmeans_training_inertia(split):
     assert inertia <= sk.inertia_ * 1.05, (inertia, sk.inertia_)
 
 
+def test_forest_training_accuracy(split):
+    from traffic_classifier_sdn_tpu.models import forest as forest_model
+    from traffic_classifier_sdn_tpu.train import forest as forest_train
+
+    tr, te = split
+    n_classes = len(tr.classes)
+    # 16 trees keeps CPU CI fast; measured 99.84% (100 trees: 99.82%) vs
+    # the 99.87% notebook baseline (BASELINE.md)
+    params = forest_train.fit(
+        tr.X, tr.y, n_classes, n_trees=16, max_depth=8, n_bins=64, seed=0
+    )
+    acc = _acc(
+        forest_model.predict(params, jnp.asarray(te.X, jnp.float32)), te.y
+    )
+    assert acc >= 0.99, f"forest accuracy {acc:.4f}"
+
+
+def test_svc_training_accuracy(split):
+    from traffic_classifier_sdn_tpu.models import svc as svc_model
+    from traffic_classifier_sdn_tpu.train import svc as svc_train
+
+    tr, te = split
+    n_classes = len(tr.classes)
+    params = svc_train.fit(tr.X, tr.y, n_classes, n_iters=800)
+    Xhi, Xlo = svc_model.split_hilo(te.X)
+    acc = _acc(svc_model.predict(params, Xhi, Xlo), te.y)
+    # measured 85.81% — identical to sklearn SVC(rbf, C=1, gamma=scale) on
+    # this split; notebook 6-class baseline 85.01% (BASELINE.md)
+    assert acc >= 0.84, f"svc accuracy {acc:.4f}"
+
+
+def test_knn_training_accuracy(split):
+    from traffic_classifier_sdn_tpu.models import knn as knn_model
+    from traffic_classifier_sdn_tpu.train import knn as knn_train
+
+    tr, te = split
+    params = knn_train.fit(
+        tr.X, tr.y, n_neighbors=5, n_classes=len(tr.classes)
+    )
+    acc = _acc(
+        knn_model.predict(params, jnp.asarray(te.X, jnp.float32)), te.y
+    )
+    # notebook baseline: 99.30% (BASELINE.md)
+    assert acc >= 0.99, f"knn accuracy {acc:.4f}"
+
+
 def test_logreg_sgd_step_decreases_loss(split):
     tr, _ = split
     n_classes = len(tr.classes)

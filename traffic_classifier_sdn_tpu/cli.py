@@ -72,6 +72,12 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--capacity", type=int, default=65536)
     p.add_argument(
+        "--idle-timeout",
+        type=int,
+        default=60,
+        help="evict flows idle for N seconds (0 disables eviction)",
+    )
+    p.add_argument(
         "--print-every", type=int, default=10, help="render every N poll ticks"
     )
     p.add_argument(
@@ -110,9 +116,11 @@ def _tick_source(args):
         coll = SubprocessCollector(args.monitor_cmd or DEFAULT_MONITOR_CMD)
         coll.start()
         try:
-            while coll.running:
+            while True:
                 first = coll.wait_record(timeout=2.0)
                 if first is None:
+                    if not coll.running:
+                        break  # monitor exited and the queue is drained
                     continue
                 time.sleep(0.05)  # let the 1 Hz burst of lines arrive
                 yield [first] + coll.poll_records()
@@ -126,7 +134,6 @@ def _run_classify(args) -> None:
     from .ingest.batcher import FlowStateEngine
     from .models import SUBCOMMAND_ALIASES, load_reference_model
     from .io.sklearn_import import REFERENCE_CHECKPOINTS
-    from .utils.table import CLASSIFIER_FIELDS, render_table, status_str
 
     name = SUBCOMMAND_ALIASES[args.subcommand]
     ckpt = f"{args.checkpoint_dir}/{REFERENCE_CHECKPOINTS[name]}"
@@ -135,11 +142,24 @@ def _run_classify(args) -> None:
 
     engine = FlowStateEngine(args.capacity)
     ticks = 0
+    dropped_seen = 0
     for records in _tick_source(args):
         engine.ingest(records)
         engine.step()
         ticks += 1
         if ticks % args.print_every == 0:
+            if args.idle_timeout and records:
+                now = max(r.time for r in records)
+                engine.evict_idle(now, args.idle_timeout)
+            if engine.batcher.dropped > dropped_seen:
+                print(
+                    f"WARNING: flow table full — "
+                    f"{engine.batcher.dropped - dropped_seen} new flows "
+                    f"dropped since last report (capacity {args.capacity}, "
+                    f"idle-timeout {args.idle_timeout}s)",
+                    file=sys.stderr,
+                )
+                dropped_seen = engine.batcher.dropped
             _print_table(engine, model, predict, args)
         if args.max_ticks and ticks >= args.max_ticks:
             break

@@ -244,6 +244,32 @@ def apply_batch(table: FlowTable, b: UpdateBatch) -> FlowTable:
     return FlowTable(time_start=time_start, in_use=in_use, fwd=fwd, rev=rev)
 
 
+def _cleared_dir(d: DirState, slot) -> DirState:
+    def put(arr):
+        return arr.at[slot].set(jnp.zeros((), arr.dtype), mode="drop")
+
+    return DirState(
+        pkts_lo=put(d.pkts_lo), pkts_f=put(d.pkts_f),
+        bytes_lo=put(d.bytes_lo), bytes_f=put(d.bytes_f),
+        delta_pkts=put(d.delta_pkts), delta_bytes=put(d.delta_bytes),
+        inst_pps=put(d.inst_pps), avg_pps=put(d.avg_pps),
+        inst_bps=put(d.inst_bps), avg_bps=put(d.avg_bps),
+        last_time=put(d.last_time), active=put(d.active),
+    )
+
+
+@jax.jit
+def clear_slots(table: FlowTable, slot: jax.Array) -> FlowTable:
+    """Reset the given slots to the empty state (eviction). ``slot`` is a
+    fixed-length int32 batch padded with ``capacity`` (the scratch row)."""
+    return FlowTable(
+        time_start=table.time_start.at[slot].set(0, mode="drop"),
+        in_use=table.in_use.at[slot].set(False, mode="drop"),
+        fwd=_cleared_dir(table.fwd, slot),
+        rev=_cleared_dir(table.rev, slot),
+    )
+
+
 def features12(table: FlowTable) -> jax.Array:
     """(capacity, 12) online feature matrix, order of
     traffic_classifier.py:104 — rows for unused slots are zero."""

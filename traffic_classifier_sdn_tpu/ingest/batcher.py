@@ -46,6 +46,7 @@ class FlowIndex:
 
     capacity: int
     key_to_slot: dict = field(default_factory=dict)
+    slot_to_key: dict = field(default_factory=dict)
     slot_meta: dict = field(default_factory=dict)  # slot → (src, dst) for UI
     free: list = field(default_factory=list)
     next_slot: int = 0
@@ -69,12 +70,14 @@ class FlowIndex:
         else:
             return None
         self.key_to_slot[key] = slot
+        self.slot_to_key[slot] = key
         self.slot_meta[slot] = (r.eth_src, r.eth_dst)
         return SlotAssignment(slot, True, True)
 
-    def release(self, key: int) -> None:
-        slot = self.key_to_slot.pop(key, None)
-        if slot is not None:
+    def release_slot(self, slot: int) -> None:
+        key = self.slot_to_key.pop(slot, None)
+        if key is not None:
+            self.key_to_slot.pop(key, None)
             self.slot_meta.pop(slot, None)
             self.free.append(slot)
 
@@ -126,7 +129,11 @@ class Batcher:
         )
 
     def flush(self) -> ft.UpdateBatch | None:
-        """Materialize and clear; None when empty."""
+        """Materialize up to one largest-bucket batch and clear what it
+        consumed; None when empty. Rows beyond the largest bucket stay
+        pending — call again until None (engine.step loops). Per-slot
+        create rows always precede their update row across the split, so
+        sequential semantics hold."""
         rows = []  # (slot, fwd, rec, is_create)
         for (s, fwd), e in self._pending.items():
             if e["create"] is not None:
@@ -135,6 +142,14 @@ class Batcher:
                 rows.append((s, fwd, e["update"], False))
         if not rows:
             return None
+        self._pending.clear()
+        if len(rows) > self.buckets[-1]:
+            for s, fwd, r, create in rows[self.buckets[-1] :]:
+                entry = self._pending.setdefault(
+                    (s, fwd), {"create": None, "update": None}
+                )
+                entry["create" if create else "update"] = r
+            rows = rows[: self.buckets[-1]]
         size = bucket_size(len(rows), self.buckets)
         slot = np.full(size, self.index.capacity, np.int32)  # scratch row pad
         time = np.zeros(size, np.int32)
@@ -153,7 +168,6 @@ class Batcher:
             bytes_f[i] = np.float32(r.bytes)
             is_fwd[i] = fwd
             is_create[i] = create
-        self._pending.clear()
         return ft.UpdateBatch(
             slot=slot, time=time, pkts_lo=pkts_lo, pkts_f=pkts_f,
             bytes_lo=bytes_lo, bytes_f=bytes_f, is_fwd=is_fwd,
@@ -191,13 +205,41 @@ class FlowStateEngine:
         return n
 
     def step(self) -> bool:
-        """Flush pending records into the device table; False if idle."""
-        batch = self.batcher.flush()
-        if batch is None:
-            return False
-        self.table = _apply(self.table, batch)
-        return True
+        """Flush all pending records into the device table; False if idle.
+        Loops because one tick can exceed the largest batch bucket."""
+        applied = False
+        while (batch := self.batcher.flush()) is not None:
+            self.table = _apply(self.table, batch)
+            applied = True
+        return applied
 
     def features(self):
         """(capacity, 12) device feature matrix (classifier input)."""
         return ft.features12(self.table)
+
+    def evict_idle(self, now: int, idle_seconds: int) -> int:
+        """Release flows with no telemetry in either direction for
+        ``idle_seconds`` — the capacity-reclaim the reference lacks (its
+        ``flows`` dict grows forever, traffic_classifier.py:24). Returns
+        the number of evicted flows."""
+        # Flush pending records first: device last_time must be current,
+        # and no stale pending row may outlive its slot's eviction (it
+        # would scatter into a reassigned slot).
+        self.step()
+        in_use = np.asarray(self.table.in_use)[:-1]
+        last = np.maximum(
+            np.asarray(self.table.fwd.last_time)[:-1],
+            np.asarray(self.table.rev.last_time)[:-1],
+        )
+        stale = in_use & (now - last >= idle_seconds)
+        slots = np.nonzero(stale)[0]
+        step = self.batcher.buckets[-1]
+        for i in range(0, slots.size, step):
+            chunk = slots[i : i + step]
+            size = bucket_size(chunk.size, self.batcher.buckets)
+            padded = np.full(size, self.index.capacity, np.int32)
+            padded[: chunk.size] = chunk
+            self.table = ft.clear_slots(self.table, padded)
+        for s in slots:
+            self.index.release_slot(int(s))
+        return int(slots.size)

@@ -214,6 +214,7 @@ def import_forest(path: str) -> dict:
         "values": values,
         "max_depth": max(t["max_depth"] for t in trees),
         "classes": _classes(est),
+        "n_features": int(est.n_features_in_),
     }
 
 

@@ -45,15 +45,20 @@ class ForestGemm(struct.PyTreeNode):
     row_chunk: int = struct.field(pytree_node=False)
 
 
-def build_gemm_operands(d: dict) -> dict:
+def build_gemm_operands(d: dict, n_features: int | None = None) -> dict:
     """Extract per-tree GEMM operands (numpy) from importer node arrays
     (io/sklearn_import.import_forest format). Shared by the XLA GEMM path
-    below and the fused Pallas kernel (ops/pallas_forest.py)."""
+    below and the fused Pallas kernel (ops/pallas_forest.py).
+
+    ``n_features`` must match the width of the X the forest will see; it
+    defaults to the importer dict's value, else the widest feature id used
+    by any split."""
     left, right = d["left"], d["right"]
     feature, threshold, values = d["feature"], d["threshold"], d["values"]
     n_trees, M = left.shape
     n_classes = values.shape[2]
-    n_features = 12
+    if n_features is None:
+        n_features = int(d.get("n_features", int(np.max(feature)) + 1))
 
     per_tree = []
     D_max = L_max = 0
@@ -142,9 +147,11 @@ def build_gemm_operands(d: dict) -> dict:
     }
 
 
-def compile_forest(d: dict, row_chunk: int = 32768) -> ForestGemm:
+def compile_forest(
+    d: dict, row_chunk: int = 32768, n_features: int | None = None
+) -> ForestGemm:
     """Build device GEMM operands from importer node arrays."""
-    ops = build_gemm_operands(d)
+    ops = build_gemm_operands(d, n_features=n_features)
     return ForestGemm(
         feat_onehot=jnp.asarray(ops["feat_onehot"]),
         thresholds=jnp.asarray(ops["thresholds"]),

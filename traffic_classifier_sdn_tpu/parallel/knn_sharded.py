@@ -26,13 +26,16 @@ from .mesh import STATE_AXIS
 
 
 def pad_corpus(d: dict, n_shards: int) -> dict:
-    """Pad corpus length to a multiple of the state-axis size with
-    +inf-distance sentinels (zero rows never win because their half-norm
-    is replaced by +inf)."""
+    """Pad corpus length to a multiple of the state-axis size — and to at
+    least ``n_neighbors`` rows per shard, so the local ``top_k`` is always
+    well-formed — with +inf-distance sentinels (zero rows never win because
+    their half-norm is replaced by +inf)."""
     import numpy as np
 
     S = d["fit_X"].shape[0]
-    pad = (-S) % n_shards
+    k = int(d.get("n_neighbors", 1))
+    target = max(S + (-S) % n_shards, n_shards * k)
+    pad = target - S
     if pad == 0:
         return d
     out = dict(d)

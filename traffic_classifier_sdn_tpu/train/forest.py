@@ -1,0 +1,227 @@
+"""On-device random-forest training via histogram split search.
+
+Replaces sklearn's Cython CART builder (``3_RandomForest.ipynb`` cell 13;
+reference checkpoint ``models/RandomForestClassifier``: 100 gini trees,
+bootstrap, max_features=sqrt; SURVEY.md §2.3, §7 hard part d). Exact
+split enumeration is pointer-chasing and data-dependent — hostile to XLA —
+so this builder uses the standard accelerator-friendly reformulation
+(LightGBM/XGBoost-style quantile histograms, level-wise growth):
+
+- Features are pre-binned on the host into ``n_bins`` quantile bins whose
+  edges are actual data values, making the binned comparison
+  ``bin(x) <= b  ⟺  x <= edges[b]`` exact — so the trained tree evaluates
+  identically through the unbinned predict path (ops/tree_eval.py).
+- Trees grow breadth-first in a perfect binary layout: at depth ``d`` one
+  scatter-add builds the (nodes, features, bins, classes) class-count
+  histogram for every node at once, a cumulative sum turns it into all
+  left/right split candidates, and the gini surrogate
+  ``Σc nL_c²/nL + Σc nR_c²/nR`` (maximizing ⇔ minimizing weighted child
+  impurity) is evaluated for every (node, feature, bin) in one shot.
+- Per-node feature subsampling (max_features) uses a top-k mask over
+  uniform scores; bootstrap resampling becomes per-sample integer weights.
+- The whole builder is ``jit``-compiled with static depth; trees run in a
+  ``lax.scan`` over per-tree PRNG keys, so 100 trees compile once.
+
+The output is a models/forest.Params node stack — the same format the
+sklearn-checkpoint importer produces — so sharded predict
+(parallel/forest_sharded.py) and the GEMM/Pallas kernels apply unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import forest
+
+
+def make_bins(X: np.ndarray, n_bins: int) -> np.ndarray:
+    """Per-feature candidate thresholds: (F, n_bins-1) sorted data values.
+
+    Edges are taken from the data (quantile ``method='lower'``) so every
+    threshold is exactly representable and the bin/raw comparisons agree.
+    """
+    X = np.asarray(X, np.float32)
+    qs = np.linspace(0.0, 1.0, n_bins - 1)
+    edges = np.quantile(X, qs, axis=0, method="lower").T.astype(np.float32)
+    return np.sort(edges, axis=1)
+
+
+def bin_features(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Map raw features to bin ids: bin(x) = #{edges < x} ∈ [0, n_bins-1]."""
+    X = np.asarray(X, np.float32)
+    out = np.empty(X.shape, np.int32)
+    for f in range(X.shape[1]):
+        out[:, f] = np.searchsorted(edges[f], X[:, f], side="left")
+    return out
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_classes", "max_depth", "n_bins", "max_features", "bootstrap"
+    ),
+)
+def _build_tree(
+    key,
+    Xb,  # (N, F) int32 binned features
+    y,  # (N,) int32
+    edges,  # (F, B-1) f32 candidate thresholds
+    *,
+    n_classes: int,
+    max_depth: int,
+    n_bins: int,
+    max_features: int,
+    bootstrap: bool,
+):
+    N, F = Xb.shape
+    E = n_bins - 1  # candidate split count per feature
+    M = 2 ** (max_depth + 1) - 1  # perfect-layout node capacity
+
+    k_boot, k_feat = jax.random.split(key)
+    if bootstrap:
+        picks = jax.random.randint(k_boot, (N,), 0, N)
+        w = jnp.zeros(N, jnp.float32).at[picks].add(1.0)
+    else:
+        w = jnp.ones(N, jnp.float32)
+
+    left = jnp.full(M, -1, jnp.int32)
+    right = jnp.full(M, -1, jnp.int32)
+    feature = jnp.zeros(M, jnp.int32)
+    threshold = jnp.zeros(M, jnp.float32)
+    values = jnp.zeros((M, n_classes), jnp.float32)
+
+    pos = jnp.zeros(N, jnp.int32)  # node index *within* the current level
+    wa = w  # per-sample weight, zeroed once its node goes leaf
+
+    feat_keys = jax.random.split(k_feat, max_depth)
+    fi = jnp.arange(F)
+
+    for d in range(max_depth + 1):
+        n_nodes = 2 ** d
+        off = n_nodes - 1  # global offset of this level
+
+        cnt = jnp.zeros((n_nodes, n_classes), jnp.float32)
+        cnt = cnt.at[pos, y].add(wa)  # (nodes, C) node class counts
+        n_node = jnp.sum(cnt, axis=1)  # (nodes,)
+        values = jax.lax.dynamic_update_slice_in_dim(values, cnt, off, 0)
+
+        if d == max_depth:
+            break  # deepest level: all leaves
+
+        # Class-count histogram over (node, feature, bin, class).
+        H = jnp.zeros((n_nodes, F, n_bins, n_classes), jnp.float32)
+        H = H.at[pos[:, None], fi[None, :], Xb, y[:, None]].add(
+            wa[:, None]
+        )
+
+        # All left/right candidates at once: L[n,f,b,c] = count with
+        # bin <= b; split b keeps bins [0..b] left ⟺ x <= edges[f, b].
+        L = jnp.cumsum(H, axis=2)[:, :, :E, :]  # (nodes, F, E, C)
+        nL = jnp.sum(L, axis=-1)
+        R = cnt[:, None, None, :] - L
+        nR = n_node[:, None, None] - nL
+        score = jnp.sum(L * L, -1) / jnp.maximum(nL, 1.0) + jnp.sum(
+            R * R, -1
+        ) / jnp.maximum(nR, 1.0)
+        score = jnp.where((nL > 0) & (nR > 0), score, -jnp.inf)
+
+        # Per-node feature subsampling (sklearn max_features): keep the
+        # top-`max_features` of per-(node, feature) uniform scores.
+        if max_features < F:
+            u = jax.random.uniform(feat_keys[d], (n_nodes, F))
+            kth = jax.lax.top_k(u, max_features)[0][:, -1]
+            score = jnp.where(
+                (u >= kth[:, None])[:, :, None], score, -jnp.inf
+            )
+
+        flat = score.reshape(n_nodes, F * E)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+        f_star = (best // E).astype(jnp.int32)
+        b_star = (best % E).astype(jnp.int32)
+
+        # Positive impurity decrease ⟺ child score beats the parent's
+        # Σc cnt²/n; pure or <2-sample nodes become leaves.
+        parent_score = jnp.sum(cnt * cnt, 1) / jnp.maximum(n_node, 1.0)
+        is_split = (
+            (best_gain > parent_score + 1e-3)
+            & (n_node >= 2.0)
+            & (jnp.max(cnt, axis=1) < n_node)
+        )
+
+        child_off = 2 * n_nodes - 1
+        kid = jnp.arange(n_nodes, dtype=jnp.int32)
+        left = jax.lax.dynamic_update_slice_in_dim(
+            left, jnp.where(is_split, child_off + 2 * kid, -1), off, 0
+        )
+        right = jax.lax.dynamic_update_slice_in_dim(
+            right, jnp.where(is_split, child_off + 2 * kid + 1, -1), off, 0
+        )
+        feature = jax.lax.dynamic_update_slice_in_dim(
+            feature, jnp.where(is_split, f_star, 0), off, 0
+        )
+        threshold = jax.lax.dynamic_update_slice_in_dim(
+            threshold,
+            jnp.where(is_split, edges[f_star, b_star], 0.0),
+            off,
+            0,
+        )
+
+        # Route samples one level down; samples in leaf nodes go inert.
+        sf = f_star[pos]
+        sb = b_star[pos]
+        go_left = jnp.take_along_axis(Xb, sf[:, None], 1)[:, 0] <= sb
+        wa = jnp.where(is_split[pos], wa, 0.0)
+        pos = 2 * pos + jnp.where(go_left, 0, 1)
+
+    return left, right, feature, threshold, values
+
+
+def fit(
+    X,
+    y,
+    n_classes: int,
+    *,
+    n_trees: int = 100,
+    max_depth: int = 10,
+    n_bins: int = 128,
+    max_features: int | str = "sqrt",
+    bootstrap: bool = True,
+    seed: int = 0,
+) -> forest.Params:
+    """Fit a random forest on device; returns predict-ready node stacks."""
+    X = np.asarray(X, np.float32)
+    y_np = np.asarray(y, np.int32)
+    F = X.shape[1]
+    if max_features == "sqrt":
+        max_features = max(1, int(np.sqrt(F)))
+
+    edges = make_bins(X, n_bins)
+    Xb = jnp.asarray(bin_features(X, edges))
+    yj = jnp.asarray(y_np)
+    ej = jnp.asarray(edges)
+
+    build = partial(
+        _build_tree,
+        n_classes=n_classes,
+        max_depth=max_depth,
+        n_bins=n_bins,
+        max_features=int(max_features),
+        bootstrap=bootstrap,
+    )
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
+    left, right, feature, threshold, values = jax.lax.map(
+        lambda k: build(k, Xb, yj, ej), keys
+    )
+    return forest.Params(
+        left=left,
+        right=right,
+        feature=feature,
+        threshold=threshold,
+        values=values,
+        max_depth=max_depth,
+    )

@@ -1,0 +1,34 @@
+"""KNN "training" — corpus registration as device arrays.
+
+sklearn's ``KNeighborsClassifier.fit`` builds a KDTree
+(``4_knearest.ipynb`` cell 13; SURVEY.md §2.3). TPUs have no pointer-chasing
+tree structures; the idiomatic fit is to lay the training matrix out as a
+dense device array (two-float split for parity-exact f32 distances) so
+predict is one MXU matmul + ``lax.top_k`` (models/knn.py). For corpora
+bigger than one chip's HBM, shard with parallel/knn_sharded.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models import knn
+
+
+def fit(X, y, *, n_neighbors: int = 5, n_classes: int | None = None,
+        dtype=None) -> knn.Params:
+    """Register the training corpus; returns predict-ready Params."""
+    import jax.numpy as jnp
+
+    y = np.asarray(y)
+    if n_classes is None:
+        n_classes = int(y.max()) + 1
+    return knn.from_numpy(
+        {
+            "fit_X": np.asarray(X, np.float64),
+            "y": y.astype(np.int32),
+            "n_neighbors": n_neighbors,
+            "classes": np.arange(n_classes),
+        },
+        dtype=dtype or jnp.float32,
+    )

@@ -1,0 +1,171 @@
+"""On-device RBF-SVC training: batched one-vs-one dual ascent.
+
+Replaces libsvm's sequential SMO fit (``2_SVM.ipynb`` cell 13; reference
+checkpoint ``models/SVC``; SURVEY.md §2.3, §7 hard part d). SMO updates one
+α pair at a time — inherently serial and shape-dynamic, hostile to XLA —
+so this trainer uses the accelerator-friendly reformulation:
+
+- The intercept's equality constraint ``Σ tᵃαᵃ = 0`` is removed by
+  augmenting the kernel with a constant (``K+1``), the classic
+  bias-regularized SVM: the dual becomes a pure box-constrained QP,
+  ``max Σα − ½αᵀQα, 0 ≤ α ≤ C`` with ``Q = ttᵀ ⊙ (K+1)``, and the
+  intercept is recovered as ``b = Σ tᵃαᵃ``.
+- Each of the C·(C−1)/2 ovo subproblems is solved by projected gradient
+  ascent with Nesterov momentum (FISTA), step 1/λmax estimated by power
+  iteration — every iteration is one dense symmetric matvec on the MXU.
+- All pairs run through one ``lax.scan`` body, padded to the largest pair,
+  so the 15 binary SVMs compile once and stream through the chip.
+
+The full train-set kernel is computed once with the two-float (hi/lo)
+difference form (models/svc.py numerical notes: raw features reach ~8e8, so
+the dot-product expansion of ‖x−s‖² cancels catastrophically in f32),
+chunked so the (chunk, N, F) difference tensor stays small in HBM.
+
+The result is packed directly into models/svc.Params (dense per-pair
+coefficients over the support vectors), so the Pallas/XLA predict paths and
+sharded serving apply to retrained models unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import svc
+
+
+def _pad_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def rbf_kernel_matrix(
+    X: np.ndarray, gamma: float, chunk: int = 256
+) -> jax.Array:
+    """Full (N, N) RBF kernel, f32, hi/lo-exact distances, row-chunked."""
+    N = X.shape[0]
+    Xhi, Xlo = svc.split_hilo(X)
+    Np = _pad_to(N, chunk)
+    pad = Np - N
+    Xhi_p = jnp.pad(Xhi, ((0, pad), (0, 0)))
+    Xlo_p = jnp.pad(Xlo, ((0, pad), (0, 0)))
+    g = jnp.float32(gamma)
+
+    def block(args):
+        bh, bl = args  # (chunk, F)
+        diff = (bh[:, None, :] - Xhi[None, :, :]) + (
+            bl[:, None, :] - Xlo[None, :, :]
+        )
+        return jnp.exp(-g * jnp.sum(diff * diff, axis=-1))  # (chunk, N)
+
+    nb = Np // chunk
+    blocks = jax.lax.map(
+        block,
+        (
+            Xhi_p.reshape(nb, chunk, -1),
+            Xlo_p.reshape(nb, chunk, -1),
+        ),
+    )
+    return blocks.reshape(Np, N)[:N]
+
+
+@partial(jax.jit, static_argnames=("n_iters", "power_iters"))
+def _solve_pair(K, idx, t, Cbox, *, n_iters: int, power_iters: int):
+    """FISTA on one padded ovo box QP; returns α (Smax,)."""
+    Kp = K[idx[:, None], idx[None, :]] + 1.0  # bias-augmented
+    valid = t != 0.0
+
+    def matvec(v):
+        return t * jnp.matmul(
+            Kp, t * v, precision=jax.lax.Precision.HIGHEST
+        )
+
+    # Power iteration for λmax(Q) → step size.
+    v0 = valid.astype(jnp.float32)
+    v0 = v0 / jnp.linalg.norm(v0)
+
+    def pw(_, v):
+        w = matvec(v)
+        return w / jnp.maximum(jnp.linalg.norm(w), 1e-12)
+
+    v = jax.lax.fori_loop(0, power_iters, pw, v0)
+    lam = jnp.vdot(v, matvec(v))
+    eta = (1.0 / jnp.maximum(lam, 1e-6)).astype(jnp.float32)
+
+    def proj(a):
+        return jnp.clip(a, 0.0, Cbox)
+
+    def step(i, carry):
+        a, z = carry
+        g = 1.0 - matvec(z)  # ∇ of Σα − ½αᵀQα at the momentum point
+        a_new = proj(z + eta * g)
+        beta = i.astype(jnp.float32) / (i.astype(jnp.float32) + 3.0)
+        z_new = a_new + beta * (a_new - a)
+        return a_new, z_new
+
+    a0 = jnp.zeros_like(t)
+    a, _ = jax.lax.fori_loop(0, n_iters, step, (a0, a0))
+    return a
+
+
+def fit(
+    X,
+    y,
+    n_classes: int,
+    *,
+    C: float = 1.0,
+    gamma: float | str = "scale",
+    n_iters: int = 800,
+    power_iters: int = 24,
+    sv_tol: float = 1e-6,
+) -> svc.Params:
+    """Fit ovo RBF-SVC on device; returns predict-ready Params."""
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.int32)
+    N, F = X.shape
+    if gamma == "scale":  # sklearn: 1 / (F · Var(X))
+        gamma = 1.0 / (F * X.var())
+    gamma = float(gamma)
+
+    K = rbf_kernel_matrix(X, gamma)
+
+    pairs = [(i, j) for i in range(n_classes) for j in range(i + 1, n_classes)]
+    members = [np.nonzero((y == i) | (y == j))[0] for i, j in pairs]
+    Smax = max(len(m) for m in members)
+
+    idx_all = np.zeros((len(pairs), Smax), np.int32)
+    t_all = np.zeros((len(pairs), Smax), np.float32)
+    for p, ((i, j), m) in enumerate(zip(pairs, members)):
+        idx_all[p, : len(m)] = m
+        t_all[p, : len(m)] = np.where(y[m] == i, 1.0, -1.0)
+    Cbox_all = np.where(t_all != 0.0, np.float32(C), 0.0)
+
+    solve = partial(_solve_pair, n_iters=n_iters, power_iters=power_iters)
+    alphas = jax.lax.map(
+        lambda args: solve(K, *args),
+        (jnp.asarray(idx_all), jnp.asarray(t_all), jnp.asarray(Cbox_all)),
+    )  # (P, Smax)
+
+    # Pack into dense (P, N) signed coefficients + recovered intercepts.
+    coef_dense = np.zeros((len(pairs), N), np.float64)
+    at = np.asarray(alphas, np.float64) * t_all
+    for p in range(len(pairs)):
+        m = members[p]
+        coef_dense[p, m] = at[p, : len(m)]
+    intercept = at.sum(axis=1)  # b from the K+1 augmentation
+
+    sv_mask = np.abs(coef_dense).max(axis=0) > sv_tol
+    sv_idx = np.nonzero(sv_mask)[0]
+    sv_hi, sv_lo = svc.split_hilo(X[sv_idx])
+    return svc.Params(
+        sv_hi=sv_hi,
+        sv_lo=sv_lo,
+        pair_coef=jnp.asarray(coef_dense[:, sv_idx], jnp.float32),
+        intercept=jnp.asarray(intercept, jnp.float32),
+        vote_i=jnp.asarray([i for i, _ in pairs], jnp.int32),
+        vote_j=jnp.asarray([j for _, j in pairs], jnp.int32),
+        gamma=jnp.asarray(gamma, jnp.float32),
+        n_classes=n_classes,
+    )
