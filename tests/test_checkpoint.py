@@ -1,0 +1,144 @@
+"""Checkpoint/resume + config round-trip tests (SURVEY.md §5: the
+reference's only persistence is sklearn pickles; we add versioned native
+checkpoints and resumable training state)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from traffic_classifier_sdn_tpu import config as config_mod
+from traffic_classifier_sdn_tpu.io import checkpoint as ckpt
+from traffic_classifier_sdn_tpu.models import MODEL_MODULES, load_reference_model
+from traffic_classifier_sdn_tpu.io.sklearn_import import REFERENCE_CHECKPOINTS
+
+
+@pytest.mark.parametrize(
+    "sub,name",
+    [
+        ("logistic", "logreg"),
+        ("gaussiannb", "gnb"),
+        ("kmeans", "kmeans"),
+        ("knearest", "knn"),
+        ("svm", "svc"),
+        ("Randomforest", "forest"),
+    ],
+)
+def test_model_checkpoint_roundtrip(
+    sub, name, tmp_path, reference_models_dir, flow_dataset
+):
+    src = os.path.join(reference_models_dir, REFERENCE_CHECKPOINTS[name])
+    m = load_reference_model(sub, src)
+    path = str(tmp_path / name)
+    ckpt.save_model(
+        path, name, m.params,
+        m.classes.names if m.classes is not None else None,
+    )
+    m2 = ckpt.load_model(path)
+    assert m2.name == name
+
+    X = jnp.asarray(flow_dataset.X[:256], jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(m.predict(m.params, X)),
+        np.asarray(m2.predict(m2.params, X)),
+    )
+    if m.classes is not None:
+        assert m2.classes.names == m.classes.names
+
+
+def test_checkpoint_version_gate(tmp_path, reference_models_dir):
+    src = os.path.join(reference_models_dir, REFERENCE_CHECKPOINTS["logreg"])
+    m = load_reference_model("logistic", src)
+    path = str(tmp_path / "m")
+    ckpt.save_model(path, "logreg", m.params, m.classes.names)
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    manifest["format_version"] = 999
+    json.dump(manifest, open(os.path.join(path, "manifest.json"), "w"))
+    with pytest.raises(ValueError, match="format_version"):
+        ckpt.load_model(path)
+
+
+def test_train_state_resume(tmp_path):
+    from traffic_classifier_sdn_tpu.train import logreg as logreg_train
+
+    init, train_step = logreg_train.make_sgd(learning_rate=1e-2)
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.rand(64, 12), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 6, 64), jnp.int32)
+
+    state = init(6, 12)
+    for step in range(5):
+        state, _ = train_step(state, X, y)
+    ckpt.save_train_state(str(tmp_path / "ts"), state, step=5)
+
+    restored, step = ckpt.restore_train_state(str(tmp_path / "ts"), init(6, 12))
+    assert step == 5
+    # resumed trajectory identical to the uninterrupted one
+    cont_a, loss_a = train_step(state, X, y)
+    cont_b, loss_b = train_step(restored, X, y)
+    assert float(loss_a) == float(loss_b)
+    np.testing.assert_array_equal(
+        np.asarray(cont_a.params.coef), np.asarray(cont_b.params.coef)
+    )
+
+
+def test_config_roundtrip_and_partial(tmp_path):
+    cfg = config_mod.Config(
+        mesh=config_mod.MeshConfig(n_data=4, n_state=2),
+        ingest=config_mod.IngestConfig(capacity=1024, idle_timeout_s=30),
+    )
+    path = str(tmp_path / "cfg.json")
+    config_mod.save(cfg, path)
+    back = config_mod.load(path)
+    assert back == cfg
+
+    partial = config_mod.from_dict({"ingest": {"capacity": 99}})
+    assert partial.ingest.capacity == 99
+    assert partial.ingest.idle_timeout_s == 60  # default preserved
+
+    with pytest.raises(ValueError, match="unknown"):
+        config_mod.from_dict({"ingest": {"capacityy": 1}})
+
+
+def test_cli_retrain_and_native_checkpoint(
+    tmp_path, capsys, reference_datasets_dir
+):
+    from traffic_classifier_sdn_tpu import cli
+
+    path = str(tmp_path / "native_gnb")
+    cli.main(
+        [
+            "retrain", "gnb",
+            "--data-dir", reference_datasets_dir,
+            "--native-checkpoint", path,
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "held-out accuracy" in out and "saved native checkpoint" in out
+
+    # classify from the freshly trained native checkpoint via replay
+    from traffic_classifier_sdn_tpu.ingest.protocol import format_line
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+
+    cap = tmp_path / "capture.tsv"
+    syn = SyntheticFlows(n_flows=8, seed=1)
+    with open(cap, "wb") as f:
+        for _ in range(6):
+            for r in syn.tick():
+                f.write(format_line(r))
+    cli.main(
+        [
+            "gaussiannb",
+            "--source", "replay",
+            "--capture", str(cap),
+            "--native-checkpoint", path,
+            "--capacity", "32",
+            "--print-every", "3",
+            "--max-ticks", "6",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "Traffic Type" in out

@@ -26,6 +26,7 @@ import numpy as np
 
 SUBCOMMANDS = (
     "train",
+    "retrain",
     "logistic",
     "kmeans",
     "knearest",
@@ -51,7 +52,21 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "traffic_type",
         nargs="?",
-        help="traffic label to collect (train subcommand only)",
+        help="traffic label to collect (train), or model family (retrain)",
+    )
+    p.add_argument(
+        "--config", default=None, help="JSON config file (config.py schema)"
+    )
+    p.add_argument(
+        "--native-checkpoint",
+        default=None,
+        help="load from an io/checkpoint.py directory instead of a "
+        "reference pickle (classify), or save target (retrain)",
+    )
+    p.add_argument(
+        "--data-dir",
+        default="/root/reference/datasets",
+        help="training CSV directory (retrain subcommand)",
     )
     p.add_argument(
         "--source",
@@ -65,26 +80,31 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         help="override the monitor command for --source ryu",
     )
+    # None defaults are sentinels: a --config file fills them, then
+    # main() applies the built-in defaults (see main()).
     p.add_argument(
         "--checkpoint-dir",
-        default=_DEFAULT_CKPT_DIR,
-        help="directory with reference-format model checkpoints",
+        default=None,
+        help="directory with reference-format model checkpoints "
+        f"(default {_DEFAULT_CKPT_DIR})",
     )
-    p.add_argument("--capacity", type=int, default=65536)
+    p.add_argument("--capacity", type=int, default=None)
     p.add_argument(
         "--idle-timeout",
         type=int,
-        default=60,
-        help="evict flows idle for N seconds (0 disables eviction)",
+        default=None,
+        help="evict flows idle for N seconds (0 disables; default 60)",
     )
     p.add_argument(
-        "--print-every", type=int, default=10, help="render every N poll ticks"
+        "--print-every", type=int, default=None,
+        help="render every N poll ticks (default 10)",
     )
     p.add_argument(
         "--duration",
         type=float,
-        default=15 * 60,
-        help="train collection seconds (reference TIMEOUT, :27)",
+        default=None,
+        help="train collection seconds (reference TIMEOUT, :27; "
+        "default 900)",
     )
     p.add_argument(
         "--max-ticks", type=int, default=0, help="stop after N ticks (0=∞)"
@@ -136,8 +156,13 @@ def _run_classify(args) -> None:
     from .io.sklearn_import import REFERENCE_CHECKPOINTS
 
     name = SUBCOMMAND_ALIASES[args.subcommand]
-    ckpt = f"{args.checkpoint_dir}/{REFERENCE_CHECKPOINTS[name]}"
-    model = load_reference_model(args.subcommand, ckpt)
+    if args.native_checkpoint:
+        from .io.checkpoint import load_model
+
+        model = load_model(args.native_checkpoint)
+    else:
+        ckpt = f"{args.checkpoint_dir}/{REFERENCE_CHECKPOINTS[name]}"
+        model = load_reference_model(args.subcommand, ckpt)
     predict = jax.jit(model.predict)
 
     engine = FlowStateEngine(args.capacity)
@@ -224,10 +249,103 @@ def _run_train(args) -> None:
     print(f"wrote {out_path}")
 
 
+def _run_retrain(args) -> None:
+    """On-device retraining from the training CSVs (the C12 notebook
+    pipeline, SURVEY.md §3.4) + native checkpoint save."""
+    import jax.numpy as jnp
+
+    from .io.datasets import load_reference_datasets, train_test_split
+    from .models import MODEL_MODULES, SUBCOMMAND_ALIASES
+
+    family = SUBCOMMAND_ALIASES.get(args.traffic_type, args.traffic_type)
+    if family not in MODEL_MODULES:
+        sys.exit(
+            f"ERROR: retrain needs a model family "
+            f"({', '.join(MODEL_MODULES)}), got {args.traffic_type!r}"
+        )
+    ds = load_reference_datasets(args.data_dir)
+    tr, te = train_test_split(ds, test_size=0.5, seed=101)
+    n_classes = len(tr.classes)
+    mod = MODEL_MODULES[family]
+
+    if family == "logreg":
+        from .train import logreg as t
+
+        params = t.fit(tr.X, tr.y, n_classes)
+    elif family == "gnb":
+        from .train import gnb as t
+
+        params = t.fit(tr.X, tr.y, n_classes)
+    elif family == "kmeans":
+        from .train import kmeans as t
+
+        params, inertia = t.fit(tr.X, k=n_classes)
+        print(f"kmeans inertia: {inertia:.4g}")
+    elif family == "knn":
+        from .train import knn as t
+
+        params = t.fit(tr.X, tr.y, n_neighbors=5, n_classes=n_classes)
+    elif family == "forest":
+        from .train import forest as t
+
+        params = t.fit(tr.X, tr.y, n_classes)
+    else:  # svc
+        from .train import svc as t
+
+        params = t.fit(tr.X, tr.y, n_classes)
+
+    if family != "kmeans":
+        pred = np.asarray(
+            mod.predict(params, jnp.asarray(te.X, jnp.float32))
+        )
+        acc = (pred == te.y).mean()
+        print(f"{family} held-out accuracy: {acc:.4f} "
+              f"({len(te.y)} rows, classes={list(tr.classes)})")
+    if args.native_checkpoint:
+        from .io.checkpoint import save_model
+
+        save_model(args.native_checkpoint, family, params, tr.classes)
+        print(f"saved native checkpoint to {args.native_checkpoint}")
+
+
 def main(argv=None) -> None:
     args = _build_parser().parse_args(argv)
+    if args.config:
+        from . import config as config_mod
+
+        cfg = config_mod.load(args.config)
+        # config supplies defaults; explicit flags win (argparse defaults
+        # are sentinels where config can override)
+        if args.capacity is None:
+            args.capacity = cfg.ingest.capacity
+        if args.idle_timeout is None:
+            args.idle_timeout = cfg.ingest.idle_timeout_s
+        if args.print_every is None:
+            args.print_every = cfg.print_every
+        if args.monitor_cmd is None:
+            args.monitor_cmd = cfg.ingest.monitor_cmd
+        if args.duration is None:
+            args.duration = cfg.train.collect_duration_s
+        if args.checkpoint_dir is None:
+            args.checkpoint_dir = cfg.model.checkpoint_dir
+        if args.native_checkpoint is None:
+            args.native_checkpoint = cfg.model.native_checkpoint
+    # unset sentinels → built-in defaults
+    if args.capacity is None:
+        args.capacity = 65536
+    if args.idle_timeout is None:
+        args.idle_timeout = 60
+    if args.print_every is None:
+        args.print_every = 10
+    if args.duration is None:
+        args.duration = 15 * 60
+    if args.checkpoint_dir is None:
+        args.checkpoint_dir = _DEFAULT_CKPT_DIR
+
     if args.subcommand == "train":
         _run_train(args)
+    elif args.subcommand == "retrain":
+        _run_retrain(args)
     else:
         _run_classify(args)
 

@@ -1,0 +1,168 @@
+"""Native checkpoint format: versioned orbax directories for every model
+family and for resumable training state.
+
+The reference's only persistence is ``pickle.dump`` of sklearn estimators
+(notebooks, e.g. ``3_RandomForest.ipynb`` cell 19) loaded by an if-chain at
+traffic_classifier.py:229-244 — unversioned, Python-only, and tied to the
+exact sklearn build (its own pickles no longer load in modern sklearn,
+SURVEY.md §2.2). This module replaces that with:
+
+- ``save_model`` / ``load_model``: any of the six model-family Params
+  pytrees → an orbax checkpoint directory plus a JSON manifest carrying the
+  format version, model family, class names, and the non-array static
+  fields (which are jit-static and must round-trip exactly);
+- ``save_train_state`` / ``restore_train_state``: mid-training state
+  (params + optimizer state + step) for crash-resume of the streaming
+  trainers — the resume-in-training the reference lacks (SURVEY.md §5);
+- importers compose: ``load_reference_model`` (sklearn pickle) → ``fit`` →
+  ``save_model`` gives a pickle-free, forward-compatible artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+_MANIFEST = "manifest.json"
+_ARRAYS = "arrays"
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def _field_names(params) -> list[str]:
+    if dataclasses.is_dataclass(params):
+        return [f.name for f in dataclasses.fields(params)]
+    if hasattr(params, "_fields"):  # NamedTuple (models/kmeans.Params)
+        return list(params._fields)
+    raise TypeError(f"unsupported params type {type(params)!r}")
+
+
+def _split_fields(params) -> tuple[dict, dict]:
+    """Partition params fields into (arrays, static python values)."""
+    arrays, static = {}, {}
+    for name in _field_names(params):
+        v = getattr(params, name)
+        if isinstance(v, (jax.Array, np.ndarray)):
+            arrays[name] = np.asarray(v)
+        else:
+            static[name] = v
+    return arrays, static
+
+
+def save_model(path: str, name: str, params, classes=None) -> None:
+    """Write a versioned model checkpoint directory.
+
+    ``name`` is a MODEL_MODULES key (logreg/gnb/kmeans/knn/svc/forest);
+    ``classes`` an optional sequence of label names stored for decode.
+    """
+    from ..models import MODEL_MODULES
+
+    if name not in MODEL_MODULES:
+        raise ValueError(f"unknown model family {name!r}")
+    arrays, static = _split_fields(params)
+    os.makedirs(path, exist_ok=True)
+    _checkpointer().save(
+        os.path.join(os.path.abspath(path), _ARRAYS), arrays, force=True
+    )
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "model": name,
+        "static": static,
+        "classes": list(classes) if classes is not None else None,
+        "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+    }
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def load_model(path: str):
+    """Read a checkpoint directory → models.LoadedModel."""
+    from ..models import MODEL_MODULES, LoadedModel
+    from ..models.base import ClassList
+
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest["format_version"] > FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint {path} has format_version "
+            f"{manifest['format_version']} > supported {FORMAT_VERSION}"
+        )
+    name = manifest["model"]
+    mod = MODEL_MODULES[name]
+    raw = _checkpointer().restore(
+        os.path.join(os.path.abspath(path), _ARRAYS)
+    )
+    arrays = {
+        k: jnp.asarray(v, dtype=manifest["dtypes"][k])
+        for k, v in raw.items()
+    }
+    params = mod.Params(**arrays, **manifest["static"])
+    classes = (
+        ClassList(tuple(manifest["classes"]))
+        if manifest["classes"]
+        else None
+    )
+    return LoadedModel(
+        name=name,
+        params=params,
+        classes=classes,
+        predict=mod.predict,
+        scores=mod.scores,
+    )
+
+
+def save_train_state(path: str, state: Any, step: int) -> None:
+    """Persist an arbitrary training-state pytree (e.g. train.logreg
+    SGDState) + step counter for resume."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    os.makedirs(path, exist_ok=True)
+    _checkpointer().save(
+        os.path.join(os.path.abspath(path), _ARRAYS), arrays, force=True
+    )
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump(
+            {
+                "format_version": FORMAT_VERSION,
+                "kind": "train_state",
+                "step": int(step),
+                "n_leaves": len(leaves),
+                "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+            },
+            f,
+        )
+
+
+def restore_train_state(path: str, template: Any) -> tuple[Any, int]:
+    """Restore a training-state pytree into ``template``'s structure.
+
+    ``template`` is a freshly initialized state (same shapes/treedef) —
+    the standard orbax restore-with-target pattern.
+    """
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    raw = _checkpointer().restore(
+        os.path.join(os.path.abspath(path), _ARRAYS)
+    )
+    leaves_t, treedef = jax.tree_util.tree_flatten(template)
+    if len(leaves_t) != manifest["n_leaves"]:
+        raise ValueError(
+            f"template has {len(leaves_t)} leaves, checkpoint "
+            f"{manifest['n_leaves']}"
+        )
+    leaves = [
+        jnp.asarray(raw[f"leaf_{i}"], dtype=manifest["dtypes"][f"leaf_{i}"])
+        for i in range(len(leaves_t))
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves), manifest["step"]
