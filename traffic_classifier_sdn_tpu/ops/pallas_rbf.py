@@ -1,0 +1,160 @@
+"""Fused Pallas TPU kernel for RBF-SVC decision evaluation.
+
+The XLA path (models/svc.py) materializes the (N, S) kernel matrix in HBM
+before the vote matmul — ~9 GB of traffic for a million-flow batch against
+the reference's 2281 support vectors (SURVEY.md §7 hard part b). This
+kernel fuses distance, exponential, and vote-projection per grid step so
+the kernel matrix never leaves VMEM:
+
+    d²   = Σ_f ((x_f − s_f) + (xlo_f − slo_f))²   (VPU, two-float exact)
+    K    = exp(−γ·d²)                              (VPU)
+    acc += K @ coef_chunk                          (MXU, f32)
+
+per (row-tile × SV-chunk) grid step; the (TILE, P) output block stays
+resident and accumulates over SV chunks. The two-float difference form is
+the same parity trick as models/svc.py: raw features reach ~8e8, where the
+dot-product expansion of d² cancels catastrophically in f32.
+
+HBM traffic collapses to: read X once, stream the (F, S) support vectors +
+(S, P) coefficients per row tile (~150 KB for the reference checkpoint),
+write (N, P) decisions once.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models import svc
+
+
+class SvcPallas(struct.PyTreeNode):
+    sv_t_hi: jax.Array  # (F, Sp) support vectors, transposed, hi part
+    sv_t_lo: jax.Array  # (F, Sp) two-float residual
+    coef_t: jax.Array  # (Sp, P) dense ovo coefficients, transposed
+    intercept: jax.Array  # (P,)
+    vote_i: jax.Array  # (P,) int32
+    vote_j: jax.Array  # (P,) int32
+    gamma: jax.Array  # (1, 1) f32 (SMEM scalar)
+    n_classes: int = struct.field(pytree_node=False)
+    row_tile: int = struct.field(pytree_node=False)
+    sv_chunk: int = struct.field(pytree_node=False)
+
+
+def compile_svc(
+    params: svc.Params, row_tile: int = 512, sv_chunk: int = 1024
+) -> SvcPallas:
+    """Re-lay a models/svc.Params for the fused kernel: SVs transposed to
+    (F, S) so per-feature rows broadcast along lanes, S padded to the chunk
+    size with zero-coefficient sentinels (their K contribution is killed by
+    the zero coefficient, so no ±inf bookkeeping is needed)."""
+    sv_hi = np.asarray(params.sv_hi, np.float32)
+    sv_lo = np.asarray(params.sv_lo, np.float32)
+    coef = np.asarray(params.pair_coef, np.float32)  # (P, S)
+    S = sv_hi.shape[0]
+    pad = (-S) % sv_chunk
+    if pad:
+        sv_hi = np.concatenate([sv_hi, np.zeros((pad, sv_hi.shape[1]), np.float32)])
+        sv_lo = np.concatenate([sv_lo, np.zeros((pad, sv_lo.shape[1]), np.float32)])
+        coef = np.concatenate([coef, np.zeros((coef.shape[0], pad), np.float32)], axis=1)
+    return SvcPallas(
+        sv_t_hi=jnp.asarray(sv_hi.T),
+        sv_t_lo=jnp.asarray(sv_lo.T),
+        coef_t=jnp.asarray(coef.T),
+        intercept=params.intercept,
+        vote_i=params.vote_i,
+        vote_j=params.vote_j,
+        gamma=jnp.reshape(params.gamma.astype(jnp.float32), (1, 1)),
+        n_classes=params.n_classes,
+        row_tile=row_tile,
+        sv_chunk=sv_chunk,
+    )
+
+
+def _kernel(gamma_ref, x_ref, xlo_ref, svt_ref, svtlo_ref, coef_ref, out_ref,
+            *, n_features: int):
+    s = pl.program_id(1)
+    g = gamma_ref[0, 0]
+    d2 = jnp.zeros((x_ref.shape[0], svt_ref.shape[1]), jnp.float32)
+    for f in range(n_features):  # static unroll: F outer-product adds
+        diff = (x_ref[:, f : f + 1] - svt_ref[f : f + 1, :]) + (
+            xlo_ref[:, f : f + 1] - svtlo_ref[f : f + 1, :]
+        )
+        d2 = d2 + diff * diff
+    K = jnp.exp(-g * d2)  # (TILE, SC)
+    # precision=HIGHEST: the MXU's default f32 matmul is bf16-like, and
+    # ovo margins go down to ~0.04 (models/svc.py numerical notes)
+    acc = jnp.dot(
+        K,
+        coef_ref[:],
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+    @pl.when(s == 0)
+    def _():
+        out_ref[:] = acc
+
+    @pl.when(s > 0)
+    def _():
+        out_ref[:] = out_ref[:] + acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decision_ovo_pallas(
+    g: SvcPallas, X: jax.Array, X_lo=None, interpret: bool = False
+) -> jax.Array:
+    """Per-pair ovo decision values, (N, P) — fused kernel version of
+    models/svc.decision_ovo."""
+    N, F = X.shape
+    TILE, SC = g.row_tile, g.sv_chunk
+    Sp = g.sv_t_hi.shape[1]
+    P = g.coef_t.shape[1]
+    if X_lo is None:
+        X_lo = jnp.zeros_like(X)
+
+    padded = (-N) % TILE
+    if padded:
+        X = jnp.concatenate([X, jnp.zeros((padded, F), X.dtype)])
+        X_lo = jnp.concatenate([X_lo, jnp.zeros((padded, F), X_lo.dtype)])
+    n_tiles = X.shape[0] // TILE
+    n_chunks = Sp // SC
+
+    kernel = functools.partial(_kernel, n_features=F)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_tiles, n_chunks),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # gamma (1,1)
+            pl.BlockSpec((TILE, F), lambda i, s: (i, 0)),
+            pl.BlockSpec((TILE, F), lambda i, s: (i, 0)),
+            pl.BlockSpec((F, SC), lambda i, s: (0, s)),
+            pl.BlockSpec((F, SC), lambda i, s: (0, s)),
+            pl.BlockSpec((SC, P), lambda i, s: (s, 0)),
+        ],
+        out_specs=pl.BlockSpec((TILE, P), lambda i, s: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((X.shape[0], P), jnp.float32),
+        interpret=interpret,
+    )(g.gamma, X, X_lo, g.sv_t_hi, g.sv_t_lo, g.coef_t)
+    return out[:N] + g.intercept[None, :]
+
+
+def scores(g: SvcPallas, X, X_lo=None, interpret: bool = False) -> jax.Array:
+    """Vote counts per class, (N, C) — same ovo aggregation as models/svc."""
+    D = decision_ovo_pallas(g, X, X_lo, interpret=interpret)
+    pos = D > 0
+    votes_i = jax.nn.one_hot(g.vote_i, g.n_classes, dtype=D.dtype)
+    votes_j = jax.nn.one_hot(g.vote_j, g.n_classes, dtype=D.dtype)
+    return jnp.where(pos[:, :, None], votes_i, votes_j).sum(axis=1)
+
+
+def predict(g: SvcPallas, X, X_lo=None, interpret: bool = False) -> jax.Array:
+    return jnp.argmax(scores(g, X, X_lo, interpret=interpret), axis=-1).astype(
+        jnp.int32
+    )
