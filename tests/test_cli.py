@@ -130,3 +130,30 @@ def test_live_subprocess_fake_monitor(capsys, reference_models_dir):
     )
     out = capsys.readouterr().out
     assert "Flow ID" in out
+
+
+def test_live_subprocess_native_ingest(capsys, reference_models_dir):
+    """Same live path but with the C++ engine: raw pipe chunks go straight
+    to native ingest (no per-line Python between the pipe and the device
+    scatter)."""
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
+
+    if not native_engine.available():
+        pytest.skip("g++ unavailable")
+    cmd = f"{sys.executable} tools/fake_monitor.py 8 6 0.05"
+    cli.main(
+        [
+            "gaussiannb",
+            "--source", "ryu",
+            "--monitor-cmd", cmd,
+            "--checkpoint-dir", reference_models_dir,
+            "--capacity", "32",
+            "--print-every", "2",
+            "--max-ticks", "4",
+            "--native-ingest", "on",
+            "--idle-timeout", "60",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "Flow ID" in out
+    assert "00:00:00" in out  # slot metadata came back from C++

@@ -278,3 +278,31 @@ def test_synthetic_replay_roundtrip(tmp_path):
     e1 = _engine_run(ticks, capacity=32)
     e2 = _engine_run(replayed, capacity=32)
     np.testing.assert_array_equal(np.asarray(e1.features()), np.asarray(e2.features()))
+
+
+def test_ingest_bytes_python_fallback_buffers_partial_lines():
+    """The pure-Python ingest_bytes path must carry a trailing partial
+    line across chunks (same contract as the native engine's tail)."""
+    from traffic_classifier_sdn_tpu.ingest.protocol import (
+        TelemetryRecord,
+        format_line,
+    )
+
+    eng = FlowStateEngine(capacity=8, native=False)
+    r = TelemetryRecord(
+        time=2, datapath="1", in_port="1", eth_src="aa", eth_dst="bb",
+        out_port="2", packets=7, bytes=500000,
+    )
+    line = format_line(r)
+    # split mid-way through the byte counter: naive parsing would ingest
+    # a corrupted record (bytes=500) and drop the continuation
+    cut = len(line) - 4
+    n = eng.ingest_bytes(line[:cut])
+    assert n == 0
+    n = eng.ingest_bytes(line[cut:])
+    assert n == 1
+    eng.step()
+    import numpy as np
+    from traffic_classifier_sdn_tpu.core import flow_table as ft
+
+    assert np.asarray(ft.features16(eng.table))[0, 1] == 500000

@@ -113,11 +113,31 @@ def _build_parser() -> argparse.ArgumentParser:
         "--synthetic-flows", type=int, default=1024, help="synthetic source size"
     )
     p.add_argument("--out", default=None, help="training CSV path")
+    p.add_argument(
+        "--native-ingest",
+        choices=("auto", "on", "off"),
+        default="auto",
+        help="use the C++ ingest engine (native/flow_engine.cpp); auto "
+        "falls back to the pure-Python batcher if g++ is unavailable",
+    )
     return p
 
 
-def _tick_source(args):
-    """Yield lists of TelemetryRecords, one list per poll tick."""
+def _use_native(args) -> bool:
+    if args.native_ingest == "off":
+        return False
+    from .native import engine as native_engine
+
+    ok = native_engine.available()
+    if args.native_ingest == "on" and not ok:
+        sys.exit("ERROR: --native-ingest on, but the C++ engine won't build")
+    return ok
+
+
+def _tick_source(args, raw: bool = False):
+    """Yield one batch of telemetry per poll tick: a list of
+    TelemetryRecords, or raw pipe bytes when ``raw`` (the native-engine
+    fast path — no per-line Python anywhere between the pipe and C++)."""
     if args.source == "replay":
         if not args.capture:
             sys.exit("--source replay requires --capture FILE")
@@ -133,7 +153,9 @@ def _tick_source(args):
     else:
         from .ingest.collector import DEFAULT_MONITOR_CMD, SubprocessCollector
 
-        coll = SubprocessCollector(args.monitor_cmd or DEFAULT_MONITOR_CMD)
+        coll = SubprocessCollector(
+            args.monitor_cmd or DEFAULT_MONITOR_CMD, raw=raw
+        )
         coll.start()
         try:
             while True:
@@ -143,7 +165,11 @@ def _tick_source(args):
                         break  # monitor exited and the queue is drained
                     continue
                 time.sleep(0.05)  # let the 1 Hz burst of lines arrive
-                yield [first] + coll.poll_records()
+                rest = coll.poll_records()
+                if raw:
+                    yield first + b"".join(rest)
+                else:
+                    yield [first] + rest
         finally:
             coll.stop()
 
@@ -165,26 +191,29 @@ def _run_classify(args) -> None:
         model = load_reference_model(args.subcommand, ckpt)
     predict = jax.jit(model.predict)
 
-    engine = FlowStateEngine(args.capacity)
+    use_native = _use_native(args)
+    engine = FlowStateEngine(args.capacity, native=use_native)
     ticks = 0
     dropped_seen = 0
-    for records in _tick_source(args):
-        engine.ingest(records)
+    for batch in _tick_source(args, raw=use_native and args.source == "ryu"):
+        if isinstance(batch, bytes):
+            engine.ingest_bytes(batch)
+        else:
+            engine.ingest(batch)
         engine.step()
         ticks += 1
         if ticks % args.print_every == 0:
-            if args.idle_timeout and records:
-                now = max(r.time for r in records)
-                engine.evict_idle(now, args.idle_timeout)
-            if engine.batcher.dropped > dropped_seen:
+            if args.idle_timeout and engine.last_time:
+                engine.evict_idle(engine.last_time, args.idle_timeout)
+            if engine.dropped > dropped_seen:
                 print(
                     f"WARNING: flow table full — "
-                    f"{engine.batcher.dropped - dropped_seen} new flows "
+                    f"{engine.dropped - dropped_seen} new flows "
                     f"dropped since last report (capacity {args.capacity}, "
                     f"idle-timeout {args.idle_timeout}s)",
                     file=sys.stderr,
                 )
-                dropped_seen = engine.batcher.dropped
+                dropped_seen = engine.dropped
             _print_table(engine, model, predict, args)
         if args.max_ticks and ticks >= args.max_ticks:
             break
@@ -201,7 +230,7 @@ def _print_table(engine, model, predict, args) -> None:
     fwd_active = np.asarray(engine.table.fwd.active)[:-1]
     rev_active = np.asarray(engine.table.rev.active)[:-1]
     rows = []
-    for slot, (src, dst) in sorted(engine.index.slot_meta.items()):
+    for slot, (src, dst) in sorted(engine.slot_metadata().items()):
         rows.append(
             (
                 slot,
@@ -225,13 +254,18 @@ def _run_train(args) -> None:
     if not args.traffic_type:
         sys.exit("ERROR: specify traffic type.")  # reference :225
     out_path = args.out or f"{args.traffic_type}_training_data.csv"
-    engine = FlowStateEngine(args.capacity)
+    engine = FlowStateEngine(args.capacity, native=_use_native(args))
     deadline = time.time() + args.duration
     ticks = 0
     with open(out_path, "w") as f:
         f.write("\t".join(list(CSV_COLUMNS_16) + [LABEL_COLUMN]) + "\n")
-        for records in _tick_source(args):
-            engine.ingest(records)
+        for batch in _tick_source(
+            args, raw=engine.native and args.source == "ryu"
+        ):
+            if isinstance(batch, bytes):
+                engine.ingest_bytes(batch)
+            else:
+                engine.ingest(batch)
             engine.step()
             ticks += 1
             X16 = np.asarray(features16(engine.table))

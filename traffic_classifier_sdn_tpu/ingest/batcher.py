@@ -188,10 +188,20 @@ class FlowStateEngine:
     and keeps all state device-resident.
     """
 
-    def __init__(self, capacity: int, buckets=DEFAULT_BUCKETS):
+    def __init__(self, capacity: int, buckets=DEFAULT_BUCKETS,
+                 native: bool = False):
         self.table = ft.make_table(capacity)
-        self.index = FlowIndex(capacity)
-        self.batcher = Batcher(self.index, buckets)
+        self.native = native
+        if native:
+            from ..native.engine import NativeBatcher
+
+            self.index = None
+            self.batcher = NativeBatcher(capacity, buckets)
+        else:
+            self.index = FlowIndex(capacity)
+            self.batcher = Batcher(self.index, buckets)
+        self._tail = b""  # partial line carried across ingest_bytes calls
+        self._last_time = 0
 
     def ingest(self, records: Iterable[TelemetryRecord]) -> int:
         n = 0
@@ -201,8 +211,54 @@ class FlowStateEngine:
                 # then retry — keeps per-line sequential semantics exact
                 self.step()
                 self.batcher.add(r)
+            if r.time > self._last_time:
+                self._last_time = r.time
             n += 1
         return n
+
+    @property
+    def last_time(self) -> int:
+        """Max telemetry timestamp ingested — the idle-eviction clock."""
+        if self.native:
+            return max(self._last_time, self.batcher.last_time)
+        return self._last_time
+
+    def ingest_bytes(self, data: bytes) -> int:
+        """Bulk raw-byte ingest (monitor pipe chunks). On the native path
+        this never crosses into Python per line; the fallback parses with
+        protocol.parse_line. Returns records parsed."""
+        if self.native:
+            return self.batcher.feed(data)
+        from .protocol import parse_line
+
+        data = self._tail + data
+        # split on \n only (not universal newlines) — same framing as the
+        # native engine; the final element is the partial-line tail
+        parts = data.split(b"\n")
+        self._tail = parts.pop()
+        n = 0
+        for line in parts:
+            r = parse_line(line + b"\n")
+            if r is not None:
+                self.ingest([r])
+                n += 1
+        return n
+
+    @property
+    def dropped(self) -> int:
+        return self.batcher.dropped
+
+    def slot_metadata(self) -> dict:
+        """slot → (eth_src, eth_dst) for all in-use slots (UI table)."""
+        if not self.native:
+            return dict(self.index.slot_meta)
+        out = {}
+        in_use = np.asarray(self.table.in_use)[:-1]
+        for s in np.nonzero(in_use)[0]:
+            meta = self.batcher.slot_meta(int(s))
+            if meta is not None:
+                out[int(s)] = meta
+        return out
 
     def step(self) -> bool:
         """Flush all pending records into the device table; False if idle.
@@ -234,12 +290,14 @@ class FlowStateEngine:
         stale = in_use & (now - last >= idle_seconds)
         slots = np.nonzero(stale)[0]
         step = self.batcher.buckets[-1]
+        capacity = self.table.capacity
         for i in range(0, slots.size, step):
             chunk = slots[i : i + step]
             size = bucket_size(chunk.size, self.batcher.buckets)
-            padded = np.full(size, self.index.capacity, np.int32)
+            padded = np.full(size, capacity, np.int32)
             padded[: chunk.size] = chunk
             self.table = ft.clear_slots(self.table, padded)
+        release = (self.batcher if self.native else self.index).release_slot
         for s in slots:
-            self.index.release_slot(int(s))
+            release(int(s))
         return int(slots.size)

@@ -31,8 +31,13 @@ DEFAULT_MONITOR_CMD = "sudo ryu run simple_monitor_13.py"
 class SubprocessCollector:
     """Spawn a monitor command and iterate parsed records."""
 
-    def __init__(self, cmd: str = DEFAULT_MONITOR_CMD, queue_size: int = 1 << 16):
+    def __init__(self, cmd: str = DEFAULT_MONITOR_CMD, queue_size: int = 1 << 16,
+                 raw: bool = False):
+        """``raw=True`` queues raw pipe chunks (bytes) instead of parsed
+        TelemetryRecords — the zero-Python-per-line path for the native
+        C++ engine (FlowStateEngine.ingest_bytes)."""
         self.cmd = cmd
+        self.raw = raw
         self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
         self._proc: subprocess.Popen | None = None
         self._thread: threading.Thread | None = None
@@ -51,6 +56,25 @@ class SubprocessCollector:
 
     def _reader(self) -> None:
         assert self._proc is not None and self._proc.stdout is not None
+        if self.raw:
+            stream = self._proc.stdout
+            drop_seam = False
+            while True:
+                chunk = stream.read1(1 << 16)
+                if not chunk:
+                    break
+                if drop_seam:
+                    # a dropped chunk broke line framing: force a break so
+                    # the fragments on either side of the gap can't splice
+                    # into one corrupted-but-parseable record
+                    chunk = b"\n" + chunk
+                try:
+                    self._queue.put_nowait(chunk)
+                    drop_seam = False
+                except queue.Full:
+                    self.lines_dropped += chunk.count(b"\n")
+                    drop_seam = True
+            return
         for line in self._proc.stdout:
             r = parse_line(line)
             if r is None:

@@ -1,0 +1,3 @@
+"""Native (C++) host-runtime components. See engine.py."""
+
+from .engine import NativeBatcher, available  # noqa: F401

@@ -1,0 +1,229 @@
+"""ctypes bindings for the native (C++) ingest engine.
+
+``NativeBatcher`` is a drop-in for the pure-Python ``FlowIndex`` +
+``Batcher`` pair in ingest/batcher.py: raw monitor bytes in, padded
+``flow_table.UpdateBatch`` out. The Python pair remains the behavioral
+oracle (tests/test_native_engine.py asserts record-for-record parity);
+this path exists because line splitting + dict routing is the host-side
+hot loop once the counter math lives on device (SURVEY.md §2.3 — the
+reference's equivalent work runs in eventlet/CPython, one line at a time).
+
+The shared library is built lazily with g++ on first use (no pybind11 in
+this image; plain C ABI + ctypes). ``available()`` reports whether a
+build is possible so callers can gate to the Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import subprocess
+import threading
+from typing import Iterable
+
+import numpy as np
+
+from ..core import flow_table as ft
+from ..ingest.protocol import TelemetryRecord, format_line
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "flow_engine.cpp")
+_LIB = os.path.join(_DIR, "_flow_engine.so")
+_lock = threading.Lock()
+_lib = None
+_build_error: str | None = None
+
+
+def _build() -> None:
+    # Compile to a temp path and rename into place: atomic, so concurrent
+    # processes never dlopen a half-written .so.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise RuntimeError(_build_error)
+        try:
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ct.CDLL(_LIB)
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _build_error = f"native flow engine unavailable: {detail}"
+            raise RuntimeError(_build_error) from e
+        lib.tc_engine_create.restype = ct.c_void_p
+        lib.tc_engine_create.argtypes = [ct.c_uint32, ct.c_uint32]
+        lib.tc_engine_destroy.argtypes = [ct.c_void_p]
+        lib.tc_engine_feed.restype = ct.c_uint64
+        lib.tc_engine_feed.argtypes = [ct.c_void_p, ct.c_char_p, ct.c_uint64]
+        lib.tc_engine_pending.restype = ct.c_uint64
+        lib.tc_engine_pending.argtypes = [ct.c_void_p]
+        lib.tc_engine_flush.restype = ct.c_uint32
+        lib.tc_engine_flush.argtypes = [ct.c_void_p] + [ct.c_void_p] * 8
+        lib.tc_engine_dropped.restype = ct.c_uint64
+        lib.tc_engine_dropped.argtypes = [ct.c_void_p]
+        lib.tc_engine_parsed.restype = ct.c_uint64
+        lib.tc_engine_parsed.argtypes = [ct.c_void_p]
+        lib.tc_engine_last_time.restype = ct.c_int32
+        lib.tc_engine_last_time.argtypes = [ct.c_void_p]
+        lib.tc_engine_num_flows.restype = ct.c_uint32
+        lib.tc_engine_num_flows.argtypes = [ct.c_void_p]
+        lib.tc_engine_slot_meta.restype = ct.c_int
+        lib.tc_engine_slot_meta.argtypes = [
+            ct.c_void_p, ct.c_uint32, ct.c_char_p, ct.c_char_p, ct.c_uint32,
+        ]
+        lib.tc_engine_release_slot.argtypes = [ct.c_void_p, ct.c_uint32]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    """True when the native engine can be built/loaded on this host."""
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ct.c_void_p)
+
+
+class NativeBatcher:
+    """Raw telemetry bytes → padded UpdateBatch, all routing in C++.
+
+    API-compatible with the batcher.FlowIndex + batcher.Batcher pair where
+    FlowStateEngine touches them (add/flush/dropped/release_slot/slot_meta),
+    plus a bulk ``feed(bytes)`` fast path the Python pair doesn't have.
+    """
+
+    def __init__(self, capacity: int, buckets=None):
+        from ..ingest.batcher import DEFAULT_BUCKETS
+
+        if buckets is None:
+            buckets = DEFAULT_BUCKETS
+        lib = _load()
+        self._lib = lib
+        self.capacity = capacity
+        self.buckets = tuple(buckets)
+        self._max = self.buckets[-1]
+        self._h = lib.tc_engine_create(capacity, self._max)
+        if not self._h:
+            raise RuntimeError("tc_engine_create failed")
+        # Reused flush staging buffers (C fills the first n rows; the
+        # padded tail is re-zeroed per flush below).
+        m = self._max
+        self._slot = np.empty(m, np.int32)
+        self._time = np.empty(m, np.int32)
+        self._pkts_lo = np.empty(m, np.uint32)
+        self._pkts_f = np.empty(m, np.float32)
+        self._bytes_lo = np.empty(m, np.uint32)
+        self._bytes_f = np.empty(m, np.float32)
+        self._is_fwd = np.empty(m, np.uint8)
+        self._is_create = np.empty(m, np.uint8)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.tc_engine_destroy(h)
+            self._h = None
+
+    # -- ingest ------------------------------------------------------------
+    def feed(self, data: bytes) -> int:
+        """Bulk byte ingest (the fast path). Returns records parsed."""
+        return int(self._lib.tc_engine_feed(self._h, data, len(data)))
+
+    def add(self, r: TelemetryRecord) -> bool:
+        """Record-object compatibility shim (tests, mixed pipelines)."""
+        self.feed(format_line(r))
+        return True
+
+    def __len__(self) -> int:
+        return int(self._lib.tc_engine_pending(self._h))
+
+    # -- flush -------------------------------------------------------------
+    def flush(self) -> ft.UpdateBatch | None:
+        """Pop the oldest pending generation as a padded UpdateBatch
+        (None when idle) — same contract as batcher.Batcher.flush."""
+        n = int(
+            self._lib.tc_engine_flush(
+                self._h, _ptr(self._slot), _ptr(self._time),
+                _ptr(self._pkts_lo), _ptr(self._pkts_f),
+                _ptr(self._bytes_lo), _ptr(self._bytes_f),
+                _ptr(self._is_fwd), _ptr(self._is_create),
+            )
+        )
+        if n == 0:
+            return None
+        size = next(b for b in self.buckets if n <= b)
+        slot = np.full(size, self.capacity, np.int32)  # scratch-row padding
+        slot[:n] = self._slot[:n]
+        time = np.zeros(size, np.int32)
+        time[:n] = self._time[:n]
+        pkts_lo = np.zeros(size, np.uint32)
+        pkts_lo[:n] = self._pkts_lo[:n]
+        pkts_f = np.zeros(size, np.float32)
+        pkts_f[:n] = self._pkts_f[:n]
+        bytes_lo = np.zeros(size, np.uint32)
+        bytes_lo[:n] = self._bytes_lo[:n]
+        bytes_f = np.zeros(size, np.float32)
+        bytes_f[:n] = self._bytes_f[:n]
+        is_fwd = np.ones(size, bool)
+        is_fwd[:n] = self._is_fwd[:n].astype(bool)
+        is_create = np.zeros(size, bool)
+        is_create[:n] = self._is_create[:n].astype(bool)
+        return ft.UpdateBatch(
+            slot=slot, time=time, pkts_lo=pkts_lo, pkts_f=pkts_f,
+            bytes_lo=bytes_lo, bytes_f=bytes_f, is_fwd=is_fwd,
+            is_create=is_create,
+        )
+
+    # -- bookkeeping -------------------------------------------------------
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.tc_engine_dropped(self._h))
+
+    @property
+    def parsed(self) -> int:
+        return int(self._lib.tc_engine_parsed(self._h))
+
+    @property
+    def last_time(self) -> int:
+        """Max telemetry timestamp parsed — the idle-eviction clock."""
+        return int(self._lib.tc_engine_last_time(self._h))
+
+    def num_flows(self) -> int:
+        return int(self._lib.tc_engine_num_flows(self._h))
+
+    def slot_meta(self, slot: int) -> tuple[str, str] | None:
+        """(eth_src, eth_dst) for an in-use slot, for the UI table."""
+        src = ct.create_string_buffer(64)
+        dst = ct.create_string_buffer(64)
+        if self._lib.tc_engine_slot_meta(self._h, slot, src, dst, 64):
+            # errors="replace" is belt-and-braces: ingest_line rejects
+            # non-UTF-8 fields, so this should never trigger
+            return (
+                src.value.decode(errors="replace"),
+                dst.value.decode(errors="replace"),
+            )
+        return None
+
+    def release_slot(self, slot: int) -> None:
+        self._lib.tc_engine_release_slot(self._h, slot)

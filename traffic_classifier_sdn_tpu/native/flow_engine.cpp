@@ -1,0 +1,341 @@
+// Native host-side ingest engine: telemetry line parsing, flow indexing
+// with direction folding, and packed update-batch assembly.
+//
+// This is the C++ replacement for the host-bound half of the reference's
+// ingest loop (traffic_classifier.py:144-171): where the reference splits
+// strings and mutates per-flow Python objects one line at a time, this
+// engine consumes raw pipe bytes in bulk and emits packed arrays that the
+// JAX layer scatters into the device-resident flow table
+// (core/flow_table.py). All counter math stays on device; this code only
+// decides where each record goes (slot, direction, create flag) — the
+// same contract as ingest/batcher.py's FlowIndex + Batcher, which remain
+// as the pure-Python fallback and behavioral oracle.
+//
+// Semantics mirrored from the Python batcher (and ultimately from the
+// reference's key folding at traffic_classifier.py:157-165):
+//   - a record keys on (datapath, eth_src, eth_dst); if that key is new
+//     but the reversed key exists, the record is the reverse direction of
+//     the existing flow
+//   - per (slot, direction) a batch generation holds at most one create
+//     row and one update row; a second same-direction update starts a new
+//     generation, so flushing generations in order reproduces the
+//     reference's sequential per-line semantics exactly
+//   - table-full records are dropped and counted
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Row {
+  uint32_t slot;
+  int32_t time;
+  uint64_t pkts;
+  uint64_t bytes;
+  uint8_t is_fwd;
+  uint8_t is_create;
+};
+
+// One flush unit: rows plus the per-(slot,dir) occupancy needed to detect
+// the one-create-plus-one-update-per-direction limit.
+struct Generation {
+  std::vector<Row> rows;
+  // (slot << 1 | is_fwd) -> flags bit0=create present, bit1=update present
+  std::unordered_map<uint64_t, uint8_t> occ;
+};
+
+struct Engine {
+  uint32_t capacity;
+  uint32_t max_batch;
+  std::unordered_map<std::string, uint32_t> key_to_slot;
+  std::vector<std::string> slot_key;  // "" when free
+  std::vector<std::string> slot_src;
+  std::vector<std::string> slot_dst;
+  std::vector<uint32_t> free_slots;
+  uint32_t next_slot = 0;
+  uint64_t dropped = 0;
+  uint64_t parsed = 0;
+  int32_t last_time = 0;  // max telemetry timestamp seen (eviction clock)
+  std::deque<Generation> gens;
+  std::string tail;  // partial line carried across feed() calls
+
+  explicit Engine(uint32_t cap, uint32_t mb)
+      : capacity(cap), max_batch(mb), slot_key(cap), slot_src(cap),
+        slot_dst(cap) {}
+};
+
+// Python-int-compatible enough for the wire format: optional surrounding
+// spaces, optional sign, then digits. Returns false on anything else
+// (mirrors the parse_line() int() guard in ingest/protocol.py).
+bool parse_i64(const char* s, size_t len, int64_t* out) {
+  size_t i = 0, j = len;
+  while (i < j && (s[i] == ' ' || s[i] == '\r')) i++;
+  while (j > i && (s[j - 1] == ' ' || s[j - 1] == '\r')) j--;
+  if (i >= j) return false;
+  bool neg = false;
+  if (s[i] == '+' || s[i] == '-') {
+    neg = s[i] == '-';
+    i++;
+  }
+  if (i >= j) return false;
+  int64_t v = 0;
+  for (; i < j; i++) {
+    if (s[i] < '0' || s[i] > '9') return false;
+    v = v * 10 + (s[i] - '0');
+  }
+  *out = neg ? -v : v;
+  return true;
+}
+
+// Strict UTF-8 validity — the Python oracle's parse_line rejects lines
+// whose string fields fail .decode() (ingest/protocol.py), so we must too
+// or slot metadata could carry bytes Python can't decode.
+bool utf8_valid(const char* s, size_t len) {
+  size_t i = 0;
+  while (i < len) {
+    unsigned char c = s[i];
+    size_t n;
+    if (c < 0x80) n = 0;
+    else if ((c & 0xE0) == 0xC0) n = 1;
+    else if ((c & 0xF0) == 0xE0) n = 2;
+    else if ((c & 0xF8) == 0xF0) n = 3;
+    else return false;
+    if (i + n >= len) return false;  // truncated sequence
+    for (size_t k = 1; k <= n; k++) {
+      if ((static_cast<unsigned char>(s[i + k]) & 0xC0) != 0x80) return false;
+    }
+    // reject overlong/surrogate/out-of-range forms
+    if (n == 1 && c < 0xC2) return false;
+    if (n == 2 && c == 0xE0 && static_cast<unsigned char>(s[i + 1]) < 0xA0)
+      return false;
+    if (n == 2 && c == 0xED && static_cast<unsigned char>(s[i + 1]) >= 0xA0)
+      return false;
+    if (n == 3 && c == 0xF0 && static_cast<unsigned char>(s[i + 1]) < 0x90)
+      return false;
+    if (n == 3 && (c > 0xF4 ||
+                   (c == 0xF4 && static_cast<unsigned char>(s[i + 1]) > 0x8F)))
+      return false;
+    i += n + 1;
+  }
+  return true;
+}
+
+std::string make_key(const char* dp, size_t dpl, const char* src, size_t sl,
+                     const char* dst, size_t dl) {
+  // \x00 separators, same anti-ambiguity rule as protocol.stable_flow_key.
+  std::string k;
+  k.reserve(dpl + sl + dl + 2);
+  k.append(dp, dpl);
+  k.push_back('\0');
+  k.append(src, sl);
+  k.push_back('\0');
+  k.append(dst, dl);
+  return k;
+}
+
+Generation& current_gen(Engine* e) {
+  if (e->gens.empty()) e->gens.emplace_back();
+  return e->gens.back();
+}
+
+void push_row(Engine* e, uint32_t slot, uint8_t is_fwd, uint8_t is_create,
+              int32_t time, uint64_t pkts, uint64_t bytes) {
+  uint64_t k = (static_cast<uint64_t>(slot) << 1) | is_fwd;
+  uint8_t bit = is_create ? 1 : 2;
+  Generation* g = &current_gen(e);
+  uint8_t* occ = &g->occ[k];
+  if ((*occ & bit) || g->rows.size() >= e->max_batch) {
+    e->gens.emplace_back();
+    g = &e->gens.back();
+    occ = &g->occ[k];
+  }
+  *occ |= bit;
+  g->rows.push_back(Row{slot, time, pkts, bytes, is_fwd, is_create});
+}
+
+// Route one parsed record (the FlowIndex.assign logic).
+void route(Engine* e, const char* dp, size_t dpl, const char* src, size_t sl,
+           const char* dst, size_t dl, int32_t time, uint64_t pkts,
+           uint64_t bytes) {
+  std::string key = make_key(dp, dpl, src, sl, dst, dl);
+  auto it = e->key_to_slot.find(key);
+  if (it != e->key_to_slot.end()) {
+    push_row(e, it->second, 1, 0, time, pkts, bytes);
+    return;
+  }
+  std::string rkey = make_key(dp, dpl, dst, dl, src, sl);
+  it = e->key_to_slot.find(rkey);
+  if (it != e->key_to_slot.end()) {
+    push_row(e, it->second, 0, 0, time, pkts, bytes);
+    return;
+  }
+  uint32_t slot;
+  if (!e->free_slots.empty()) {
+    slot = e->free_slots.back();
+    e->free_slots.pop_back();
+  } else if (e->next_slot < e->capacity) {
+    slot = e->next_slot++;
+  } else {
+    e->dropped++;
+    return;
+  }
+  e->key_to_slot.emplace(key, slot);
+  e->slot_key[slot] = std::move(key);
+  e->slot_src[slot].assign(src, sl);
+  e->slot_dst[slot].assign(dst, dl);
+  push_row(e, slot, 1, 1, time, pkts, bytes);
+}
+
+// Parse one complete line (no trailing \n). Returns true if it was a
+// telemetry record (counted), false for headers / controller logs.
+bool ingest_line(Engine* e, const char* line, size_t len) {
+  // prefix match, like the reference's line.startswith('data')
+  // (traffic_classifier.py:152)
+  if (len < 4 || std::memcmp(line, "data", 4) != 0) return false;
+  // split on \t, drop field 0, need >= 8 remaining
+  const char* f[16];
+  size_t fl[16];
+  int nf = 0;
+  size_t start = 0;
+  for (size_t i = 0; i <= len && nf < 16; i++) {
+    if (i == len || line[i] == '\t') {
+      f[nf] = line + start;
+      fl[nf] = i - start;
+      nf++;
+      start = i + 1;
+    }
+  }
+  if (nf < 9) return false;
+  int64_t time, pkts, bytes;
+  if (!parse_i64(f[1], fl[1], &time)) return false;
+  if (!parse_i64(f[7], fl[7], &pkts)) return false;
+  if (!parse_i64(f[8], fl[8], &bytes)) return false;
+  // the Python oracle decodes datapath/ports/MACs as UTF-8 and rejects
+  // the line on failure; match it (fields 2..6 are the string fields)
+  for (int k = 2; k <= 6; k++) {
+    if (!utf8_valid(f[k], fl[k])) return false;
+  }
+  // f[2]=datapath f[4]=eth_src f[5]=eth_dst (f[3]=in_port f[6]=out_port
+  // are carried by the wire format but unused for keying, same as the
+  // reference)
+  route(e, f[2], fl[2], f[4], fl[4], f[5], fl[5],
+        static_cast<int32_t>(time), static_cast<uint64_t>(pkts),
+        static_cast<uint64_t>(bytes));
+  e->parsed++;
+  if (static_cast<int32_t>(time) > e->last_time)
+    e->last_time = static_cast<int32_t>(time);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tc_engine_create(uint32_t capacity, uint32_t max_batch) {
+  if (capacity == 0 || max_batch == 0) return nullptr;
+  return new Engine(capacity, max_batch);
+}
+
+void tc_engine_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+// Feed raw bytes in arbitrary chunks (partial lines are carried over).
+// Returns the number of telemetry records parsed from this chunk.
+uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len) {
+  Engine* e = static_cast<Engine*>(h);
+  uint64_t before = e->parsed;
+  size_t start = 0;
+  for (size_t i = 0; i < len; i++) {
+    if (buf[i] != '\n') continue;
+    if (e->tail.empty()) {
+      ingest_line(e, buf + start, i - start);
+    } else {
+      e->tail.append(buf + start, i - start);
+      ingest_line(e, e->tail.data(), e->tail.size());
+      e->tail.clear();
+    }
+    start = i + 1;
+  }
+  if (start < len) e->tail.append(buf + start, len - start);
+  return e->parsed - before;
+}
+
+uint64_t tc_engine_pending(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  uint64_t n = 0;
+  for (const auto& g : e->gens) n += g.rows.size();
+  return n;
+}
+
+// Pop the oldest generation into caller-provided arrays (each sized >=
+// max_batch). Returns the row count, 0 when nothing is pending. pkts/bytes
+// are split into low-32-bits + float32 lanes, matching the device table's
+// uint32+f32 counter representation (core/flow_table.py).
+uint32_t tc_engine_flush(void* h, int32_t* slot, int32_t* time,
+                         uint32_t* pkts_lo, float* pkts_f, uint32_t* bytes_lo,
+                         float* bytes_f, uint8_t* is_fwd, uint8_t* is_create) {
+  Engine* e = static_cast<Engine*>(h);
+  while (!e->gens.empty() && e->gens.front().rows.empty()) {
+    e->gens.pop_front();
+  }
+  if (e->gens.empty()) return 0;
+  const Generation& g = e->gens.front();
+  uint32_t n = static_cast<uint32_t>(g.rows.size());
+  for (uint32_t i = 0; i < n; i++) {
+    const Row& r = g.rows[i];
+    slot[i] = static_cast<int32_t>(r.slot);
+    time[i] = r.time;
+    pkts_lo[i] = static_cast<uint32_t>(r.pkts & 0xFFFFFFFFu);
+    pkts_f[i] = static_cast<float>(r.pkts);
+    bytes_lo[i] = static_cast<uint32_t>(r.bytes & 0xFFFFFFFFu);
+    bytes_f[i] = static_cast<float>(r.bytes);
+    is_fwd[i] = r.is_fwd;
+    is_create[i] = r.is_create;
+  }
+  e->gens.pop_front();
+  return n;
+}
+
+uint64_t tc_engine_dropped(void* h) { return static_cast<Engine*>(h)->dropped; }
+uint64_t tc_engine_parsed(void* h) { return static_cast<Engine*>(h)->parsed; }
+int32_t tc_engine_last_time(void* h) {
+  return static_cast<Engine*>(h)->last_time;
+}
+
+uint32_t tc_engine_num_flows(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  return static_cast<uint32_t>(e->key_to_slot.size());
+}
+
+// Copy the (src, dst) MAC strings for a slot into caller buffers of size
+// cap (NUL-terminated, truncated if needed). Returns 1 if the slot is in
+// use, 0 otherwise.
+int tc_engine_slot_meta(void* h, uint32_t slot, char* src_out, char* dst_out,
+                        uint32_t cap) {
+  Engine* e = static_cast<Engine*>(h);
+  if (slot >= e->capacity || e->slot_key[slot].empty() || cap == 0) return 0;
+  std::snprintf(src_out, cap, "%s", e->slot_src[slot].c_str());
+  std::snprintf(dst_out, cap, "%s", e->slot_dst[slot].c_str());
+  return 1;
+}
+
+// Free a slot (idle eviction). The caller must drain flush() first so no
+// pending row can scatter into a reassigned slot — same contract as
+// FlowStateEngine.evict_idle.
+void tc_engine_release_slot(void* h, uint32_t slot) {
+  Engine* e = static_cast<Engine*>(h);
+  if (slot >= e->capacity || e->slot_key[slot].empty()) return;
+  e->key_to_slot.erase(e->slot_key[slot]);
+  e->slot_key[slot].clear();
+  e->slot_src[slot].clear();
+  e->slot_dst[slot].clear();
+  e->free_slots.push_back(slot);
+}
+
+}  // extern "C"
