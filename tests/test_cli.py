@@ -157,3 +157,41 @@ def test_live_subprocess_native_ingest(capsys, reference_models_dir):
     out = capsys.readouterr().out
     assert "Flow ID" in out
     assert "00:00:00" in out  # slot metadata came back from C++
+
+
+def test_e2e_own_controller_fake_switch(capsys, reference_models_dir):
+    """Full three-process pipeline with zero external SDN stack:
+    classifier (here) ← pipe ← our OpenFlow controller ← TCP ← fake
+    switch. The reference needs Mininet + OVS + Ryu for this path."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    switch = subprocess.Popen(
+        [sys.executable, "tools/fake_switch.py", "--port", str(port),
+         "--hosts", "4", "--duration", "30"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        cli.main(
+            [
+                "Randomforest",
+                "--source", "controller",
+                "--of-port", str(port),
+                "--monitor-cmd",
+                f"{sys.executable} -m traffic_classifier_sdn_tpu.controller "
+                f"--host 127.0.0.1 --port {port} --poll 0.1",
+                "--checkpoint-dir", reference_models_dir,
+                "--capacity", "32",
+                "--print-every", "2",
+                "--max-ticks", "4",
+            ]
+        )
+    finally:
+        switch.terminate()
+        switch.wait(timeout=10)
+    out = capsys.readouterr().out
+    assert "Flow ID" in out
+    assert "00:00:00:00:00:01" in out  # learned MAC made it to the table

@@ -1,0 +1,219 @@
+"""Tests for the standalone OpenFlow 1.3 controller (controller/) — wire
+format round-trips, learning-switch behavior, and the full controller ↔
+fake-switch ↔ telemetry ↔ ingest pipeline, all in-process (no OVS/Ryu,
+the test seam SURVEY.md §4 calls for)."""
+
+import asyncio
+import io
+import struct
+import sys
+
+import pytest
+
+sys.path.insert(0, "tools")
+
+from traffic_classifier_sdn_tpu.controller import openflow as of
+from traffic_classifier_sdn_tpu.controller.switch import Controller
+from traffic_classifier_sdn_tpu.ingest.protocol import parse_line
+
+
+# ---------------------------------------------------------------------------
+# wire format
+
+
+def test_match_roundtrip():
+    raw = of.encode_match(in_port=7, eth_src="aa:bb:cc:dd:ee:ff",
+                          eth_dst="11:22:33:44:55:66")
+    assert len(raw) % 8 == 0
+    fields, off = of.decode_match(raw, 0)
+    assert off == len(raw)
+    assert fields == {
+        "in_port": 7,
+        "eth_src": "aa:bb:cc:dd:ee:ff",
+        "eth_dst": "11:22:33:44:55:66",
+    }
+
+
+def test_empty_match_roundtrip():
+    raw = of.encode_match()
+    fields, off = of.decode_match(raw, 0)
+    assert fields == {} and off == len(raw) == 8
+
+
+def test_flow_mod_roundtrip():
+    match = of.encode_match(in_port=2, eth_src="aa:aa:aa:aa:aa:aa",
+                            eth_dst="bb:bb:bb:bb:bb:bb")
+    instr = of.instruction_apply_actions(of.action_output(5))
+    msg = of.flow_mod(3, priority=1, match=match, instructions=instr)
+    mtype, xid, body = of.MessageReader().feed(msg)[0]
+    assert (mtype, xid) == (of.OFPT_FLOW_MOD, 3)
+    fm = of.parse_flow_mod(body)
+    assert fm["priority"] == 1
+    assert fm["match"]["in_port"] == 2
+    assert of.decode_output_port(fm["instructions"]) == 5
+
+
+def test_flow_stats_roundtrip():
+    stats = [
+        of.FlowStat(1, 100, 5000,
+                    {"in_port": 1, "eth_src": "aa:aa:aa:aa:aa:aa",
+                     "eth_dst": "bb:bb:bb:bb:bb:bb"}, out_port=2),
+        of.FlowStat(0, 7, 70, {}, out_port=None),
+    ]
+    msg = of.flow_stats_reply(9, stats)
+    mtype, xid, body = of.MessageReader().feed(msg)[0]
+    assert (mtype, xid) == (of.OFPT_MULTIPART_REPLY, 9)
+    mp_type, parsed = of.parse_multipart_reply(body)
+    assert mp_type == of.OFPMP_FLOW
+    assert len(parsed) == 2
+    assert parsed[0].packet_count == 100
+    assert parsed[0].byte_count == 5000
+    assert parsed[0].match["eth_dst"] == "bb:bb:bb:bb:bb:bb"
+    assert parsed[0].out_port == 2
+    assert parsed[1].priority == 0
+
+
+def test_packet_in_roundtrip():
+    from fake_switch import eth_frame
+
+    frame = eth_frame("aa:aa:aa:aa:aa:aa", "bb:bb:bb:bb:bb:bb")
+    msg = of.packet_in(4, of.OFP_NO_BUFFER, 0, of.encode_match(in_port=3),
+                       frame)
+    _, _, body = of.MessageReader().feed(msg)[0]
+    pkt = of.parse_packet_in(body)
+    assert pkt["match"]["in_port"] == 3
+    assert pkt["eth_src"] == "aa:aa:aa:aa:aa:aa"
+    assert pkt["eth_dst"] == "bb:bb:bb:bb:bb:bb"
+    assert pkt["frame"] == frame
+
+
+def test_message_reader_partial_frames():
+    msg = of.hello(1) + of.features_request(2)
+    mr = of.MessageReader()
+    out = mr.feed(msg[:5])
+    assert out == []
+    out = mr.feed(msg[5:9])
+    assert [m[0] for m in out] == [of.OFPT_HELLO]
+    out = mr.feed(msg[9:])
+    assert [m[0] for m in out] == [of.OFPT_FEATURES_REQUEST]
+
+
+# ---------------------------------------------------------------------------
+# controller ↔ fake switch
+
+
+async def _run_session(n_polls=3, n_hosts=4):
+    from fake_switch import FakeSwitch
+
+    out = io.StringIO()
+    ctl = Controller(host="127.0.0.1", port=0, poll_interval=0.05, out=out)
+    await ctl.start()
+    sw = FakeSwitch(dpid=42, n_hosts=n_hosts)
+    await sw.connect("127.0.0.1", ctl.bound_port)
+    await sw.pump(0.2)  # hello/features/table-miss handshake
+    for a in range(0, n_hosts - 1, 2):
+        sw.converse(a, a + 1)
+    await sw.pump(0.05 * (n_polls + 4))
+    registered = dict(ctl.datapaths)  # snapshot before stop unregisters
+    await ctl.stop()
+    return registered, sw, out.getvalue()
+
+
+@pytest.fixture(scope="module")
+def session():
+    return asyncio.run(_run_session())
+
+
+def test_controller_registers_datapath(session):
+    registered, sw, _ = session
+    assert 42 in registered
+    assert registered[42].dpid == 42
+
+
+def test_learning_switch_installs_flows(session):
+    _, sw, _ = session
+    prios = sorted(f["priority"] for f in sw.flows)
+    # 1 table-miss + one priority-1 flow per direction per conversing pair
+    assert prios[0] == 0
+    p1 = [f for f in sw.flows if f["priority"] == 1]
+    assert len(p1) == 4  # 2 pairs × 2 directions
+    for f in p1:
+        assert f["match"]["in_port"] == sw.port_of[f["match"]["eth_src"]]
+        assert f["out_port"] == sw.port_of[f["match"]["eth_dst"]]
+
+
+def test_monitor_emits_parseable_telemetry(session):
+    _, sw, text = session
+    records = [
+        r
+        for r in (parse_line(line.encode() + b"\n")
+                  for line in text.splitlines())
+        if r is not None
+    ]
+    assert len(records) >= 4  # ≥1 poll saw all four flows
+    for r in records:
+        assert r.datapath == "42"
+        assert r.eth_src in sw.macs and r.eth_dst in sw.macs
+        assert int(r.out_port) == sw.port_of[r.eth_dst]
+        assert r.packets >= 0 and r.bytes >= 0
+    # counters grow across polls for at least one flow
+    by_flow = {}
+    for r in records:
+        by_flow.setdefault((r.eth_src, r.eth_dst), []).append(r.packets)
+    assert any(v[-1] > v[0] for v in by_flow.values() if len(v) > 1)
+
+
+def test_full_pipeline_controller_to_device_table(session):
+    """Telemetry from our own controller drives the ingest spine and the
+    device flow table ends up with the conversations, direction-folded."""
+    import numpy as np
+
+    from traffic_classifier_sdn_tpu.core import flow_table as ft
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+
+    _, sw, text = session
+    eng = FlowStateEngine(capacity=32)
+    eng.ingest_bytes(text.encode())
+    eng.step()
+    in_use = np.asarray(eng.table.in_use)[:-1]
+    # 4 unidirectional flows fold into 2 bidirectional conversations
+    assert int(in_use.sum()) == 2
+    f12 = np.asarray(ft.features12(eng.table))
+    active = f12[in_use]
+    # both directions saw traffic: fwd and rev cumulative-delta columns
+    # can be zero on the last tick, but rates are recorded
+    assert np.all(active[:, 3] >= 0)
+
+
+def test_echo_and_junk_resilience():
+    """Controller answers echo and survives unknown message types."""
+
+    async def run():
+        out = io.StringIO()
+        ctl = Controller(host="127.0.0.1", port=0, poll_interval=10, out=out)
+        await ctl.start()
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", ctl.bound_port
+        )
+        mr = of.MessageReader()
+        # swallow hello + features_request
+        writer.write(of.message(of.OFPT_ECHO_REQUEST, 77, b"ping"))
+        # unknown/unsupported type 25 (role request) — must not kill us
+        writer.write(of.message(25, 78, b"\x00" * 8))
+        writer.write(of.message(of.OFPT_ECHO_REQUEST, 79, b"pong"))
+        await writer.drain()
+        got = {}
+        for _ in range(20):
+            data = await asyncio.wait_for(reader.read(4096), timeout=2.0)
+            if not data:
+                break
+            for mtype, xid, body in mr.feed(data):
+                got[(mtype, xid)] = body
+            if (of.OFPT_ECHO_REPLY, 79) in got:
+                break
+        writer.close()
+        await ctl.stop()
+        assert got[(of.OFPT_ECHO_REPLY, 77)] == b"ping"
+        assert got[(of.OFPT_ECHO_REPLY, 79)] == b"pong"
+
+    asyncio.run(run())

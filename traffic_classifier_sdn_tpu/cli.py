@@ -70,15 +70,22 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--source",
-        choices=("ryu", "replay", "synthetic"),
+        choices=("ryu", "controller", "replay", "synthetic"),
         default="ryu",
-        help="telemetry source (default: the Ryu monitor subprocess)",
+        help="telemetry source: 'ryu' spawns the reference's monitor "
+        "command, 'controller' spawns our own OpenFlow 1.3 controller "
+        "(controller/switch.py — no Ryu needed; switches connect to "
+        "--of-port), 'replay' reads --capture, 'synthetic' generates flows",
+    )
+    p.add_argument(
+        "--of-port", type=int, default=6653,
+        help="OpenFlow listen port for --source controller",
     )
     p.add_argument("--capture", help="capture file for --source replay")
     p.add_argument(
         "--monitor-cmd",
         default=None,
-        help="override the monitor command for --source ryu",
+        help="override the spawned monitor command (--source ryu or controller; for controller this replaces the built-in OpenFlow controller and --of-port is ignored)",
     )
     # None defaults are sentinels: a --config file fills them, then
     # main() applies the built-in defaults (see main()).
@@ -153,9 +160,14 @@ def _tick_source(args, raw: bool = False):
     else:
         from .ingest.collector import DEFAULT_MONITOR_CMD, SubprocessCollector
 
-        coll = SubprocessCollector(
-            args.monitor_cmd or DEFAULT_MONITOR_CMD, raw=raw
-        )
+        if args.source == "controller":
+            cmd = args.monitor_cmd or (
+                f"{sys.executable} -m traffic_classifier_sdn_tpu.controller "
+                f"--port {args.of_port}"
+            )
+        else:
+            cmd = args.monitor_cmd or DEFAULT_MONITOR_CMD
+        coll = SubprocessCollector(cmd, raw=raw)
         coll.start()
         try:
             while True:
@@ -195,7 +207,7 @@ def _run_classify(args) -> None:
     engine = FlowStateEngine(args.capacity, native=use_native)
     ticks = 0
     dropped_seen = 0
-    for batch in _tick_source(args, raw=use_native and args.source == "ryu"):
+    for batch in _tick_source(args, raw=use_native and args.source in ("ryu", "controller")):
         if isinstance(batch, bytes):
             engine.ingest_bytes(batch)
         else:
@@ -260,7 +272,7 @@ def _run_train(args) -> None:
     with open(out_path, "w") as f:
         f.write("\t".join(list(CSV_COLUMNS_16) + [LABEL_COLUMN]) + "\n")
         for batch in _tick_source(
-            args, raw=engine.native and args.source == "ryu"
+            args, raw=engine.native and args.source in ("ryu", "controller")
         ):
             if isinstance(batch, bytes):
                 engine.ingest_bytes(batch)

@@ -1,0 +1,4 @@
+"""Standalone OpenFlow 1.3 controller (learning switch + telemetry
+monitor) — the framework's replacement for the reference's Ryu layer."""
+
+from .switch import Controller  # noqa: F401

@@ -1,0 +1,3 @@
+from .switch import main
+
+main()
