@@ -195,3 +195,21 @@ def test_e2e_own_controller_fake_switch(capsys, reference_models_dir):
     out = capsys.readouterr().out
     assert "Flow ID" in out
     assert "00:00:00:00:00:01" in out  # learned MAC made it to the table
+
+
+def test_metrics_reporting_in_classify_loop(capsys, reference_models_dir):
+    cli.main(
+        [
+            "gaussiannb",
+            "--source", "synthetic",
+            "--synthetic-flows", "16",
+            "--checkpoint-dir", reference_models_dir,
+            "--capacity", "32",
+            "--print-every", "2",
+            "--metrics-every", "2",
+            "--max-ticks", "4",
+        ]
+    )
+    err = capsys.readouterr().err
+    assert "metrics " in err
+    assert "records=" in err and "predict_s_p50=" in err

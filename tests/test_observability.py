@@ -1,0 +1,233 @@
+"""Tests for the auxiliary subsystems (SURVEY.md §5): metrics registry,
+profiling helpers, and the failure-detecting supervised collector."""
+
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.ingest.supervisor import SupervisedCollector
+from traffic_classifier_sdn_tpu.utils.metrics import Histogram, Metrics
+from traffic_classifier_sdn_tpu.utils import profiling
+
+
+# ---------------------------------------------------------------------------
+# metrics
+
+
+def test_counters_gauges():
+    m = Metrics()
+    m.inc("a")
+    m.inc("a", 4)
+    m.set("g", 7.5)
+    snap = m.snapshot()
+    assert snap["a"] == 5
+    assert snap["g"] == 7.5
+    assert snap["uptime_s"] >= 0
+
+
+def test_histogram_percentiles_exact_over_window():
+    h = Histogram(window=100)
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.percentile(0) == 1.0
+    assert h.percentile(100) == 100.0
+    assert h.percentile(50) == 51.0  # nearest-rank on 0-indexed 100 samples
+    assert h.count == 100
+    assert h.mean == pytest.approx(50.5)
+
+
+def test_histogram_ring_evicts_oldest():
+    h = Histogram(window=4)
+    for v in [10, 20, 30, 40, 50, 60]:
+        h.observe(v)
+    # window now holds 50, 60, 30, 40 → sorted [30, 40, 50, 60]
+    assert h.percentile(0) == 30
+    assert h.percentile(100) == 60
+    assert h.count == 6  # lifetime count unaffected by eviction
+
+
+def test_timer_and_report_line():
+    m = Metrics()
+    with m.time("op_s"):
+        time.sleep(0.01)
+    snap = m.snapshot()
+    assert snap["op_s_count"] == 1
+    assert 0.005 < snap["op_s_p50"] < 1.0
+    rep = m.report()
+    assert rep.startswith("metrics ")
+    assert "op_s_p50=" in rep
+
+
+# ---------------------------------------------------------------------------
+# profiling
+
+
+def test_device_seconds_per_call_orders_work_sizes():
+    """Bigger kernels must time slower; sanity for the dependent-chain
+    methodology (runs on the test CPU backend)."""
+    import jax.numpy as jnp
+
+    def f(x):
+        return x @ x
+
+    small = profiling.device_seconds_per_call(
+        f, (jnp.ones((32, 32), jnp.float32),), iters=8, repeats=3
+    )
+    big = profiling.device_seconds_per_call(
+        f, (jnp.ones((512, 512), jnp.float32),), iters=8, repeats=3
+    )
+    assert small > 0
+    assert big > small
+
+
+def test_trace_noop_and_capture(tmp_path):
+    import jax.numpy as jnp
+
+    with profiling.trace(None):  # no-op path
+        pass
+    d = tmp_path / "trace"
+    with profiling.trace(str(d)):
+        jnp.ones((8,)).sum().block_until_ready()
+    assert any(d.rglob("*"))  # profiler wrote something
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+
+
+def _line_cmd(n_lines, tag, sleep=0.01, exit_code=1):
+    """A monitor that emits n telemetry lines then exits (nonzero by
+    default — a 'crash'; exit_code=0 simulates intentional completion)."""
+    code = (
+        "import sys, time\n"
+        f"for i in range({n_lines}):\n"
+        f"    print('data\\t'+str(i+1)+'\\t{tag}\\t1\\taa\\tbb\\t2\\t'+"
+        "str((i+1)*10)+'\\t'+str((i+1)*100), flush=True)\n"
+        f"    time.sleep({sleep})\n"
+        f"sys.exit({exit_code})\n"
+    )
+    return f'{sys.executable} -c "{code}"'
+
+
+def test_supervisor_restarts_dead_monitor():
+    cmd = _line_cmd(3, tag="dp")
+    sup = SupervisedCollector(cmd, max_restarts=2, backoff_base=0.05)
+    sup.start()
+    got = []
+    deadline = time.time() + 20
+    while sup.running and time.time() < deadline:
+        r = sup.wait_record(timeout=0.2)
+        if r is not None:
+            got.append(r)
+    # 3 lines per life × (1 original + 2 restarts)
+    assert len(got) == 9
+    assert sup.restarts == 2
+    assert not sup.running  # budget exhausted → honest exit signal
+    sup.stop()
+
+
+def test_supervisor_zero_restarts_behaves_like_plain_collector():
+    cmd = _line_cmd(2, tag="dp")
+    sup = SupervisedCollector(cmd, max_restarts=0, backoff_base=0.01)
+    sup.start()
+    got = []
+    deadline = time.time() + 10
+    while sup.running and time.time() < deadline:
+        r = sup.wait_record(timeout=0.2)
+        if r is not None:
+            got.append(r)
+    assert len(got) == 2
+    assert sup.restarts == 0
+    sup.stop()
+
+
+def test_supervisor_metrics_integration():
+    m = Metrics()
+    cmd = _line_cmd(1, tag="dp", sleep=0.0)
+    sup = SupervisedCollector(
+        cmd, max_restarts=1, backoff_base=0.02, metrics=m
+    )
+    sup.start()
+    deadline = time.time() + 10
+    while sup.running and time.time() < deadline:
+        sup.wait_record(timeout=0.1)
+    assert m.counters.get("monitor_deaths", 0) >= 1
+    assert m.counters.get("monitor_restarts", 0) == 1
+    sup.stop()
+
+
+def test_supervisor_clean_exit_is_not_a_crash():
+    """Exit code 0 means the monitor finished on purpose (cat of a
+    capture file): no restarts, the source just ends."""
+    cmd = _line_cmd(3, tag="dp", exit_code=0)
+    sup = SupervisedCollector(cmd, max_restarts=5, backoff_base=0.05)
+    sup.start()
+    got = []
+    deadline = time.time() + 10
+    while sup.running and time.time() < deadline:
+        r = sup.wait_record(timeout=0.2)
+        if r is not None:
+            got.append(r)
+    assert len(got) == 3
+    assert sup.restarts == 0
+    sup.stop()
+
+
+def test_supervisor_preserves_queued_records_across_restart():
+    """Records queued when the monitor dies are served, not discarded."""
+    # burst of 5 lines with no sleep: they queue before the caller reads
+    cmd = _line_cmd(5, tag="dp", sleep=0.0)
+    sup = SupervisedCollector(cmd, max_restarts=1, backoff_base=0.05)
+    sup.start()
+    time.sleep(0.5)  # let it emit everything and die before we read
+    got = []
+    deadline = time.time() + 10
+    while sup.running and time.time() < deadline:
+        r = sup.wait_record(timeout=0.2)
+        if r is not None:
+            got.append(r)
+    assert len(got) == 10  # 5 original + 5 from the single restart
+    sup.stop()
+
+
+def test_supervisor_raw_seam_prevents_cross_restart_splice():
+    """In raw mode a \\n seam separates the dead monitor's last partial
+    line from the restarted monitor's first bytes."""
+    # monitor prints a line WITHOUT trailing newline then crashes
+    code = (
+        "import sys;"
+        "sys.stdout.write('data\\t1\\t1\\t1\\taa\\tbb\\t2\\t5\\t12');"
+        "sys.stdout.flush();sys.exit(1)"
+    )
+    cmd = f'{sys.executable} -c "{code}"'
+    sup = SupervisedCollector(cmd, raw=True, max_restarts=1,
+                              backoff_base=0.05)
+    sup.start()
+    chunks = []
+    deadline = time.time() + 10
+    while sup.running and time.time() < deadline:
+        c = sup.wait_record(timeout=0.2)
+        if c is not None:
+            chunks.append(c)
+    data = b"".join(chunks)
+    sup.stop()
+    # the poison-seam makes each incarnation's truncated fragment
+    # unparseable (the half-written byte counter must NOT become a
+    # record) and prevents the fragments merging into one record
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+
+    eng = FlowStateEngine(capacity=8)
+    assert eng.ingest_bytes(data) == 0
+    assert data.count(b"\x00\n") >= 1
+
+
+def test_supervisor_aggregates_lines_dropped_across_incarnations():
+    sup = SupervisedCollector("true", max_restarts=0)
+    sup.start()
+    time.sleep(0.2)
+    sup._collector.lines_dropped = 7
+    sup._check()  # detects death, accumulates into _dropped_prior
+    assert sup.lines_dropped == 7
+    sup.stop()

@@ -127,6 +127,20 @@ def _build_parser() -> argparse.ArgumentParser:
         help="use the C++ ingest engine (native/flow_engine.cpp); auto "
         "falls back to the pure-Python batcher if g++ is unavailable",
     )
+    p.add_argument(
+        "--monitor-restarts", type=int, default=5,
+        help="restart a dead monitor up to N times with exponential "
+        "backoff (0 disables supervision; the reference just exits)",
+    )
+    p.add_argument(
+        "--metrics-every", type=int, default=0,
+        help="print an ingest/predict metrics line to stderr every N "
+        "poll ticks (0 disables)",
+    )
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler trace of the run into this directory",
+    )
     return p
 
 
@@ -167,7 +181,16 @@ def _tick_source(args, raw: bool = False):
             )
         else:
             cmd = args.monitor_cmd or DEFAULT_MONITOR_CMD
-        coll = SubprocessCollector(cmd, raw=raw)
+        if args.monitor_restarts:
+            from .ingest.supervisor import SupervisedCollector
+            from .utils.metrics import global_metrics
+
+            coll = SupervisedCollector(
+                cmd, raw=raw, max_restarts=args.monitor_restarts,
+                metrics=global_metrics,
+            )
+        else:
+            coll = SubprocessCollector(cmd, raw=raw)
         coll.start()
         try:
             while True:
@@ -203,32 +226,48 @@ def _run_classify(args) -> None:
         model = load_reference_model(args.subcommand, ckpt)
     predict = jax.jit(model.predict)
 
+    from .utils.metrics import global_metrics as m
+    from .utils.profiling import trace
+
     use_native = _use_native(args)
     engine = FlowStateEngine(args.capacity, native=use_native)
     ticks = 0
     dropped_seen = 0
-    for batch in _tick_source(args, raw=use_native and args.source in ("ryu", "controller")):
-        if isinstance(batch, bytes):
-            engine.ingest_bytes(batch)
-        else:
-            engine.ingest(batch)
-        engine.step()
-        ticks += 1
-        if ticks % args.print_every == 0:
-            if args.idle_timeout and engine.last_time:
-                engine.evict_idle(engine.last_time, args.idle_timeout)
-            if engine.dropped > dropped_seen:
-                print(
-                    f"WARNING: flow table full — "
-                    f"{engine.dropped - dropped_seen} new flows "
-                    f"dropped since last report (capacity {args.capacity}, "
-                    f"idle-timeout {args.idle_timeout}s)",
-                    file=sys.stderr,
-                )
-                dropped_seen = engine.dropped
-            _print_table(engine, model, predict, args)
-        if args.max_ticks and ticks >= args.max_ticks:
-            break
+    with trace(args.profile_dir):
+        for batch in _tick_source(
+            args, raw=use_native and args.source in ("ryu", "controller")
+        ):
+            with m.time("ingest_s"):
+                if isinstance(batch, bytes):
+                    m.inc("records", engine.ingest_bytes(batch))
+                else:
+                    m.inc("records", engine.ingest(batch))
+                engine.step()
+            ticks += 1
+            m.inc("ticks")
+            if ticks % args.print_every == 0:
+                if args.idle_timeout and engine.last_time:
+                    m.inc(
+                        "evicted",
+                        engine.evict_idle(engine.last_time, args.idle_timeout),
+                    )
+                if engine.dropped > dropped_seen:
+                    print(
+                        f"WARNING: flow table full — "
+                        f"{engine.dropped - dropped_seen} new flows "
+                        f"dropped since last report (capacity "
+                        f"{args.capacity}, idle-timeout "
+                        f"{args.idle_timeout}s)",
+                        file=sys.stderr,
+                    )
+                    dropped_seen = engine.dropped
+                m.set("flows_dropped", engine.dropped)
+                with m.time("predict_s"):
+                    _print_table(engine, model, predict, args)
+            if args.metrics_every and ticks % args.metrics_every == 0:
+                print(m.report(), file=sys.stderr, flush=True)
+            if args.max_ticks and ticks >= args.max_ticks:
+                break
 
 
 def _print_table(engine, model, predict, args) -> None:
@@ -355,6 +394,9 @@ def _run_retrain(args) -> None:
 
 
 def main(argv=None) -> None:
+    from .utils.metrics import global_metrics
+
+    global_metrics.reset()  # per-run metrics, even for embedded reuse
     args = _build_parser().parse_args(argv)
     if args.config:
         from . import config as config_mod

@@ -105,6 +105,12 @@ class SubprocessCollector:
     def running(self) -> bool:
         return self._proc is not None and self._proc.poll() is None
 
+    @property
+    def returncode(self) -> int | None:
+        """Exit status of the monitor process (None while running or
+        before start)."""
+        return self._proc.poll() if self._proc is not None else None
+
     def stop(self) -> None:
         """Terminate the monitor's process group (the reference's
         ``os.killpg`` teardown at traffic_classifier.py:222)."""
@@ -114,3 +120,7 @@ class SubprocessCollector:
             except ProcessLookupError:
                 pass
         self._proc = None
+
+    def drain(self) -> list:
+        """All queued items (records or raw chunks), non-blocking."""
+        return self.poll_records()

@@ -1,0 +1,147 @@
+"""Failure detection and elastic recovery for the telemetry source.
+
+The reference's failure handling is one ``p.poll()`` check that breaks
+the ingest loop (traffic_classifier.py:150-151) — a dead monitor ends the
+run. Here a supervisor wraps SubprocessCollector with crash detection,
+exponential-backoff restart, and a restart budget, so a wedged or killed
+monitor (controller crash, Ryu OOM, switch flap) costs seconds of
+telemetry instead of the whole session. Flow state survives restarts: the
+device flow table and the C++/Python flow index live in the classifier
+process, and counters in the protocol are cumulative, so a restarted
+monitor's first poll simply produces one large delta per flow (the same
+thing the reference would see after a missed poll).
+
+Restart semantics:
+- a monitor that exits **0** finished on purpose (``cat capture.txt``,
+  a bounded fake monitor) — no restart, the source just ends
+- nonzero exit / signal death → restart after exponential backoff, up to
+  ``max_restarts`` times
+- records still queued at death are preserved and served before the new
+  incarnation's output; in raw mode a ``b"\\x00\\n"`` poison-seam is
+  injected so the dead monitor's trailing partial line is rejected by
+  the parser (a bare newline would *complete* a truncated record) and
+  can never splice with the first chunk of the new one (same framing
+  hazard SubprocessCollector._reader guards against on queue overflow)
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from .collector import SubprocessCollector
+
+
+class SupervisedCollector:
+    """SubprocessCollector with restart-on-crash and backoff.
+
+    Same surface the CLI uses (start/stop/wait_record/poll_records/
+    running/lines_dropped) so it drops into _tick_source unchanged.
+    """
+
+    def __init__(self, cmd: str, raw: bool = False, max_restarts: int = 5,
+                 backoff_base: float = 0.5, backoff_cap: float = 30.0,
+                 metrics=None):
+        self.cmd = cmd
+        self.raw = raw
+        self.max_restarts = max_restarts
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.restarts = 0
+        self._metrics = metrics
+        self._collector: SubprocessCollector | None = None
+        self._next_restart_at = 0.0
+        self._done = False  # clean exit or budget exhausted
+        self._carryover: deque = deque()  # preserved across restarts
+        self._dropped_prior = 0  # lines_dropped from dead incarnations
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._collector = SubprocessCollector(self.cmd, raw=self.raw)
+        self._collector.start()
+
+    def stop(self) -> None:
+        if self._collector is not None:
+            self._collector.stop()
+
+    @property
+    def lines_dropped(self) -> int:
+        now = self._collector.lines_dropped if self._collector else 0
+        return self._dropped_prior + now
+
+    @property
+    def running(self) -> bool:
+        """True while the monitor runs OR a restart is still possible OR
+        preserved records remain — the caller's loop condition."""
+        if self._carryover:
+            return True
+        if self._collector is not None and self._collector.running:
+            return True
+        return not self._done
+
+    # -- supervision -------------------------------------------------------
+    def _check(self) -> None:
+        """Detect a dead monitor and restart it after backoff."""
+        c = self._collector
+        if self._done or (c is not None and c.running):
+            return
+        now = time.monotonic()
+        if self._next_restart_at == 0.0:
+            # just detected the exit: preserve queued output, then decide
+            if c is not None:
+                self._carryover.extend(c.drain())
+                self._dropped_prior += c.lines_dropped
+                if self.raw:
+                    # poison + seam: a NUL makes the dead monitor's
+                    # trailing partial line unparseable (a bare \n would
+                    # *complete* a truncated record, e.g. a half-written
+                    # byte counter), and the \n stops it splicing with
+                    # the new monitor's first bytes
+                    self._carryover.append(b"\x00\n")
+            if (c is not None and c.returncode == 0) or (
+                self.restarts >= self.max_restarts
+            ):
+                self._done = True
+                if c is not None:
+                    c.stop()
+                self._collector = None
+                return
+            delay = min(
+                self.backoff_cap, self.backoff_base * (2 ** self.restarts)
+            )
+            self._next_restart_at = now + delay
+            if self._metrics is not None:
+                self._metrics.inc("monitor_deaths")
+            return
+        if now < self._next_restart_at:
+            return
+        self._next_restart_at = 0.0
+        self.restarts += 1
+        if self._metrics is not None:
+            self._metrics.inc("monitor_restarts")
+        if c is not None:
+            c.stop()  # reap the old process group
+        self.start()
+
+    # -- collector surface -------------------------------------------------
+    def wait_record(self, timeout: float):
+        self._check()
+        if self._carryover:
+            return self._carryover.popleft()
+        if self._collector is None:
+            time.sleep(min(timeout, 0.05))
+            return None
+        rec = self._collector.wait_record(timeout=timeout)
+        if rec is None:
+            self._check()
+            if self._carryover:
+                return self._carryover.popleft()
+        return rec
+
+    def poll_records(self, max_records: int = 1 << 20):
+        out = []
+        while self._carryover and len(out) < max_records:
+            out.append(self._carryover.popleft())
+        if self._collector is not None and len(out) < max_records:
+            out.extend(self._collector.poll_records(max_records - len(out)))
+        return out

@@ -1,0 +1,124 @@
+"""Lightweight metrics registry: counters, gauges, and latency
+histograms with percentile snapshots.
+
+The reference's only observability is the TSV line protocol itself plus
+PrettyTable output (SURVEY.md §5 — "the TSV line protocol *is* the
+metrics system"). This module gives the framework real counters for the
+ingest spine (records parsed/dropped, batches scattered, evictions) and
+latency distributions for the device predict path, renderable as a
+single-line report or a dict for programmatic scraping.
+
+Deliberately dependency-free and cheap: increments are plain float adds;
+histograms keep a bounded ring of recent samples (exact percentiles over
+the window, no binning error).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Histogram:
+    """Bounded ring of recent samples; exact percentiles over the window."""
+
+    window: int = 1024
+    _samples: list = field(default_factory=list)
+    _pos: int = 0
+    count: int = 0
+    total: float = 0.0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if len(self._samples) < self.window:
+            self._samples.append(value)
+        else:
+            self._samples[self._pos] = value
+            self._pos = (self._pos + 1) % self.window
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; nearest-rank over the current window."""
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+        return s[idx]
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class Metrics:
+    """Flat namespace of counters / gauges / histograms."""
+
+    def __init__(self):
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, Histogram] = {}
+        self._t0 = time.time()
+
+    def reset(self) -> None:
+        """Zero everything (start of a CLI run — the global registry must
+        not leak state between runs in one process)."""
+        self.counters.clear()
+        self.gauges.clear()
+        self.histograms.clear()
+        self._t0 = time.time()
+
+    # -- write -------------------------------------------------------------
+    def inc(self, name: str, value: float = 1.0) -> None:
+        self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def set(self, name: str, value: float) -> None:
+        self.gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram()
+        h.observe(value)
+
+    def time(self, name: str):
+        """Context manager: record elapsed seconds into histogram ``name``."""
+        return _TimerCtx(self, name)
+
+    # -- read --------------------------------------------------------------
+    def snapshot(self) -> dict:
+        out: dict = {"uptime_s": time.time() - self._t0}
+        out.update({k: v for k, v in self.counters.items()})
+        out.update({k: v for k, v in self.gauges.items()})
+        for name, h in self.histograms.items():
+            out[f"{name}_count"] = h.count
+            out[f"{name}_mean"] = h.mean
+            out[f"{name}_p50"] = h.percentile(50)
+            out[f"{name}_p99"] = h.percentile(99)
+        return out
+
+    def report(self) -> str:
+        """One human line, stable key order — greppable from stderr."""
+        snap = self.snapshot()
+        parts = []
+        for k in sorted(snap):
+            v = snap[k]
+            parts.append(f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}")
+        return "metrics " + " ".join(parts)
+
+
+class _TimerCtx:
+    def __init__(self, m: Metrics, name: str):
+        self.m, self.name = m, name
+
+    def __enter__(self):
+        self._t = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.m.observe(self.name, time.perf_counter() - self._t)
+        return False
+
+
+# process-global default registry (import-cheap, test-resettable)
+global_metrics = Metrics()

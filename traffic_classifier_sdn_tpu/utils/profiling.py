@@ -1,0 +1,93 @@
+"""Profiling helpers: jax.profiler trace capture + on-device step timing.
+
+The reference has no tracing/profiling at all (SURVEY.md §5); its nearest
+artifact is Ryu debug logging. Here: ``trace()`` wraps
+``jax.profiler.trace`` so any CLI run can drop a TensorBoard-compatible
+trace of the XLA pipeline, and ``device_timer`` measures the median
+on-device cost of a jitted callable the same careful way bench.py does
+(chained dependent iterations inside one dispatch, round-trip subtracted)
+— reliable even over a remote-TPU tunnel where naive wall-clock timing of
+single calls lies.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Capture a jax.profiler trace into ``log_dir`` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def _sync(x) -> float:
+    import numpy as np
+
+    return float(np.asarray(x))
+
+
+def roundtrip_seconds(repeats: int = 7) -> float:
+    """Median dispatch + scalar-fetch cost of a trivial kernel."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda a: jnp.sum(a) * 0.0)
+    a = jnp.ones((8,), jnp.float32)
+    _sync(f(a))
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        _sync(f(a))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def device_seconds_per_call(fn, args, iters: int = 16, repeats: int = 5,
+                            perturb=None) -> float:
+    """Median on-device seconds per ``fn(*args)`` call.
+
+    Runs ``iters`` dependent iterations inside one jitted ``fori_loop``
+    (a loop-carried perturbation defeats loop-invariant hoisting),
+    reduces to a scalar, fetches it (a real sync), subtracts the measured
+    empty-kernel round trip, divides by ``iters``. ``perturb(x, carry)``
+    maps the loop carry into the first argument; default adds a scaled
+    scalar."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    if perturb is None:
+        def perturb(x, carry):
+            return x + carry.astype(x.dtype) * 1e-6
+
+    first, rest = args[0], tuple(args[1:])
+
+    @jax.jit
+    def loop(x0):
+        def body(_, carry):
+            acc, x = carry
+            out = fn(perturb(x, acc), *rest)
+            return acc + jnp.sum(out).astype(jnp.float32), x
+
+        acc, _ = lax.fori_loop(0, iters, body, (jnp.float32(0.0), x0))
+        return acc
+
+    _sync(loop(first))  # compile + warm
+    rtt = roundtrip_seconds()
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        _sync(loop(first))
+        times.append(time.perf_counter() - t0)
+    total = float(np.median(times))
+    return max(total - rtt, 1e-12) / iters
