@@ -1,0 +1,189 @@
+"""Tests for analysis/: sklearn parity for scaler/PCA/confusion matrix,
+and reproduction of the reference notebook's analysis numbers
+(1_log_Kmeans.ipynb cells 70-129, SURVEY.md §6)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from traffic_classifier_sdn_tpu.analysis import (
+    PCA,
+    StandardScaler,
+    accuracy,
+    confusion_matrix,
+    match_clusters,
+)
+from traffic_classifier_sdn_tpu.analysis.eval import clustering_accuracy
+
+sklearn = pytest.importorskip("sklearn")
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.RandomState(42)
+
+
+@pytest.fixture(scope="module")
+def X(rng):
+    # heteroscedastic, correlated columns — PCA actually has work to do
+    base = rng.randn(500, 12)
+    mix = rng.randn(12, 12) * np.linspace(0.1, 3.0, 12)
+    return (base @ mix + rng.randn(12) * 5).astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# sklearn parity
+
+
+def test_scaler_matches_sklearn(X):
+    from sklearn.preprocessing import StandardScaler as SkScaler
+
+    sk = SkScaler().fit(X)
+    p = StandardScaler.fit(jnp.asarray(X))
+    np.testing.assert_allclose(np.asarray(p.mean), sk.mean_, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p.scale), sk.scale_, rtol=1e-6)
+    ours = np.asarray(StandardScaler.transform(p, jnp.asarray(X)))
+    np.testing.assert_allclose(ours, sk.transform(X), rtol=1e-5, atol=1e-8)
+    back = np.asarray(StandardScaler.inverse_transform(p, jnp.asarray(ours)))
+    np.testing.assert_allclose(back, X, rtol=1e-5, atol=1e-6)
+
+
+def test_scaler_zero_variance_column():
+    Xc = np.ones((50, 3))
+    Xc[:, 1] = np.arange(50)
+    p = StandardScaler.fit(jnp.asarray(Xc))
+    assert float(p.scale[0]) == 1.0  # zero-variance guard, like sklearn
+    out = np.asarray(StandardScaler.transform(p, jnp.asarray(Xc)))
+    assert np.all(out[:, 0] == 0)
+
+
+def test_pca_matches_sklearn(X):
+    from sklearn.decomposition import PCA as SkPCA
+
+    sk = SkPCA(n_components=2).fit(X)
+    p = PCA.fit(jnp.asarray(X), n_components=2)
+    np.testing.assert_allclose(
+        np.asarray(p.explained_variance), sk.explained_variance_, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(p.explained_variance_ratio),
+        sk.explained_variance_ratio_,
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(p.components), sk.components_, rtol=1e-4, atol=1e-6
+    )
+    ours = np.asarray(PCA.transform(p, jnp.asarray(X)))
+    np.testing.assert_allclose(ours, sk.transform(X), rtol=1e-4, atol=1e-5)
+
+
+def test_pca_inverse_reconstructs_full_rank(X):
+    p = PCA.fit(jnp.asarray(X), n_components=12)
+    Z = PCA.transform(p, jnp.asarray(X))
+    back = np.asarray(PCA.inverse_transform(p, Z))
+    np.testing.assert_allclose(back, X, rtol=1e-4, atol=1e-5)
+
+
+def test_confusion_matrix_matches_sklearn(rng):
+    from sklearn.metrics import confusion_matrix as sk_cm
+
+    y_true = rng.randint(0, 6, 300)
+    y_pred = rng.randint(0, 6, 300)
+    ours = np.asarray(
+        confusion_matrix(jnp.asarray(y_true), jnp.asarray(y_pred), 6)
+    )
+    np.testing.assert_array_equal(ours, sk_cm(y_true, y_pred, labels=range(6)))
+    assert float(accuracy(jnp.asarray(y_true), jnp.asarray(y_pred))) == (
+        pytest.approx((y_true == y_pred).mean())
+    )
+
+
+def test_match_clusters_mode_and_ties():
+    # cluster 0: labels [1,1,2] → 1; cluster 1: tie [0,2] → smallest = 0
+    cids = jnp.asarray([0, 0, 0, 1, 1])
+    y = jnp.asarray([1, 1, 2, 0, 2])
+    remap = np.asarray(match_clusters(cids, y, k=3, n_classes=3))
+    assert remap[0] == 1
+    assert remap[1] == 0
+    assert remap[2] == 0  # empty cluster → 0
+
+
+# ---------------------------------------------------------------------------
+# notebook-number reproduction on the reference datasets
+
+
+@pytest.fixture(scope="module")
+def ref_ds():
+    import os
+
+    if not os.path.isdir("/root/reference/datasets"):
+        pytest.skip("reference datasets unavailable")
+    from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
+
+    return load_reference_datasets("/root/reference/datasets")
+
+
+def test_pca2_explained_variance_matches_notebook(ref_ds):
+    """1_log_Kmeans.ipynb cell 82: scaled PCA-2 explains 81.11% of the
+    variance (SURVEY.md §6)."""
+    Xs = StandardScaler.transform(
+        StandardScaler.fit(jnp.asarray(ref_ds.X)), jnp.asarray(ref_ds.X)
+    )
+    p = PCA.fit(Xs, n_components=2)
+    ratio = float(jnp.sum(p.explained_variance_ratio))
+    assert ratio == pytest.approx(0.8111, abs=0.02)
+
+
+def test_pca2_logreg_matches_notebook(ref_ds):
+    """1_log_Kmeans.ipynb cell 91: LogReg on PCA-2, 70/30 split → 83.03%.
+    Our split PRNG differs from sklearn's, so a ±3% band."""
+    from traffic_classifier_sdn_tpu.io.datasets import train_test_split
+    from traffic_classifier_sdn_tpu.models import logreg
+    from traffic_classifier_sdn_tpu.train import logreg as logreg_train
+
+    tr, te = train_test_split(ref_ds, test_size=0.3, seed=101)
+    sp = StandardScaler.fit(jnp.asarray(tr.X))
+    pca = PCA.fit(StandardScaler.transform(sp, jnp.asarray(tr.X)), 2)
+    Ztr = PCA.transform(pca, StandardScaler.transform(sp, jnp.asarray(tr.X)))
+    Zte = PCA.transform(pca, StandardScaler.transform(sp, jnp.asarray(te.X)))
+    params = logreg_train.fit(
+        np.asarray(Ztr), tr.y, n_classes=len(tr.classes)
+    )
+    acc = float(
+        accuracy(jnp.asarray(te.y), logreg.predict(params, Zte))
+    )
+    assert acc == pytest.approx(0.8303, abs=0.03)
+
+
+def test_kmeans_mode_matching_matches_notebook(ref_ds):
+    """1_log_Kmeans.ipynb cell 118: the 4-cluster KMeans checkpoint,
+    mode-matched on the 4-class rows, scores 46.38%."""
+    import os
+
+    ckpt = "/root/reference/models/KMeans_Clustering"
+    if not os.path.exists(ckpt):
+        pytest.skip("reference KMeans checkpoint unavailable")
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+    from traffic_classifier_sdn_tpu.models import kmeans
+
+    four = [c for c in ("dns", "ping", "telnet", "voice")]
+    keep = np.isin(np.asarray(ref_ds.classes)[ref_ds.y], four)
+    X4 = ref_ds.X[keep]
+    # relabel to the 4-class alphabetical coding the notebook used
+    names = np.asarray(ref_ds.classes)[ref_ds.y[keep]]
+    y4 = np.searchsorted(np.asarray(four), names).astype(np.int32)
+
+    params = kmeans.from_numpy(ski.import_kmeans(ckpt), dtype=jnp.float64)
+    cids = kmeans.predict(params, jnp.asarray(X4))
+    # the notebook's 46.38% is its cell-116 map, which is the identity on
+    # the alphabetical coding (0=dns,1=ping,2=telnet,3=voice)
+    notebook_acc = float(accuracy(jnp.asarray(y4), cids))
+    assert notebook_acc == pytest.approx(0.4638, abs=0.005)
+    # our data-driven mode matching must do at least as well (measured:
+    # 61.0% — it fixes the reference's suboptimal cluster→label order)
+    acc = float(
+        clustering_accuracy(cids, jnp.asarray(y4), k=4, n_classes=4)
+    )
+    assert acc >= notebook_acc
+    assert acc == pytest.approx(0.610, abs=0.02)

@@ -213,3 +213,25 @@ def test_metrics_reporting_in_classify_loop(capsys, reference_models_dir):
     err = capsys.readouterr().err
     assert "metrics " in err
     assert "records=" in err and "predict_s_p50=" in err
+
+
+def test_retrain_reports_confusion_matrix(capsys):
+    import os
+
+    if not os.path.isdir("/root/reference/datasets"):
+        pytest.skip("reference datasets unavailable")
+    cli.main(["retrain", "gaussiannb"])
+    out = capsys.readouterr().out
+    assert "held-out accuracy" in out
+    assert "confusion matrix" in out
+    assert "dns" in out and "voice" in out
+
+
+def test_retrain_kmeans_reports_mode_matched_accuracy(capsys):
+    import os
+
+    if not os.path.isdir("/root/reference/datasets"):
+        pytest.skip("reference datasets unavailable")
+    cli.main(["retrain", "kmeans"])
+    out = capsys.readouterr().out
+    assert "mode-matched clustering accuracy" in out

@@ -231,3 +231,29 @@ def test_supervisor_aggregates_lines_dropped_across_incarnations():
     sup._check()  # detects death, accumulates into _dropped_prior
     assert sup.lines_dropped == 7
     sup.stop()
+
+
+def test_supervisor_no_race_with_fast_finishing_monitor():
+    """A monitor that writes a large burst and exits instantly (cat of a
+    capture): death must not be declared until the reader thread hits
+    pipe EOF, so no record is ever lost to the drain race."""
+    n = 5000
+    code = (
+        "import sys\n"
+        f"for i in range({n}):\n"
+        "    sys.stdout.write('data\\t'+str(i+1)+'\\t1\\t1\\taa\\tbb\\t2\\t'"
+        "+str(i+1)+'\\t'+str((i+1)*10)+'\\n')\n"
+    )
+    cmd = f"{sys.executable} -c \"{code}\""
+    sup = SupervisedCollector(cmd, max_restarts=3, backoff_base=0.05)
+    sup.start()
+    got = []
+    deadline = time.time() + 30
+    while sup.running and time.time() < deadline:
+        r = sup.wait_record(timeout=0.2)
+        if r is not None:
+            got.append(r)
+    # exit 0 → no restart; and every one of the 5000 burst records arrives
+    assert sup.restarts == 0
+    assert len(got) == n
+    sup.stop()

@@ -1,0 +1,7 @@
+"""Analysis toolkit: on-device preprocessing (StandardScaler, PCA),
+evaluation (accuracy, confusion matrix), and cluster→label mode matching
+— the TPU-native equivalent of the reference's notebook analysis cells
+(SURVEY.md §2 C13: 1_log_Kmeans.ipynb cells 70-129)."""
+
+from .eval import accuracy, confusion_matrix, match_clusters  # noqa: F401
+from .preprocess import PCA, StandardScaler  # noqa: F401

@@ -1,0 +1,89 @@
+"""On-device preprocessing: StandardScaler and PCA as pure JAX.
+
+TPU-native reimplementation of the reference's analysis-only pipeline
+(1_log_Kmeans.ipynb cells 70-98: StandardScaler → PCA(2) with 81.11%
+explained variance → PCA-space LogisticRegression at 83.03%). The
+reference never ships these to the online path (no scaler is pickled —
+SURVEY.md §3.5); we keep them importable for both analysis and as
+optional feature-space transforms.
+
+Both are parameter NamedTuples + pure functions, so they jit/vmap/pjit
+like every other model in the framework. PCA is computed from the
+covariance eigendecomposition (features are only 12-dimensional: the
+12×12 eigh is trivial; no need for a randomized SVD) with sklearn's sign
+convention (largest-|loading| component positive) so parity tests can
+compare components directly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ScalerParams(NamedTuple):
+    mean: jax.Array  # (d,)
+    scale: jax.Array  # (d,) std with ddof=0; zeros replaced by 1
+
+
+class StandardScaler:
+    """fit/transform with sklearn semantics (ddof=0, zero-variance → 1)."""
+
+    @staticmethod
+    def fit(X: jax.Array) -> ScalerParams:
+        mean = jnp.mean(X, axis=0)
+        var = jnp.var(X, axis=0)
+        scale = jnp.where(var == 0.0, 1.0, jnp.sqrt(var))
+        return ScalerParams(mean=mean, scale=scale)
+
+    @staticmethod
+    def transform(p: ScalerParams, X: jax.Array) -> jax.Array:
+        return (X - p.mean) / p.scale
+
+    @staticmethod
+    def inverse_transform(p: ScalerParams, Z: jax.Array) -> jax.Array:
+        return Z * p.scale + p.mean
+
+
+class PCAParams(NamedTuple):
+    mean: jax.Array  # (d,)
+    components: jax.Array  # (k, d) rows = principal axes
+    explained_variance: jax.Array  # (k,)
+    explained_variance_ratio: jax.Array  # (k,)
+
+
+class PCA:
+    """Principal components via covariance eigh (exact for small d)."""
+
+    @staticmethod
+    def fit(X: jax.Array, n_components: int) -> PCAParams:
+        n = X.shape[0]
+        mean = jnp.mean(X, axis=0)
+        Xc = X - mean
+        # sample covariance with ddof=1, matching sklearn's PCA
+        cov = (Xc.T @ Xc) / (n - 1)
+        eigvals, eigvecs = jnp.linalg.eigh(cov)  # ascending
+        order = jnp.argsort(-eigvals)
+        eigvals = eigvals[order][:n_components]
+        comps = eigvecs[:, order][:, :n_components].T  # (k, d)
+        # sklearn sign convention: largest-|loading| entry positive
+        idx = jnp.argmax(jnp.abs(comps), axis=1)
+        signs = jnp.sign(comps[jnp.arange(comps.shape[0]), idx])
+        comps = comps * signs[:, None]
+        total_var = jnp.sum(jnp.var(X, axis=0, ddof=1))
+        return PCAParams(
+            mean=mean,
+            components=comps,
+            explained_variance=eigvals,
+            explained_variance_ratio=eigvals / total_var,
+        )
+
+    @staticmethod
+    def transform(p: PCAParams, X: jax.Array) -> jax.Array:
+        return (X - p.mean) @ p.components.T
+
+    @staticmethod
+    def inverse_transform(p: PCAParams, Z: jax.Array) -> jax.Array:
+        return Z @ p.components + p.mean

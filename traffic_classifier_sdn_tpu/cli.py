@@ -380,12 +380,40 @@ def _run_retrain(args) -> None:
         params = t.fit(tr.X, tr.y, n_classes)
 
     if family != "kmeans":
+        from .analysis import accuracy, confusion_matrix
+
         pred = np.asarray(
             mod.predict(params, jnp.asarray(te.X, jnp.float32))
         )
-        acc = (pred == te.y).mean()
+        acc = float(accuracy(jnp.asarray(te.y), jnp.asarray(pred)))
         print(f"{family} held-out accuracy: {acc:.4f} "
               f"({len(te.y)} rows, classes={list(tr.classes)})")
+        cm = np.asarray(
+            confusion_matrix(
+                jnp.asarray(te.y), jnp.asarray(pred), n_classes
+            )
+        )
+        width = max(8, max(len(c) for c in tr.classes) + 1)
+        print("confusion matrix (rows=true, cols=predicted):")
+        print(" " * width + "".join(f"{c:>{width}}" for c in tr.classes))
+        for i, c in enumerate(tr.classes):
+            print(f"{c:>{width}}" + "".join(
+                f"{v:>{width}}" for v in cm[i]
+            ))
+    else:
+        from .analysis.eval import clustering_accuracy
+
+        cids = np.asarray(
+            mod.predict(params, jnp.asarray(te.X, jnp.float32))
+        )
+        acc = float(
+            clustering_accuracy(
+                jnp.asarray(cids), jnp.asarray(te.y),
+                k=int(params.centers.shape[0]), n_classes=n_classes,
+            )
+        )
+        print(f"kmeans mode-matched clustering accuracy: {acc:.4f} "
+              f"({len(te.y)} rows)")
     if args.native_checkpoint:
         from .io.checkpoint import save_model
 

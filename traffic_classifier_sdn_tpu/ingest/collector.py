@@ -111,6 +111,18 @@ class SubprocessCollector:
         before start)."""
         return self._proc.poll() if self._proc is not None else None
 
+    @property
+    def finished(self) -> bool:
+        """Process exited AND the reader thread has drained the pipe to
+        EOF — only then is every line the monitor ever wrote in the
+        queue. Supervisors must wait for this, not just ``not running``:
+        a fast monitor (cat of a capture) exits while megabytes are
+        still in flight in the pipe."""
+        if self.running:
+            return False
+        t = self._thread
+        return t is None or not t.is_alive()
+
     def stop(self) -> None:
         """Terminate the monitor's process group (the reference's
         ``os.killpg`` teardown at traffic_classifier.py:222)."""

@@ -81,30 +81,35 @@ class SupervisedCollector:
 
     # -- supervision -------------------------------------------------------
     def _check(self) -> None:
-        """Detect a dead monitor and restart it after backoff."""
-        c = self._collector
-        if self._done or (c is not None and c.running):
+        """Detect a dead monitor and restart it after backoff.
+
+        Death is declared only once the collector is ``finished`` — the
+        process exited AND its reader thread hit pipe EOF — so the drain
+        below is complete by construction (no race with late chunks: a
+        fast monitor can exit while most of its output is still in the
+        pipe buffer). The dead incarnation is torn down immediately and
+        exactly once, which also keeps lines_dropped single-counted."""
+        if self._done:
             return
+        c = self._collector
         now = time.monotonic()
-        if self._next_restart_at == 0.0:
-            # just detected the exit: preserve queued output, then decide
-            if c is not None:
-                self._carryover.extend(c.drain())
-                self._dropped_prior += c.lines_dropped
-                if self.raw:
-                    # poison + seam: a NUL makes the dead monitor's
-                    # trailing partial line unparseable (a bare \n would
-                    # *complete* a truncated record, e.g. a half-written
-                    # byte counter), and the \n stops it splicing with
-                    # the new monitor's first bytes
-                    self._carryover.append(b"\x00\n")
-            if (c is not None and c.returncode == 0) or (
-                self.restarts >= self.max_restarts
-            ):
+        if c is not None:
+            if not c.finished:
+                return  # alive, or reader still draining the pipe
+            self._carryover.extend(c.drain())
+            self._dropped_prior += c.lines_dropped
+            rc = c.returncode
+            if self.raw:
+                # poison + seam: a NUL makes the dead monitor's trailing
+                # partial line unparseable (a bare \n would *complete* a
+                # truncated record, e.g. a half-written byte counter),
+                # and the \n stops it splicing with the new monitor's
+                # first bytes
+                self._carryover.append(b"\x00\n")
+            c.stop()
+            self._collector = None
+            if rc == 0 or self.restarts >= self.max_restarts:
                 self._done = True
-                if c is not None:
-                    c.stop()
-                self._collector = None
                 return
             delay = min(
                 self.backoff_cap, self.backoff_base * (2 ** self.restarts)
@@ -113,14 +118,13 @@ class SupervisedCollector:
             if self._metrics is not None:
                 self._metrics.inc("monitor_deaths")
             return
+        # collector already torn down: waiting out the backoff
         if now < self._next_restart_at:
             return
         self._next_restart_at = 0.0
         self.restarts += 1
         if self._metrics is not None:
             self._metrics.inc("monitor_restarts")
-        if c is not None:
-            c.stop()  # reap the old process group
         self.start()
 
     # -- collector surface -------------------------------------------------
