@@ -235,3 +235,25 @@ def test_retrain_kmeans_reports_mode_matched_accuracy(capsys):
     cli.main(["retrain", "kmeans"])
     out = capsys.readouterr().out
     assert "mode-matched clustering accuracy" in out
+
+
+def test_classify_workload_source(capsys, reference_models_dir):
+    import os
+
+    if not os.path.isdir("/root/reference/datasets"):
+        pytest.skip("reference datasets unavailable")
+    cli.main(
+        [
+            "Randomforest",
+            "--source", "workload",
+            "--synthetic-flows", "10",
+            "--checkpoint-dir", reference_models_dir,
+            "--capacity", "64",
+            "--print-every", "2",
+            "--max-ticks", "4",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "Flow ID" in out
+    # the workload's class diversity shows up in the rendered table
+    assert any(c in out for c in ("dns", "ping", "telnet", "game", "voice"))

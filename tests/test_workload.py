@@ -1,0 +1,95 @@
+"""Tests for the class-conditional workload generator (the D-ITG
+stand-in, SURVEY.md §2 C15): protocol correctness, counter monotonicity,
+and labeled end-to-end classification accuracy through the real ingest
+path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+from traffic_classifier_sdn_tpu.ingest.workload import (
+    ClassWorkload,
+    class_delta_pools,
+)
+
+NEEDS_REF = pytest.mark.skipif(
+    not os.path.isdir("/root/reference/datasets"),
+    reason="reference datasets unavailable",
+)
+
+
+@pytest.fixture(scope="module")
+def pools():
+    if not os.path.isdir("/root/reference/datasets"):
+        pytest.skip("reference datasets unavailable")
+    return class_delta_pools("/root/reference/datasets")
+
+
+@NEEDS_REF
+def test_pools_cover_available_classes(pools):
+    assert set(pools) == {"dns", "game", "ping", "telnet", "voice"}
+    for name, pool in pools.items():
+        assert pool.shape[1] == 4
+        assert np.all(pool >= 0)
+
+
+@NEEDS_REF
+def test_workload_emits_monotone_cumulative_counters(pools):
+    wl = ClassWorkload(pools, flows_per_class=2, seed=1)
+    last = {}
+    for _ in range(5):
+        for r in wl.tick():
+            key = (r.eth_src, r.eth_dst)
+            if key in last:
+                assert r.packets >= last[key][0]
+                assert r.bytes >= last[key][1]
+            last[key] = (r.packets, r.bytes)
+    # two records per flow per tick (both directions)
+    assert len(last) == 2 * len(wl.labels)
+
+
+@NEEDS_REF
+def test_workload_e2e_classification_accuracy(pools):
+    """Flows generated from class c's empirical deltas should be
+    classified as c by the reference's best model — the labeled e2e
+    harness the reference could only do with live Mininet+D-ITG runs.
+    Measured: 0.8 majority accuracy (voice/quake overlap accounts for
+    most of the shortfall); gate at 0.7."""
+    if not os.path.exists("/root/reference/models/RandomForestClassifier"):
+        pytest.skip("reference RF checkpoint unavailable")
+    import jax
+
+    from traffic_classifier_sdn_tpu.models import load_reference_model
+
+    wl = ClassWorkload(pools, flows_per_class=8, seed=3)
+    eng = FlowStateEngine(capacity=256)
+    m = load_reference_model(
+        "Randomforest", "/root/reference/models/RandomForestClassifier"
+    )
+    predict = jax.jit(m.predict)
+    n_flows = len(wl.labels)
+    votes = np.zeros((n_flows, len(m.classes.names)), int)
+    slot_of = {}
+    for _ in range(20):
+        eng.ingest(wl.tick())
+        eng.step()
+        if not slot_of:
+            # map flows to slots via the engine's metadata (flow i's
+            # source MAC), not by assuming insertion order
+            mac_to_flow = {wl.flow_macs(i)[0]: i for i in range(n_flows)}
+            for slot, (src, dst) in eng.slot_metadata().items():
+                slot_of[slot] = mac_to_flow[src]
+        idx = np.asarray(predict(m.params, eng.features()))
+        for slot, flow in slot_of.items():
+            votes[flow, idx[slot]] += 1
+    names = list(m.classes.names)
+    pred = [names[votes[i].argmax()] for i in range(n_flows)]
+    acc = np.mean([p == t for p, t in zip(pred, wl.labels)])
+    assert acc >= 0.7
+    # and every class except voice is majority-correct
+    for cls in ("dns", "game", "ping", "telnet"):
+        flows = [i for i, t in enumerate(wl.labels) if t == cls]
+        cls_acc = np.mean([pred[i] == cls for i in flows])
+        assert cls_acc >= 0.5, (cls, cls_acc)

@@ -70,12 +70,14 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--source",
-        choices=("ryu", "controller", "replay", "synthetic"),
+        choices=("ryu", "controller", "replay", "synthetic", "workload"),
         default="ryu",
         help="telemetry source: 'ryu' spawns the reference's monitor "
         "command, 'controller' spawns our own OpenFlow 1.3 controller "
         "(controller/switch.py — no Ryu needed; switches connect to "
-        "--of-port), 'replay' reads --capture, 'synthetic' generates flows",
+        "--of-port), 'replay' reads --capture, 'synthetic' generates "
+        "flows, 'workload' generates class-conditional flows sampled "
+        "from the reference datasets (the D-ITG stand-in)",
     )
     p.add_argument(
         "--of-port", type=int, default=6653,
@@ -171,6 +173,16 @@ def _tick_source(args, raw: bool = False):
         syn = SyntheticFlows(n_flows=args.synthetic_flows)
         while True:
             yield syn.tick()
+    elif args.source == "workload":
+        from .ingest.workload import ClassWorkload, class_delta_pools
+
+        pools = class_delta_pools(args.data_dir)
+        wl = ClassWorkload(
+            pools,
+            flows_per_class=max(1, args.synthetic_flows // len(pools)),
+        )
+        while True:
+            yield wl.tick()
     else:
         from .ingest.collector import DEFAULT_MONITOR_CMD, SubprocessCollector
 

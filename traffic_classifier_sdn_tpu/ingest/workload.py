@@ -1,0 +1,91 @@
+"""Class-conditional workload generation — the framework's equivalent of
+the reference's D-ITG generator scripts (SURVEY.md §2 C15: per-class
+VoIP/Quake3/Telnet/CSa/DNS configs driven through Mininet hosts).
+
+Instead of shaping live packets, flows here are *trace-driven*: each
+generated conversation belongs to a traffic class, and its per-poll
+counter deltas are sampled from that class's rows in the reference
+training CSVs (the empirical per-tick delta distribution the real D-ITG
+traffic produced). The emitted records speak the monitor line protocol
+with cumulative counters, so the whole ingest → flow-table → feature
+path computes the same statistics the classifiers were trained on —
+making this both a demo workload and a labeled end-to-end accuracy
+harness (ground truth is known per flow).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..io.datasets import load_reference_datasets
+from .protocol import TelemetryRecord
+
+# features16 column indices (core/features.py CSV_COLUMNS_16 order)
+_FWD_DELTA_PKTS, _FWD_DELTA_BYTES = 2, 3
+_REV_DELTA_PKTS, _REV_DELTA_BYTES = 10, 11
+
+
+def class_delta_pools(dataset_dir: str) -> dict[str, np.ndarray]:
+    """class name → (M, 4) array of [fwd Δpkts, fwd Δbytes, rev Δpkts,
+    rev Δbytes] per-tick deltas observed in that class's CSV rows."""
+    ds = load_reference_datasets(dataset_dir)
+    names = np.asarray(ds.classes)
+    pools = {}
+    cols = [_FWD_DELTA_PKTS, _FWD_DELTA_BYTES,
+            _REV_DELTA_PKTS, _REV_DELTA_BYTES]
+    for ci, name in enumerate(names):
+        rows = ds.X16[ds.y == ci]
+        pools[str(name)] = np.maximum(rows[:, cols], 0.0)
+    return pools
+
+
+@dataclass
+class ClassWorkload:
+    """A population of flows, each assigned a traffic class, with deltas
+    sampled from the class's empirical pool. Exposes ground truth."""
+
+    pools: dict[str, np.ndarray]
+    flows_per_class: int = 8
+    seed: int = 0
+    start_time: int = 1
+    datapath: str = "1"
+    labels: list = field(init=False)
+
+    def __post_init__(self):
+        self._rng = np.random.RandomState(self.seed)
+        self.classes = sorted(self.pools)
+        self.labels = [
+            c for c in self.classes for _ in range(self.flows_per_class)
+        ]
+        n = len(self.labels)
+        self._cum = np.zeros((n, 4), np.int64)
+        self.t = self.start_time
+
+    def _mac(self, i: int, side: int) -> str:
+        b = (i * 2 + side + 1).to_bytes(6, "big")
+        return ":".join(f"{x:02x}" for x in b)
+
+    def flow_macs(self, i: int) -> tuple[str, str]:
+        return self._mac(i, 0), self._mac(i, 1)
+
+    def tick(self) -> list[TelemetryRecord]:
+        out = []
+        for i, cls in enumerate(self.labels):
+            pool = self.pools[cls]
+            row = pool[self._rng.randint(len(pool))]
+            self._cum[i] += row.astype(np.int64)  # pools are clamped >= 0
+            src, dst = self.flow_macs(i)
+            out.append(TelemetryRecord(
+                time=self.t, datapath=self.datapath, in_port="1",
+                eth_src=src, eth_dst=dst, out_port="2",
+                packets=int(self._cum[i, 0]), bytes=int(self._cum[i, 1]),
+            ))
+            out.append(TelemetryRecord(
+                time=self.t, datapath=self.datapath, in_port="2",
+                eth_src=dst, eth_dst=src, out_port="1",
+                packets=int(self._cum[i, 2]), bytes=int(self._cum[i, 3]),
+            ))
+        self.t += 1
+        return out
