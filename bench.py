@@ -135,12 +135,13 @@ def bench_sklearn_forest(X_np: np.ndarray, sample: int = 65536) -> float:
     clf = RandomForestClassifier(n_estimators=100, random_state=0)
     clf.fit(ds.X, ds.y)
     Xs = X_np[:sample]
+    n = Xs.shape[0]  # may be < sample on small fallback batches
     t0 = time.perf_counter()
     clf.predict(Xs)
     t1 = time.perf_counter()
     clf.predict(Xs)
     t2 = time.perf_counter()
-    return sample / min(t1 - t0, t2 - t1)
+    return n / min(t1 - t0, t2 - t1)
 
 
 def measure(batch: int) -> None:

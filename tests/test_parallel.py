@@ -79,3 +79,42 @@ def test_forest_tree_sharded_matches(reference_models_dir, X256):
     )
     got = np.asarray(fn(X256))
     np.testing.assert_array_equal(got, want)
+
+
+def test_svc_state_sharded_matches(reference_models_dir, flow_dataset):
+    """SV-sharded SVC must reproduce the single-device predict exactly,
+    including the hi/lo precise mode on raw-scale features."""
+    from traffic_classifier_sdn_tpu.models import svc
+    from traffic_classifier_sdn_tpu.parallel import svc_sharded
+
+    d = ski.import_svc(f"{reference_models_dir}/SVC")
+    rng = np.random.RandomState(1)
+    idx = rng.choice(flow_dataset.n, size=256, replace=False)
+    X64 = flow_dataset.X[idx]
+    X_hi, X_lo = svc.split_hilo(X64)
+
+    single = svc.from_numpy(d, dtype=jnp.float32)
+    want = np.asarray(svc.predict(single, X_hi, X_lo))
+
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    dpad = svc_sharded.pad_support(d, 8)
+    params = svc.from_numpy(dpad, dtype=jnp.float32)
+    fn = svc_sharded.sharded_predict(m, params, precise=True)
+    got = np.asarray(fn(X_hi, X_lo))
+    np.testing.assert_array_equal(got, want)
+
+    # plain (non-precise) mode also agrees with its single-device twin
+    want_plain = np.asarray(svc.predict(single, X_hi))
+    fn_plain = svc_sharded.sharded_predict(m, params)
+    np.testing.assert_array_equal(np.asarray(fn_plain(X_hi)), want_plain)
+
+
+def test_svc_sharded_pad_is_noop_when_aligned(reference_models_dir):
+    from traffic_classifier_sdn_tpu.parallel import svc_sharded
+
+    d = ski.import_svc(f"{reference_models_dir}/SVC")
+    S = d["support_vectors"].shape[0]
+    assert svc_sharded.pad_support(d, 1)["support_vectors"].shape[0] == S
+    dpad = svc_sharded.pad_support(d, 8)
+    assert dpad["support_vectors"].shape[0] % 8 == 0
+    assert np.all(dpad["dual_coef"][:, S:] == 0)

@@ -66,7 +66,8 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--data-dir",
         default="/root/reference/datasets",
-        help="training CSV directory (retrain subcommand)",
+        help="training CSV directory (retrain subcommand and "
+        "--source workload)",
     )
     p.add_argument(
         "--source",

@@ -1,0 +1,102 @@
+"""State-sharded RBF-SVC: support vectors split across chips, partial ovo
+decisions psum-reduced over ICI.
+
+libsvm walks all 2281 support vectors sequentially on one CPU (SURVEY.md
+§2.3). Here the (S, F) support-vector matrix and the (P, S) dual
+coefficients shard on the mesh's state axis: each chip computes the RBF
+kernel block against its local SVs and the *partial* pair decision
+``K_local @ coef_localᵀ`` — an (N, P) matrix whose sum over chips is the
+full ovo decision. One ``psum`` merges them (communication O(N·P),
+independent of S, so the SV set scales with the mesh), then votes and
+argmax run replicated.
+
+Same numerical contract as models/svc.py: hi/lo split support vectors,
+difference-form distances, highest-precision matmuls.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..models import svc
+from .mesh import STATE_AXIS
+
+_HI = lax.Precision.HIGHEST
+
+
+def pad_support(d: dict, n_shards: int) -> dict:
+    """Pad the SV count to a multiple of the state-axis size. Padding rows
+    duplicate SV 0 with all-zero dual coefficients, so their kernel values
+    are finite and their decision contribution is exactly zero."""
+    S = np.asarray(d["support_vectors"]).shape[0]
+    pad = (-S) % n_shards
+    if pad == 0:
+        return d
+    out = dict(d)
+    sv = np.asarray(d["support_vectors"], np.float64)
+    out["support_vectors"] = np.concatenate(
+        [sv, np.repeat(sv[:1], pad, axis=0)], axis=0
+    )
+    dual = np.asarray(d["dual_coef"], np.float64)
+    out["dual_coef"] = np.concatenate(
+        [dual, np.zeros((dual.shape[0], pad))], axis=1
+    )
+    return out
+
+
+def sharded_predict(mesh, params: svc.Params, precise: bool = False):
+    """Build a jit-compiled sharded predict: queries replicated on the
+    state axis, SV state sharded. Returns ``fn(X[, X_lo]) -> (N,) int32``.
+
+    ``precise=True`` accepts the hi/lo query split (svc.split_hilo) for
+    float64-parity on raw-counter-scale features."""
+    n_classes = params.n_classes
+    vote_i, vote_j = params.vote_i, params.vote_j
+    intercept, gamma = params.intercept, params.gamma
+
+    in_specs = (
+        P(STATE_AXIS),  # sv_hi rows
+        P(STATE_AXIS),  # sv_lo rows
+        P(None, STATE_AXIS),  # pair_coef columns
+        P(),  # X replicated
+        P(),  # X_lo replicated
+    )
+
+    def local_decision(sv_hi, sv_lo, pair_coef, X, X_lo):
+        diff = X[:, None, :] - sv_hi[None, :, :]
+        diff = diff + (X_lo[:, None, :] - sv_lo[None, :, :])
+        K = jnp.exp(-gamma * jnp.sum(diff * diff, axis=-1))
+        part = jnp.matmul(K, pair_coef.T, precision=_HI)  # (N, P) partial
+        D = lax.psum(part, STATE_AXIS) + intercept[None, :]
+        pos = D > 0
+        votes_i = jax.nn.one_hot(vote_i, n_classes, dtype=D.dtype)
+        votes_j = jax.nn.one_hot(vote_j, n_classes, dtype=D.dtype)
+        votes = jnp.where(pos[:, :, None], votes_i, votes_j).sum(axis=1)
+        # libsvm tie-break: lowest class index among maxima (argmax does
+        # exactly that, matching models/svc.predict)
+        return jnp.argmax(votes, axis=-1).astype(jnp.int32)
+
+    shmapped = jax.shard_map(
+        local_decision,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def fn(X, X_lo=None):
+        if X_lo is None:
+            X_lo = jnp.zeros_like(X)
+        return shmapped(
+            params.sv_hi, params.sv_lo, params.pair_coef, X, X_lo
+        )
+
+    if precise:
+        return fn
+    return lambda X: fn(X)
