@@ -118,3 +118,66 @@ def test_svc_sharded_pad_is_noop_when_aligned(reference_models_dir):
     dpad = svc_sharded.pad_support(d, 8)
     assert dpad["support_vectors"].shape[0] % 8 == 0
     assert np.all(dpad["dual_coef"][:, S:] == 0)
+
+
+def test_distributed_gnb_fit_matches_single_device(flow_dataset):
+    """Batch-sharded GNB moments must reproduce the single-device fit
+    (same math, reductions merely distributed)."""
+    from traffic_classifier_sdn_tpu.models import gnb as gnb_model
+    from traffic_classifier_sdn_tpu.train import gnb as gnb_train
+    from traffic_classifier_sdn_tpu.train.distributed import fit_gnb
+
+    n_classes = len(flow_dataset.classes)
+    single = gnb_train.fit(flow_dataset.X, flow_dataset.y, n_classes)
+    m = meshlib.make_mesh()  # 8-way data parallel
+    dist = fit_gnb(m, flow_dataset.X, flow_dataset.y, n_classes)
+    np.testing.assert_allclose(
+        np.asarray(dist.theta), np.asarray(single.theta), rtol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(dist.inv_var), np.asarray(single.inv_var), rtol=1e-8
+    )
+    np.testing.assert_allclose(
+        np.asarray(dist.log_const), np.asarray(single.log_const), rtol=1e-8
+    )
+    X = jnp.asarray(flow_dataset.X[:512], jnp.float64)
+    np.testing.assert_array_equal(
+        np.asarray(gnb_model.predict(dist, X)),
+        np.asarray(gnb_model.predict(single, X)),
+    )
+
+
+def test_distributed_kmeans_fit_matches_single_device(flow_dataset):
+    from traffic_classifier_sdn_tpu.models import kmeans as kmeans_model
+    from traffic_classifier_sdn_tpu.train import kmeans as kmeans_train
+    from traffic_classifier_sdn_tpu.train.distributed import fit_kmeans
+
+    X = flow_dataset.X[:2048]
+    single, in_single = kmeans_train.fit(X, k=4, n_init=4, n_iter=25, seed=7)
+    m = meshlib.make_mesh()
+    dist, in_dist = fit_kmeans(m, X, k=4, n_init=4, n_iter=25, seed=7)
+    assert in_dist == pytest.approx(in_single, rel=1e-5)
+    Xq = jnp.asarray(X[:512], jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(kmeans_model.predict(dist, Xq)),
+        np.asarray(kmeans_model.predict(single, Xq)),
+    )
+
+
+def test_distributed_gnb_fit_absent_class_matches_single_device(flow_dataset):
+    """A batch missing one class must not NaN-poison the others: the
+    smoothing term comes from the masked rows, matching train/gnb.fit."""
+    from traffic_classifier_sdn_tpu.train import gnb as gnb_train
+    from traffic_classifier_sdn_tpu.train.distributed import fit_gnb
+
+    n_classes = len(flow_dataset.classes) + 1  # one class has no rows
+    single = gnb_train.fit(flow_dataset.X, flow_dataset.y, n_classes)
+    m = meshlib.make_mesh()
+    dist = fit_gnb(m, flow_dataset.X, flow_dataset.y, n_classes)
+    present = np.arange(n_classes - 1)
+    assert np.all(np.isfinite(np.asarray(dist.inv_var)[present]))
+    np.testing.assert_allclose(
+        np.asarray(dist.inv_var)[present],
+        np.asarray(single.inv_var)[present],
+        rtol=1e-8,
+    )

@@ -1,0 +1,101 @@
+"""Data-parallel training: the flow batch sharded across the mesh, the
+model state replicated — XLA inserts the cross-chip reductions from the
+sharding annotations (the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA place the collectives on ICI).
+
+The reference trains everything single-threaded inside sklearn's C
+(SURVEY.md §2.3-2.4, no parallelism of any kind). Here the closed-form
+fits (GNB moments, Lloyd iterations) and the SGD logreg step consume a
+batch-sharded (N, F) matrix directly: per-class one-hot segment sums,
+center updates, and gradients are all contractions over the sharded N
+axis, which XLA lowers to local partial sums + ``psum`` over the data
+axis. The returned params are replicated and bit-match the single-device
+fit up to reduction-order rounding (tests gate argmax/assignment parity).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models import gnb as gnb_model, kmeans as kmeans_model
+from ..parallel.mesh import batch_sharded
+from . import gnb as gnb_train, kmeans as kmeans_train
+
+
+def _data_size(mesh) -> int:
+    return mesh.shape["data"]
+
+
+def fit_gnb(mesh, X, y, n_classes: int, *,
+            var_smoothing: float = 1e-9) -> gnb_model.Params:
+    """Distributed GaussianNB fit: one pass of sharded segment moments.
+    Same math as train/gnb.fit (two-pass centered variance, sklearn's
+    global-variance smoothing), with N sharded over the data axis.
+
+    N is padded to a multiple of the data-axis size with ``y = -1``
+    sentinel rows: their one-hot is all zeros, so every segment sum
+    excludes them, and the global-variance smoothing term masks them
+    explicitly — the fit is exact, no row dropped or double-counted."""
+    import numpy as np
+
+    d = _data_size(mesh)
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.int32)
+    pad = (-len(y)) % d
+    if pad:
+        X = np.concatenate([X, np.zeros((pad, X.shape[1]))], axis=0)
+        y = np.concatenate([y, np.full(pad, -1, np.int32)])
+    Xs = jax.device_put(jnp.asarray(X), batch_sharded(mesh))
+    ys = jax.device_put(jnp.asarray(y), batch_sharded(mesh))
+
+    @jax.jit
+    def _fit(X, y):
+        # moments() is sentinel-safe: one_hot(-1) is a zero row, so
+        # padding contributes to no count/sum/square (the mean[y] gather
+        # wraps, but its rows are masked by the same zero one-hot)
+        counts, theta, var = gnb_train.moments(X, y, n_classes)
+        total = jnp.sum(counts)
+        mask = (y >= 0).astype(X.dtype)
+        # global mean straight from the masked rows — NOT from
+        # theta·counts, where an absent class's 0/0 theta would
+        # NaN-poison the smoothing term for every class
+        mu_all = jnp.sum(mask[:, None] * X, axis=0) / total
+        global_var = (
+            jnp.sum(mask[:, None] * (X - mu_all) ** 2, axis=0) / total
+        )
+        var = var + var_smoothing * jnp.max(global_var)
+        prior = counts / total
+        return theta, var, prior
+
+    theta, var, prior = _fit(Xs, ys)
+    return gnb_model.from_numpy(
+        {
+            "theta": np.asarray(theta),
+            "var": np.asarray(var),
+            "class_prior": np.asarray(prior),
+        }
+    )
+
+
+def fit_kmeans(mesh, X, k: int = 4, *, n_init: int = 10, n_iter: int = 50,
+               seed: int = 0) -> tuple[kmeans_model.Params, float]:
+    """Distributed Lloyd: assignments and center sums contract over the
+    sharded N axis (local partials + psum); k-means++ seeding and the
+    n_init tournament run replicated. Same implementation as
+    train/kmeans — only the input sharding differs.
+
+    N is trimmed to a multiple of the data-axis size (at most
+    devices−1 rows — immaterial for Lloyd's center means; padding can't
+    be made assignment-neutral without reweighting every step)."""
+    import numpy as np
+
+    d = _data_size(mesh)
+    X = np.asarray(X)
+    X = X[: len(X) - (len(X) % d)]
+    Xs = jax.device_put(jnp.asarray(X, jnp.float32), batch_sharded(mesh))
+    centers, inertia = kmeans_train._fit_impl(
+        jax.random.key(seed), Xs, k, n_init, n_iter
+    )
+    params = kmeans_model.from_numpy({"cluster_centers": np.asarray(centers)})
+    return params, float(inertia)

@@ -26,7 +26,13 @@ def moments(X, y, n_classes: int):
     # Two-pass variance: E[x²]−E[x]² cancels catastrophically on this data
     # (x ~ 1e8 → x² ~ 1e16 vs small within-class variance); centering first
     # keeps full relative precision and matches sklearn's np.var.
-    centered = X - mean[y]  # (N, F) per-row class-mean subtraction
+    # nan_to_num guards the gather: an empty class has 0/0 NaN mean, and a
+    # row whose label gathers it (e.g. the distributed fit's padding
+    # sentinel wrapping to an empty last class) would turn 0·NaN into NaN
+    # inside the masked matmul, poisoning every class's variance. Rows
+    # with real labels always gather a finite mean, so this changes
+    # nothing for them.
+    centered = X - jnp.nan_to_num(mean)[y]  # (N, F) class-mean subtraction
     sq_sums = jnp.matmul(onehot.T, centered * centered, precision=_HI)
     var = sq_sums / counts[:, None]
     return counts, mean, var
