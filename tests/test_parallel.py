@@ -181,3 +181,18 @@ def test_distributed_gnb_fit_absent_class_matches_single_device(flow_dataset):
         np.asarray(single.inv_var)[present],
         rtol=1e-8,
     )
+
+
+def test_knn_ring_merge_matches_single_device(reference_models_dir, X256):
+    """The ppermute ring merge must equal both the all_gather merge and
+    the single-device predict exactly, ties included."""
+    d = ski.import_knn(f"{reference_models_dir}/KNeighbors")
+    single = knn.from_numpy(d, dtype=jnp.float32)
+    want = np.asarray(knn.predict(single, X256))
+
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    dpad = knn_sharded.pad_corpus(d, 8)
+    params = knn.from_numpy(dpad, dtype=jnp.float32)
+    ring = knn_sharded.ring_predict(m, params, pad_mask=dpad.get("pad_mask"))
+    got = np.asarray(ring(X256))
+    np.testing.assert_array_equal(got, want)

@@ -14,8 +14,6 @@ recipe: pick the mesh, shard the state, let the collectives ride ICI.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -49,57 +47,144 @@ def pad_corpus(d: dict, n_shards: int) -> dict:
     return out
 
 
-def sharded_predict(mesh, params: knn.Params, pad_mask=None):
-    """Build a jit-compiled sharded predict: X replicated per-chip on the
-    state axis (each chip sees the full query batch), corpus sharded.
+def _mask_half_norms(params: knn.Params, pad_mask):
+    half = params.half_sq_norms
+    if pad_mask is not None:
+        half = jnp.where(jnp.asarray(pad_mask), jnp.inf, half)
+    return half
 
-    Returns ``fn(X) -> (N,) int32``.
-    """
-    n_classes = params.n_classes
-    k = params.n_neighbors
 
+def _local_topk(fit_X, fit_y, half_norms, X, k):
+    """Per-chip candidates: (val, label, global corpus index), each (N, k).
+
+    Similarity is the half-norm trick ``x·s − ‖s‖²/2`` (argmax-equivalent
+    to −‖x−s‖²/2); +inf half-norms exclude padding rows. The global index
+    is the tie-break key: single-device ``top_k`` prefers the lowest
+    corpus index among equal distances (the data has duplicate rows, so
+    ties are real), and every merge strategy must reproduce that."""
+    me = lax.axis_index(STATE_AXIS)
+    sim = (
+        jnp.matmul(X, fit_X.T, precision=lax.Precision.HIGHEST)
+        - half_norms[None, :]
+    )
+    val, idx = lax.top_k(sim, k)
+    lab = fit_y[idx].astype(jnp.int32)
+    gidx = (idx + me * fit_X.shape[0]).astype(jnp.int32)
+    return val, lab, gidx
+
+
+def _vote(lab, n_classes):
+    votes = jnp.sum(jax.nn.one_hot(lab, n_classes, dtype=jnp.int32), axis=1)
+    return jnp.argmax(votes, axis=-1).astype(jnp.int32)
+
+
+def _build(mesh, params: knn.Params, pad_mask, local_fn):
+    """Common scaffolding: shard the corpus on the state axis, replicate
+    the queries, jit the shard_mapped kernel."""
     in_specs = (
         P(STATE_AXIS),  # fit_X rows
         P(STATE_AXIS),  # fit_y
         P(STATE_AXIS),  # half_sq_norms (+inf at padding)
         P(),  # X replicated
     )
-
-    def local_topk(fit_X, fit_y, half_norms, X):
-        sim = (
-            jnp.matmul(X, fit_X.T, precision=lax.Precision.HIGHEST)
-            - half_norms[None, :]
-        )
-        val, idx = lax.top_k(sim, k)  # local (N, k)
-        lab = fit_y[idx]
-        # merge across the state axis: gather every chip's candidates
-        all_val = lax.all_gather(val, STATE_AXIS, axis=0)  # (D, N, k)
-        all_lab = lax.all_gather(lab, STATE_AXIS, axis=0)
-        D = all_val.shape[0]
-        N = all_val.shape[1]
-        merged_val = jnp.moveaxis(all_val, 0, 1).reshape(N, D * k)
-        merged_lab = jnp.moveaxis(all_lab, 0, 1).reshape(N, D * k)
-        gval, gidx = lax.top_k(merged_val, k)  # global top-k
-        glab = jnp.take_along_axis(merged_lab, gidx, axis=1)
-        votes = jnp.sum(
-            jax.nn.one_hot(glab, n_classes, dtype=jnp.int32), axis=1
-        )
-        return jnp.argmax(votes, axis=-1).astype(jnp.int32)
-
     shmapped = jax.shard_map(
-        local_topk,
+        local_fn,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=P(),
         check_vma=False,
     )
-
-    half = params.half_sq_norms
-    if pad_mask is not None:
-        half = jnp.where(jnp.asarray(pad_mask), jnp.inf, half)
+    half = _mask_half_norms(params, pad_mask)
 
     @jax.jit
     def fn(X):
         return shmapped(params.fit_X, params.fit_y, half, X)
 
     return fn
+
+
+def sharded_predict(mesh, params: knn.Params, pad_mask=None):
+    """all_gather merge: every chip gathers all candidates and reduces.
+    Communication O(devices·k) per query; one collective per predict.
+
+    Returns ``fn(X) -> (N,) int32``.
+    """
+    n_classes = params.n_classes
+    k = params.n_neighbors
+
+    def local_topk(fit_X, fit_y, half_norms, X):
+        val, lab, _ = _local_topk(fit_X, fit_y, half_norms, X, k)
+        all_val = lax.all_gather(val, STATE_AXIS, axis=0)  # (D, N, k)
+        all_lab = lax.all_gather(lab, STATE_AXIS, axis=0)
+        D, N = all_val.shape[0], all_val.shape[1]
+        # gathered column order == global corpus order, so plain top_k
+        # keeps the single-device tie-break
+        merged_val = jnp.moveaxis(all_val, 0, 1).reshape(N, D * k)
+        merged_lab = jnp.moveaxis(all_lab, 0, 1).reshape(N, D * k)
+        _, gsel = lax.top_k(merged_val, k)
+        glab = jnp.take_along_axis(merged_lab, gsel, axis=1)
+        return _vote(glab, n_classes)
+
+    return _build(mesh, params, pad_mask, local_topk)
+
+
+def ring_predict(mesh, params: knn.Params, pad_mask=None):
+    """Ring merge: the candidate block circulates around the state axis
+    with ``ppermute`` — the ring-attention neighbor-passing schedule
+    applied to top-k merge. Live state per chip is O(N·k), independent of
+    device count, and the schedule is software-pipelined: each iteration
+    forwards the block it holds and merges the block received on the
+    *previous* hop, so the merge compute has no data dependence on the
+    in-flight collective and XLA can overlap the two.
+
+    Exactly equivalent to ``sharded_predict`` (same candidates, same
+    tie-break); preferable on large meshes where the gathered (D, N, k)
+    buffer would dominate memory.
+    """
+    n_classes = params.n_classes
+    k = params.n_neighbors
+
+    def local_ring(fit_X, fit_y, half_norms, X):
+        n_dev = lax.axis_size(STATE_AXIS)
+        val, lab, gidx = _local_topk(fit_X, fit_y, half_norms, X, k)
+        if n_dev == 1:
+            return _vote(lab, n_classes)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+        def rotate(v, ints):
+            # one f32 + one packed int32 payload per hop (labels and
+            # indices ride together: fewer collective launches)
+            return (
+                lax.ppermute(v, STATE_AXIS, perm),
+                lax.ppermute(ints, STATE_AXIS, perm),
+            )
+
+        def merge(av, al, ai, bv, bl, bi):
+            neg = jnp.concatenate([-av, -bv], axis=1)  # (N, 2k)
+            mi = jnp.concatenate([ai, bi], axis=1)
+            ml = jnp.concatenate([al, bl], axis=1)
+            # lexicographic: similarity desc, then global index asc —
+            # bit-identical to top_k over the corpus-ordered row
+            sneg, si, sl = lax.sort((neg, mi, ml), num_keys=2)
+            return -sneg[:, :k], sl[:, :k], si[:, :k]
+
+        ints0 = jnp.concatenate([lab, gidx], axis=1)  # (N, 2k) packed
+        # prologue: issue hop 1
+        in_v, in_ints = rotate(val, ints0)
+
+        def body(_, carry):
+            av, al, ai, pv, pints = carry
+            nv, nints = rotate(pv, pints)  # forward the held block
+            av, al, ai = merge(  # merge it while the transfer flies
+                av, al, ai, pv, pints[:, :k], pints[:, k:]
+            )
+            return av, al, ai, nv, nints
+
+        av, al, ai, lv, lints = lax.fori_loop(
+            0, n_dev - 2, body, (val, lab, gidx, in_v, in_ints)
+        )
+        # epilogue: merge the final in-flight block
+        av, al, ai = merge(av, al, ai, lv, lints[:, :k], lints[:, k:])
+        return _vote(al, n_classes)
+
+    return _build(mesh, params, pad_mask, local_ring)
