@@ -27,6 +27,15 @@ import pytest  # noqa: E402
 
 REFERENCE_ROOT = "/root/reference"
 
+# Point the CLI's env-var checkpoint/data-dir resolution at the reference
+# tree (the product defaults are relative paths; cli.py:_DEFAULT_CKPT_DIR).
+os.environ.setdefault(
+    "TCSDN_MODELS_DIR", os.path.join(REFERENCE_ROOT, "models")
+)
+os.environ.setdefault(
+    "TCSDN_DATA_DIR", os.path.join(REFERENCE_ROOT, "datasets")
+)
+
 
 @pytest.fixture(scope="session")
 def reference_models_dir():

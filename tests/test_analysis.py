@@ -187,3 +187,21 @@ def test_kmeans_mode_matching_matches_notebook(ref_ds):
     )
     assert acc >= notebook_acc
     assert acc == pytest.approx(0.610, abs=0.02)
+
+
+def test_cli_analyze_writes_figures(tmp_path, capsys, reference_datasets_dir):
+    """`analyze` renders all four C13 notebook figures (1_log_Kmeans.ipynb
+    cells 70-129) and prints the headline analysis numbers."""
+    from traffic_classifier_sdn_tpu import cli
+
+    cli.main([
+        "analyze", "--data-dir", reference_datasets_dir,
+        "--out", str(tmp_path),
+    ])
+    out = capsys.readouterr().out
+    assert "PCA-2 explained variance" in out
+    assert "logreg accuracy" in out
+    for name in ("pca_scatter", "decision_boundary", "cluster_centers",
+                 "cluster_scatter"):
+        p = tmp_path / f"{name}.png"
+        assert p.exists() and p.stat().st_size > 5000, name

@@ -189,3 +189,35 @@ def test_python_fallback_cr_framing_matches_native():
         nat = FlowStateEngine(capacity=8, native=True)
         assert py.ingest_bytes(data) == want
         assert nat.ingest_bytes(data) == want
+
+
+def test_malformed_counters_rejected_by_both_paths():
+    """Negative or >int64 packet/byte counters are corrupt lines (a real
+    OFPFlowStats counter is a cumulative uint); both parsers reject them
+    identically — the C++ path previously cast negatives to ~1.8e19 via
+    uint64_t and had signed-overflow UB on >19-digit fields (ADVICE r1)."""
+    from traffic_classifier_sdn_tpu.ingest.protocol import parse_line
+
+    base = b"data\t3\t1\t1\taa\tbb\t2\t%s\t%s\n"
+    cases = [
+        (b"-5", b"400"),
+        (b"10", b"-400"),
+        (b"99999999999999999999", b"400"),  # > INT64_MAX
+        (b"10", b"18446744073709551616"),   # > UINT64_MAX too
+    ]
+    for pk, by in cases:
+        line = base % (pk, by)
+        assert parse_line(line) is None, line
+        nat = FlowStateEngine(capacity=8, native=True)
+        py = FlowStateEngine(capacity=8, native=False)
+        assert nat.ingest_bytes(line) == 0
+        assert py.ingest_bytes(line) == 0
+    ok = base % (b"10", b"400")
+    assert parse_line(ok) is not None
+    nat = FlowStateEngine(capacity=8, native=True)
+    assert nat.ingest_bytes(ok) == 1
+    # poison-seam fragment: a truncated counter followed by the \x00 seam
+    # (collector.py raw-mode overflow) must not parse as a smaller value
+    assert FlowStateEngine(capacity=8, native=True).ingest_bytes(
+        b"data\t3\t1\t1\taa\tbb\t2\t10\t40\x00\n"
+    ) == 0

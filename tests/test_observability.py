@@ -257,3 +257,40 @@ def test_supervisor_no_race_with_fast_finishing_monitor():
     assert sup.restarts == 0
     assert len(got) == n
     sup.stop()
+
+
+def test_collector_raw_overflow_poisons_seam():
+    """Raw-mode queue overflow prefixes the next queued chunk with a
+    b"\\x00\\n" poison seam (not a bare newline): the pre-gap partial line
+    gets a NUL appended, so a truncated counter can't complete into a
+    smaller-but-valid value after the gap (ADVICE r1, collector.py)."""
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+    from traffic_classifier_sdn_tpu.ingest.collector import SubprocessCollector
+
+    c = SubprocessCollector("true", queue_size=1, raw=True)
+    got = []
+
+    pre_gap = b"data\t1\t1\t1\taa\tbb\t2\t10\t40"  # truncated mid-counter
+    dropped = b"0\t4000\ndata\t1\t1\t1\tcc\tdd\t2\t7\t700\n"
+    post_gap = b"data\t2\t1\t1\taa\tbb\t2\t10\t4000\n"
+
+    class Stream:
+        chunks = [pre_gap, dropped, post_gap]
+
+        def read1(self, n):
+            if not Stream.chunks:
+                return b""
+            if len(Stream.chunks) == 1:
+                got.extend(c.poll_records())  # consumer drains mid-stream
+            return Stream.chunks.pop(0)
+
+    c._proc = type("P", (), {"stdout": Stream(), "poll": lambda s: 0})()
+    c._reader()
+    got.extend(c.poll_records())
+    data = b"".join(got)
+    assert data == pre_gap + b"\x00\n" + post_gap
+    assert c.lines_dropped == dropped.count(b"\n")
+    # end to end: the spliced stream yields exactly the post-gap record —
+    # the poisoned pre-gap fragment must not parse
+    eng = FlowStateEngine(capacity=8, native=False)
+    assert eng.ingest_bytes(data) == 1

@@ -1,0 +1,187 @@
+"""C13 analysis figures — the visual half of the reference's analysis
+notebook, rendered from the on-device analysis stack.
+
+Reproduces the four figure families of ``models/notebooks.zip!notebooks/
+1_log_Kmeans.ipynb`` cells 70-129 (the round-1 gap VERDICT item 7):
+
+- cell 85: PCA-2 scatter of the scaled features, colored by traffic type;
+- cell 98: logistic-regression decision boundaries in PCA-2 space
+  (contourf over a meshgrid + the class scatter);
+- cell 112: per-class cluster-center strips (each KMeans center as a
+  1×12 heatmap);
+- cell 126: side-by-side PCA-2 scatters of learned cluster ids vs true
+  labels (the notebook's KMeans-on-raw-PCA comparison, cells 122-126).
+
+All numerics run through the framework's own kernels (analysis.preprocess
+scaler/PCA, train.logreg, train.kmeans) — matplotlib only draws.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+
+def _scatter_by_class(ax, Z, y, classes):
+    cmap = plt.cm.get_cmap("tab10")
+    for i, name in enumerate(classes):
+        m = y == i
+        ax.scatter(Z[m, 0], Z[m, 1], s=8, alpha=0.6,
+                   color=cmap(i % 10), label=str(name))
+
+
+def fig_pca_scatter(Z, y, classes, path: str) -> None:
+    """Cell 85: PCA-2 embedding colored by true traffic type."""
+    fig, ax = plt.subplots(figsize=(10, 6))
+    _scatter_by_class(ax, Z, y, classes)
+    ax.set_xlabel("First Principal Component", fontsize=15)
+    ax.set_ylabel("Second Principal Component", fontsize=15)
+    ax.legend(fontsize=12)
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+
+
+def fig_decision_boundary(Z, y, classes, predict_grid, path: str,
+                          spacing: float = 0.05) -> None:
+    """Cell 98: contourf of a classifier's prediction over a PCA-2
+    meshgrid, overlaid with the class scatter. ``predict_grid`` maps an
+    (M, 2) array of PCA coordinates to int class ids."""
+    x_min, x_max = Z[:, 0].min() - 1, Z[:, 0].max() + 1
+    y_min, y_max = Z[:, 1].min() - 1, Z[:, 1].max() + 1
+    xx, yy = np.meshgrid(
+        np.arange(x_min, x_max, spacing), np.arange(y_min, y_max, spacing)
+    )
+    grid = np.stack([xx.ravel(), yy.ravel()], axis=1).astype(np.float32)
+    zz = np.asarray(predict_grid(grid)).reshape(xx.shape)
+    fig, ax = plt.subplots(figsize=(10, 6))
+    ax.contourf(xx, yy, zz, cmap=plt.cm.Spectral, alpha=0.8,
+                levels=np.arange(len(classes) + 1) - 0.5)
+    _scatter_by_class(ax, Z, y, classes)
+    ax.set_title("Decision Boundaries", fontsize=15)
+    ax.set_xlabel("First Principal Component", fontsize=15)
+    ax.set_ylabel("Second Principal Component", fontsize=15)
+    ax.set_xlim(x_min, x_max)
+    ax.set_ylim(y_min, y_max)
+    ax.legend(fontsize=12)
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+
+
+def fig_cluster_centers(centers, names, path: str) -> None:
+    """Cell 112: each cluster center as a 1×F binary-cmap strip."""
+    k = centers.shape[0]
+    ncols = 2
+    nrows = (k + ncols - 1) // ncols
+    fig = plt.figure(figsize=(8, 1.6 * nrows))
+    for i in range(k):
+        ax = fig.add_subplot(nrows, ncols, 1 + i, xticks=[], yticks=[])
+        ax.set_title(str(names[i]))
+        ax.imshow(centers[i].reshape(1, -1), cmap=plt.cm.binary,
+                  aspect="auto")
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+
+
+def fig_cluster_scatter(Z, clusters, y, path: str) -> None:
+    """Cell 126: learned cluster ids vs true labels, side by side."""
+    k = int(max(clusters.max(), y.max())) + 1
+    kwargs = dict(cmap=plt.cm.get_cmap("rainbow", k), edgecolor="none",
+                  alpha=0.6, s=8)
+    fig, ax = plt.subplots(1, 2, figsize=(9, 4))
+    ax[0].scatter(Z[:, 0], Z[:, 1], c=clusters, **kwargs)
+    ax[0].set_title("learned cluster labels")
+    ax[1].scatter(Z[:, 0], Z[:, 1], c=y, **kwargs)
+    ax[1].set_title("true labels")
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+
+
+def save_all(ds, out_dir: str, seed: int = 101) -> dict:
+    """Render every C13 figure for a FlowDataset; returns
+    {figure_name: path} plus the headline analysis numbers (PCA-2
+    explained variance, PCA-space logreg accuracy, cluster accuracy)."""
+    import jax.numpy as jnp
+
+    from ..train import kmeans as kmeans_train
+    from ..train import logreg as logreg_train
+    from . import eval as ev
+    from .preprocess import PCA, StandardScaler
+
+    os.makedirs(out_dir, exist_ok=True)
+    X = jnp.asarray(ds.X, jnp.float64)
+    y = np.asarray(ds.y)
+    k = len(ds.classes)
+
+    # scaled PCA-2 embedding (cells 70-85)
+    sp = StandardScaler.fit(X)
+    Xs = StandardScaler.transform(sp, X)
+    pp = PCA.fit(Xs, 2)
+    Z = np.asarray(PCA.transform(pp, Xs))
+    evr = float(np.sum(np.asarray(pp.explained_variance_ratio)))
+    paths = {"pca_scatter": os.path.join(out_dir, "pca_scatter.png")}
+    fig_pca_scatter(Z, y, ds.classes, paths["pca_scatter"])
+
+    # logreg decision boundary in PCA space (cells 89-98); split on the
+    # embedded coordinates directly (70/30, notebook cell 91)
+    from ..models import logreg as logreg_model
+
+    rng = np.random.RandomState(seed)
+    perm = rng.permutation(len(Z))
+    n_te = int(round(len(Z) * 0.3))
+    te_idx, tr_idx = perm[:n_te], perm[n_te:]
+    lp = logreg_train.fit(Z[tr_idx], y[tr_idx], k)
+    pred = np.asarray(
+        logreg_model.predict(lp, jnp.asarray(Z[te_idx], jnp.float32))
+    )
+    pca_logreg_acc = float(np.mean(pred == y[te_idx]))
+    paths["decision_boundary"] = os.path.join(
+        out_dir, "decision_boundary.png"
+    )
+    fig_decision_boundary(
+        Z, y, ds.classes,
+        lambda G: logreg_model.predict(lp, jnp.asarray(G)),
+        paths["decision_boundary"],
+    )
+
+    # KMeans on scaled features: center strips (cells 104-112)
+    kp, _ = kmeans_train.fit(np.asarray(Xs), k=k, seed=0)
+    centers_scaled = np.asarray(kp.centers)
+    paths["cluster_centers"] = os.path.join(out_dir, "cluster_centers.png")
+    fig_cluster_centers(
+        centers_scaled, [f"cluster {i}" for i in range(k)],
+        paths["cluster_centers"],
+    )
+
+    # KMeans on raw-PCA coordinates: side-by-side scatter (cells 122-126)
+    pr = PCA.fit(X, 2)
+    Zr = np.asarray(PCA.transform(pr, X))
+    kp2, _ = kmeans_train.fit(Zr, k=k, seed=0)
+    from ..models import kmeans as kmeans_model
+
+    clusters = np.asarray(
+        kmeans_model.predict(kp2, jnp.asarray(Zr, jnp.float32))
+    )
+    cluster_acc = float(
+        ev.clustering_accuracy(
+            jnp.asarray(clusters), jnp.asarray(y), k, len(ds.classes)
+        )
+    )
+    paths["cluster_scatter"] = os.path.join(out_dir, "cluster_scatter.png")
+    fig_cluster_scatter(Zr, clusters, y, paths["cluster_scatter"])
+
+    return {
+        "paths": paths,
+        "pca2_explained_variance": evr,
+        "pca_logreg_accuracy": pca_logreg_acc,
+        "cluster_accuracy": cluster_acc,
+    }

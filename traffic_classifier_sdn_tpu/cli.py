@@ -19,6 +19,7 @@ batched predict over the whole table → label decode → table render.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -27,6 +28,7 @@ import numpy as np
 SUBCOMMANDS = (
     "train",
     "retrain",
+    "analyze",
     "logistic",
     "kmeans",
     "knearest",
@@ -37,9 +39,14 @@ SUBCOMMANDS = (
     "gaussiannb",
 )
 
-# reference model-file names under --checkpoint-dir
-# (traffic_classifier.py:230-240)
-_DEFAULT_CKPT_DIR = "/root/reference/models"
+# Checkpoint-dir resolution (traffic_classifier.py:230-240 hardcodes
+# relative "models/" paths; we resolve: --checkpoint-dir > config file >
+# $TCSDN_MODELS_DIR > ./models). Read at call time so tests/conftest can
+# point the env at the reference tree before invoking main().
+
+
+def _default_ckpt_dir() -> str:
+    return os.environ.get("TCSDN_MODELS_DIR", "models")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -65,9 +72,10 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--data-dir",
-        default="/root/reference/datasets",
+        default=os.environ.get("TCSDN_DATA_DIR", "datasets"),
         help="training CSV directory (retrain subcommand and "
-        "--source workload)",
+        "--source workload; default $TCSDN_DATA_DIR or ./datasets, "
+        "the reference repo's own layout)",
     )
     p.add_argument(
         "--source",
@@ -96,7 +104,21 @@ def _build_parser() -> argparse.ArgumentParser:
         "--checkpoint-dir",
         default=None,
         help="directory with reference-format model checkpoints "
-        f"(default {_DEFAULT_CKPT_DIR})",
+        f"(default {_default_ckpt_dir()})",
+    )
+    p.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=None,
+        help="retrain: save train state every N SGD steps (logreg; "
+        "0 = off, default from config train.checkpoint_every). With "
+        "--train-state-dir, an interrupted retrain resumes from the last "
+        "saved step and converges bit-identically.",
+    )
+    p.add_argument(
+        "--train-state-dir",
+        default=None,
+        help="retrain: directory for resumable train-state checkpoints",
     )
     p.add_argument("--capacity", type=int, default=None)
     p.add_argument(
@@ -347,6 +369,31 @@ def _run_train(args) -> None:
     print(f"wrote {out_path}")
 
 
+def _run_analyze(args) -> None:
+    """C13 analysis extras: the reference notebook's scaler/PCA numbers
+    AND its figures (1_log_Kmeans.ipynb cells 70-129), rendered by
+    analysis/figures.py from the on-device kernels. PNGs land in --out
+    (default ./analysis_out)."""
+    from .analysis import figures
+    from .io.datasets import load_reference_datasets
+
+    out_dir = args.out or "analysis_out"
+    ds = load_reference_datasets(args.data_dir)
+    res = figures.save_all(ds, out_dir)
+    print(
+        f"PCA-2 explained variance: "
+        f"{res['pca2_explained_variance'] * 100:.2f}%"
+    )
+    print(
+        f"PCA-space logreg accuracy (70/30): "
+        f"{res['pca_logreg_accuracy'] * 100:.2f}%"
+    )
+    print(f"cluster accuracy (mode-matched): "
+          f"{res['cluster_accuracy'] * 100:.2f}%")
+    for name, path in res["paths"].items():
+        print(f"wrote {name}: {path}")
+
+
 def _run_retrain(args) -> None:
     """On-device retraining from the training CSVs (the C12 notebook
     pipeline, SURVEY.md §3.4) + native checkpoint save."""
@@ -369,7 +416,25 @@ def _run_retrain(args) -> None:
     if family == "logreg":
         from .train import logreg as t
 
-        params = t.fit(tr.X, tr.y, n_classes)
+        ckpt_every = getattr(args, "checkpoint_every", 0) or 0
+        if ckpt_every > 0 and not args.train_state_dir:
+            sys.exit(
+                "ERROR: --checkpoint-every needs --train-state-dir (the "
+                "resumable SGD path has nowhere to save state)"
+            )
+        if ckpt_every > 0:
+            # Resumable streaming path: consumes train.checkpoint_every;
+            # a killed run re-invoked with the same --train-state-dir
+            # resumes from the last saved step (train/logreg.fit_sgd).
+            params = t.fit_sgd(
+                tr.X,
+                tr.y,
+                n_classes,
+                checkpoint_dir=args.train_state_dir,
+                checkpoint_every=ckpt_every,
+            )
+        else:
+            params = t.fit(tr.X, tr.y, n_classes)
     elif family == "gnb":
         from .train import gnb as t
 
@@ -459,6 +524,8 @@ def main(argv=None) -> None:
             args.checkpoint_dir = cfg.model.checkpoint_dir
         if args.native_checkpoint is None:
             args.native_checkpoint = cfg.model.native_checkpoint
+        if args.checkpoint_every is None:
+            args.checkpoint_every = cfg.train.checkpoint_every
     # unset sentinels → built-in defaults
     if args.capacity is None:
         args.capacity = 65536
@@ -469,12 +536,14 @@ def main(argv=None) -> None:
     if args.duration is None:
         args.duration = 15 * 60
     if args.checkpoint_dir is None:
-        args.checkpoint_dir = _DEFAULT_CKPT_DIR
+        args.checkpoint_dir = _default_ckpt_dir()
 
     if args.subcommand == "train":
         _run_train(args)
     elif args.subcommand == "retrain":
         _run_retrain(args)
+    elif args.subcommand == "analyze":
+        _run_analyze(args)
     else:
         _run_classify(args)
 

@@ -41,7 +41,9 @@ class ModelConfig:
     """Model family + checkpoint selection."""
 
     name: str = "forest"  # MODEL_MODULES key
-    checkpoint_dir: str = "/root/reference/models"
+    # resolution: CLI --checkpoint-dir > this field > $TCSDN_MODELS_DIR >
+    # ./models (the reference's own relative layout, traffic_classifier.py:230)
+    checkpoint_dir: str | None = None
     native_checkpoint: str | None = None  # io/checkpoint.py dir (wins)
     dtype: str = "float32"
 

@@ -64,10 +64,16 @@ class SubprocessCollector:
                 if not chunk:
                     break
                 if drop_seam:
-                    # a dropped chunk broke line framing: force a break so
+                    # a dropped chunk broke line framing: poison the seam so
                     # the fragments on either side of the gap can't splice
-                    # into one corrupted-but-parseable record
-                    chunk = b"\n" + chunk
+                    # into one corrupted-but-parseable record. A bare "\n"
+                    # is not enough — it would *terminate* the pre-gap
+                    # partial line, letting a truncated counter parse as a
+                    # smaller valid value (garbage negative delta). The NUL
+                    # makes the pre-gap fragment unparseable (fails the
+                    # data-prefix match / int parse), mirroring the
+                    # supervisor's restart poison seam.
+                    chunk = b"\x00\n" + chunk
                 try:
                     self._queue.put_nowait(chunk)
                     drop_seam = False

@@ -21,6 +21,7 @@ import hashlib
 from dataclasses import dataclass
 
 PREFIX = b"data"
+_I64_MAX = (1 << 63) - 1
 
 
 @dataclass(frozen=True)
@@ -61,7 +62,7 @@ def parse_line(line: bytes) -> TelemetryRecord | None:
     if len(fields) < 8:
         return None
     try:
-        return TelemetryRecord(
+        r = TelemetryRecord(
             time=int(fields[0]),
             datapath=fields[1].decode(),
             in_port=fields[2].decode(),
@@ -73,6 +74,15 @@ def parse_line(line: bytes) -> TelemetryRecord | None:
         )
     except (ValueError, UnicodeDecodeError):
         return None
+    # Counters are cumulative OFPFlowStats values: negative or >int64 is
+    # malformed (a truncated/corrupt line), and the C++ fast path rejects
+    # it the same way — a defined shared behavior instead of Python's
+    # arbitrary-precision ints silently diverging from the native engine.
+    # time shares the C++ parse_i64 bound (magnitude ≤ INT64_MAX).
+    if not (0 <= r.packets <= _I64_MAX and 0 <= r.bytes <= _I64_MAX
+            and -_I64_MAX <= r.time <= _I64_MAX):
+        return None
+    return r
 
 
 def stable_flow_key(datapath: str, eth_src: str, eth_dst: str) -> int:

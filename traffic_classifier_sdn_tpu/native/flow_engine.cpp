@@ -88,7 +88,11 @@ bool parse_i64(const char* s, size_t len, int64_t* out) {
   int64_t v = 0;
   for (; i < j; i++) {
     if (s[i] < '0' || s[i] > '9') return false;
-    v = v * 10 + (s[i] - '0');
+    int d = s[i] - '0';
+    // overflow guard: >19-digit fields would hit signed-overflow UB where
+    // Python's arbitrary-precision int parses them; both sides now reject
+    if (v > (INT64_MAX - d) / 10) return false;
+    v = v * 10 + d;
   }
   *out = neg ? -v : v;
   return true;
@@ -217,6 +221,10 @@ bool ingest_line(Engine* e, const char* line, size_t len) {
   if (!parse_i64(f[1], fl[1], &time)) return false;
   if (!parse_i64(f[7], fl[7], &pkts)) return false;
   if (!parse_i64(f[8], fl[8], &bytes)) return false;
+  // Cumulative counters can't be negative; a signed value here is a
+  // corrupt line (and would otherwise wrap to ~1.8e19 via the uint64_t
+  // cast below, diverging from the Python parser, which also rejects).
+  if (pkts < 0 || bytes < 0) return false;
   // the Python oracle decodes datapath/ports/MACs as UTF-8 and rejects
   // the line on failure; match it (fields 2..6 are the string fields)
   for (int k = 2; k <= 6; k++) {

@@ -96,6 +96,67 @@ class SGDState(NamedTuple):
     opt_state: optax.OptState
 
 
+def fit_sgd(
+    X,
+    y,
+    n_classes: int,
+    *,
+    learning_rate: float = 1e-2,
+    batch_size: int = 256,
+    n_steps: int = 2000,
+    seed: int = 0,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    stop_at_step: int | None = None,
+) -> logreg.Params:
+    """Minibatch Adam trainer with periodic train-state checkpointing and
+    crash resume — the resume-in-training the reference lacks entirely
+    (SURVEY.md §5: its only persistence is pickle.dump of a finished
+    estimator, e.g. 3_RandomForest.ipynb cell 19).
+
+    The minibatch schedule is keyed by the *absolute* step index, so a run
+    that dies and resumes from its last checkpoint replays exactly the
+    remaining schedule: final params are bit-identical to an uninterrupted
+    run (tests/test_checkpoint.py asserts this). ``checkpoint_every`` is
+    config.TrainConfig.checkpoint_every; 0 disables saving.
+    ``stop_at_step`` truncates the run mid-flight (the kill hook used by
+    the resume test).
+    """
+    import os
+
+    import numpy as np
+
+    from ..io import checkpoint as ckpt
+
+    X = np.asarray(X, np.float32)
+    y_np = np.asarray(y, np.int32)
+    n = X.shape[0]
+
+    init, train_step = make_sgd(learning_rate)
+    state = init(n_classes=n_classes, n_features=X.shape[1])
+    start_step = 0
+    if checkpoint_dir is not None and os.path.exists(
+        os.path.join(checkpoint_dir, "manifest.json")
+    ):
+        state, start_step = ckpt.restore_train_state(checkpoint_dir, state)
+
+    for step in range(start_step, n_steps):
+        if stop_at_step is not None and step >= stop_at_step:
+            break  # simulated kill: no save beyond the last periodic one
+        rng = np.random.RandomState((seed * 1_000_003 + step) & 0x7FFFFFFF)
+        idx = rng.randint(0, n, batch_size)
+        state, _ = train_step(state, jnp.asarray(X[idx]), jnp.asarray(y_np[idx]))
+        done = step + 1
+        if (
+            checkpoint_dir is not None
+            and checkpoint_every > 0
+            and (done % checkpoint_every == 0 or done == n_steps)
+        ):
+            ckpt.save_train_state(checkpoint_dir, state, done)
+
+    return state.params
+
+
 def make_sgd(learning_rate: float = 1e-3):
     """Streaming/minibatch trainer for the data-parallel training path
     (the dryrun's full train step jits this over a sharded batch; XLA
