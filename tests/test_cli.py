@@ -257,3 +257,30 @@ def test_classify_workload_source(capsys, reference_models_dir):
     assert "Flow ID" in out
     # the workload's class diversity shows up in the rendered table
     assert any(c in out for c in ("dns", "ping", "telnet", "game", "voice"))
+
+
+def test_table_render_bounded_at_scale(capsys, reference_models_dir):
+    """--table-rows caps the rendered sample (classification still covers
+    the whole table on device); the footer reports the true tracked count
+    — the O(limit) render that holds at the 2^20-flow target
+    (tools/bench_serve.py is the full-scale artifact)."""
+    from traffic_classifier_sdn_tpu import cli
+
+    cli.main(
+        [
+            "gaussiannb",
+            "--source", "synthetic",
+            "--synthetic-flows", "200",
+            "--checkpoint-dir", reference_models_dir,
+            "--capacity", "1024",
+            "--table-rows", "16",
+            "--print-every", "1",
+            "--max-ticks", "2",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "... showing 16 of 200 tracked flows" in out
+    table_rows = [l for l in out.splitlines()
+                  if l.startswith("|") and "Flow ID" not in l]
+    # 2 ticks × 16 sampled rows
+    assert len(table_rows) == 32

@@ -1,0 +1,56 @@
+"""Multi-host bring-up test: two OS processes, each with 2 virtual CPU
+devices, rendezvous through ``parallel/mesh.py:init_distributed``
+(jax.distributed over the loopback DCN analogue) and run a batch-sharded
+predict plus a cross-process psum on the spanning mesh — the multi-host
+path SURVEY.md §2.4 requires and VERDICT r1 found untested.
+
+Runs in subprocesses because jax.distributed can only initialize once per
+process (and the test session's jax is already single-process)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_spanning_predict():
+    coordinator = f"127.0.0.1:{_free_port()}"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    # fresh jax in the children, immune to the TPU sitecustomize
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, coordinator, str(i), "2"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=repo_root,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"MULTIHOST OK pid={i} devices=4" in out, out

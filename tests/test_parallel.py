@@ -196,3 +196,26 @@ def test_knn_ring_merge_matches_single_device(reference_models_dir, X256):
     ring = knn_sharded.ring_predict(m, params, pad_mask=dpad.get("pad_mask"))
     got = np.asarray(ring(X256))
     np.testing.assert_array_equal(got, want)
+
+
+def test_bench_sharded_smoke(tmp_path):
+    """tools/bench_sharded.py runs end to end on the virtual mesh and
+    emits the full scaling matrix (collective-shape regression canary)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_sharded.py"),
+         "--batch", "256", "--repeats", "1"],
+        capture_output=True, text=True, timeout=240, cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    out = json.loads(r.stdout.splitlines()[-1])
+    for shard in ("state_1", "state_2", "state_8"):
+        for key in ("knn_allgather_ms", "knn_ring_ms", "forest_ms",
+                    "svc_ms"):
+            assert out["results"][shard][key] > 0
+    assert out["results"]["data_8"]["forest_dp_ms"] > 0

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Serving-loop scale bench: drive the FULL ingest→device-table→predict→
+render→evict spine at 2²⁰ concurrent flows (the BASELINE.json north star)
+and print one JSON line of per-stage timings.
+
+This measures what VERDICT r1 item 4 said was unproven: that the host side
+of the serving loop stays O(batch)/O(limit) — not O(capacity) Python — at
+1M flows. The reference's equivalent loop is per-flow Python dict + predict
+(traffic_classifier.py:99-118,144-171) and its `flows` dict only ever held
+dozens of entries.
+
+Stages per tick:
+  ingest   — raw wire bytes → C++ engine (or Python fallback) routing
+  step     — one scatter of the padded update batch into the device table
+  predict  — batched GNB over the whole (capacity, 12) feature matrix
+  render   — sorted sample of --table-rows flows + footer (never O(N))
+  evict    — device stale-mask + host release of idle slots
+
+Usage: bench_serve.py [--capacity 1048576] [--ticks 5] [--no-native]
+(CPU-safe: forces the host platform unless --platform default.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--capacity", type=int, default=1 << 20)
+    ap.add_argument("--ticks", type=int, default=5)
+    ap.add_argument("--no-native", action="store_true")
+    ap.add_argument(
+        "--platform", choices=("cpu", "default"), default="cpu",
+        help="cpu (safe anywhere) or default (real TPU when healthy)",
+    )
+    ap.add_argument("--table-rows", type=int, default=64)
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    import numpy as np
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+    from traffic_classifier_sdn_tpu.models import gnb
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
+
+    native = (not args.no_native) and native_engine.available()
+    cap = args.capacity
+    n_flows = cap // 2  # two directions share one slot; stay under capacity
+    eng = FlowStateEngine(capacity=cap, native=native)
+    syn = SyntheticFlows(n_flows=n_flows, seed=0)
+
+    # 6-class GNB params (synthetic moments — the model family is the
+    # cheapest full-table predict; the forest/SVC cost is bench.py's job)
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy(
+        {
+            "theta": rng.gamma(2.0, 100.0, (6, 12)),
+            "var": rng.gamma(2.0, 50.0, (6, 12)) + 1.0,
+            "class_prior": np.full(6, 1 / 6),
+        }
+    )
+    predict = jax.jit(gnb.predict)
+
+    print(
+        f"# generating {args.ticks} ticks × {2 * n_flows} records "
+        f"(capacity {cap}, native={native})",
+        file=sys.stderr, flush=True,
+    )
+    payloads = [syn.tick_bytes() for _ in range(args.ticks)]
+    total_records = sum(p.count(b"\n") for p in payloads)
+
+    classes = None
+    timings = {k: [] for k in ("ingest", "step", "predict", "render",
+                               "evict", "tick")}
+    n_parsed = 0
+    for ti, payload in enumerate(payloads):
+        t0 = time.perf_counter()
+        n_parsed += eng.ingest_bytes(payload)
+        t1 = time.perf_counter()
+        eng.step()
+        t2 = time.perf_counter()
+        idx = np.asarray(predict(params, eng.features()))
+        t3 = time.perf_counter()
+        # bounded render: sample + footer, exactly the CLI's shape
+        sample = eng.slot_metadata(limit=args.table_rows)
+        rows = [
+            (s, src, dst, int(idx[s]))
+            for s, (src, dst) in sorted(sample.items())
+        ]
+        footer = f"showing {len(rows)} of {eng.num_flows()}"
+        t4 = time.perf_counter()
+        evicted = eng.evict_idle(now=eng.last_time, idle_seconds=3600)
+        t5 = time.perf_counter()
+        timings["ingest"].append(t1 - t0)
+        timings["step"].append(t2 - t1)
+        timings["predict"].append(t3 - t2)
+        timings["render"].append(t4 - t3)
+        timings["evict"].append(t5 - t4)
+        timings["tick"].append(t5 - t0)
+        print(
+            f"# tick {ti}: {footer}, evicted {evicted}, "
+            f"tick {(t5 - t0) * 1e3:.0f} ms",
+            file=sys.stderr, flush=True,
+        )
+        assert len(rows) <= args.table_rows
+
+    p50 = {k: float(np.median(v)) for k, v in timings.items()}
+    ingest_rate = (total_records / args.ticks) / p50["ingest"]
+    print(
+        json.dumps(
+            {
+                "metric": "serve_tick_p50_ms_at_capacity",
+                "value": round(p50["tick"] * 1e3, 1),
+                "unit": "ms",
+                "capacity": cap,
+                "tracked_flows": eng.num_flows(),
+                "records_per_tick": total_records // args.ticks,
+                "ingest_records_per_sec": round(ingest_rate, 1),
+                "stage_p50_ms": {
+                    k: round(v * 1e3, 2) for k, v in p50.items()
+                },
+                "native_ingest": native,
+                "platform": jax.devices()[0].platform,
+                "table_rows_rendered": args.table_rows,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Sharded-path scaling bench on a virtual CPU mesh (VERDICT r1 item 9).
+
+Times every distributed predict path at shard counts 1 / 2 / 8 on the
+8-virtual-device CPU mesh the tests use (SURVEY.md §4c). Absolute numbers
+on virtual CPU devices are meaningless; the *relative* shape catches
+collective-layout regressions (a psum/all_gather whose operand suddenly
+scales with the full state, a ring step that stops overlapping, padding
+that stops dividing) before they reach hardware. Prints one JSON line.
+
+Paths (state axis unless noted):
+  knn_allgather — local top-k + all_gather merge (parallel/knn_sharded.py)
+  knn_ring      — software-pipelined ppermute ring merge
+  forest        — tree-sharded, psum of class distributions
+  svc           — SV-sharded, psum of partial ovo decisions
+  forest_dp     — batch-sharded forest (data axis; no collectives)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--models-dir", default=os.environ.get(
+        "TCSDN_MODELS_DIR", "/root/reference/models"))
+    args = ap.parse_args()
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+    from traffic_classifier_sdn_tpu.models import forest, knn, svc
+    from traffic_classifier_sdn_tpu.parallel import (
+        forest_sharded,
+        knn_sharded,
+        mesh as meshlib,
+        predict as dp,
+        svc_sharded,
+    )
+
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(
+        np.abs(rng.gamma(1.5, 200.0, (args.batch, 12))), jnp.float32
+    )
+
+    def timed(fn, *a) -> float:
+        out = jax.block_until_ready(fn(*a))  # compile + warm
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*a))
+            times.append(time.perf_counter() - t0)
+        del out
+        return float(np.median(times))
+
+    knn_raw = ski.import_knn(os.path.join(args.models_dir, "KNeighbors"))
+    svc_raw = ski.import_svc(os.path.join(args.models_dir, "SVC"))
+    forest_raw = ski.import_forest(
+        os.path.join(args.models_dir, "RandomForestClassifier")
+    )
+
+    results: dict = {}
+    devices = jax.devices()
+    for n_state in (1, 2, 8):
+        mesh = meshlib.make_mesh(
+            n_data=1, n_state=n_state, devices=devices[:n_state]
+        )
+        r: dict = {}
+
+        kr = knn_sharded.pad_corpus(dict(knn_raw), n_state)
+        kp = knn.from_numpy(kr, dtype=jnp.float32)
+        r["knn_allgather_ms"] = timed(
+            knn_sharded.sharded_predict(
+                mesh, kp, pad_mask=kr.get("pad_mask")
+            ), X,
+        ) * 1e3
+        r["knn_ring_ms"] = timed(
+            knn_sharded.ring_predict(mesh, kp, pad_mask=kr.get("pad_mask")),
+            X,
+        ) * 1e3
+
+        fr = forest_sharded.pad_trees(dict(forest_raw), n_state)
+        fp = forest.from_numpy(fr)
+        r["forest_ms"] = timed(
+            forest_sharded.sharded_predict(
+                mesh, fp, n_real_trees=fr.get(
+                    "n_real_trees", fr["left"].shape[0]
+                )
+            ), X,
+        ) * 1e3
+
+        sr = svc_sharded.pad_support(dict(svc_raw), n_state)
+        sp = svc.from_numpy(sr, dtype=jnp.float32)
+        r["svc_ms"] = timed(svc_sharded.sharded_predict(mesh, sp), X) * 1e3
+
+        results[f"state_{n_state}"] = {
+            k: round(v, 2) for k, v in r.items()
+        }
+
+    for n_data in (1, 8):
+        mesh = meshlib.make_mesh(
+            n_data=n_data, n_state=1, devices=devices[:n_data]
+        )
+        fp = forest.from_numpy(forest_raw)
+        call = dp.data_parallel(mesh, forest.predict)
+        results[f"data_{n_data}"] = {
+            "forest_dp_ms": round(timed(call, fp, X) * 1e3, 2)
+        }
+
+    print(
+        json.dumps(
+            {
+                "metric": "sharded_scaling_cpu_mesh",
+                "batch": args.batch,
+                "platform": "cpu_x8_virtual",
+                "results": results,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

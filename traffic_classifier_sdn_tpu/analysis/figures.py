@@ -29,7 +29,7 @@ import matplotlib.pyplot as plt  # noqa: E402
 
 
 def _scatter_by_class(ax, Z, y, classes):
-    cmap = plt.cm.get_cmap("tab10")
+    cmap = plt.get_cmap("tab10")
     for i, name in enumerate(classes):
         m = y == i
         ax.scatter(Z[m, 0], Z[m, 1], s=8, alpha=0.6,
@@ -94,7 +94,7 @@ def fig_cluster_centers(centers, names, path: str) -> None:
 def fig_cluster_scatter(Z, clusters, y, path: str) -> None:
     """Cell 126: learned cluster ids vs true labels, side by side."""
     k = int(max(clusters.max(), y.max())) + 1
-    kwargs = dict(cmap=plt.cm.get_cmap("rainbow", k), edgecolor="none",
+    kwargs = dict(cmap=plt.get_cmap("rainbow", k), edgecolor="none",
                   alpha=0.6, s=8)
     fig, ax = plt.subplots(1, 2, figsize=(9, 4))
     ax[0].scatter(Z[:, 0], Z[:, 1], c=clusters, **kwargs)
@@ -118,7 +118,11 @@ def save_all(ds, out_dir: str, seed: int = 101) -> dict:
     from .preprocess import PCA, StandardScaler
 
     os.makedirs(out_dir, exist_ok=True)
-    X = jnp.asarray(ds.X, jnp.float64)
+    # dtype follows the x64 config: float64 under the test harness
+    # (conftest enables x64 for sklearn-exact parity), float32 in the
+    # production CLI — an explicit float64 request would silently
+    # truncate there and warn on every run.
+    X = jnp.asarray(ds.X)
     y = np.asarray(ds.y)
     k = len(ds.classes)
 

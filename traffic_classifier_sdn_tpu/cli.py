@@ -142,9 +142,18 @@ def _build_parser() -> argparse.ArgumentParser:
         "--max-ticks", type=int, default=0, help="stop after N ticks (0=∞)"
     )
     p.add_argument(
+        "--table-rows", type=int, default=64,
+        help="max flows rendered per table (0 = all; classification "
+        "always covers the whole table on device)",
+    )
+    p.add_argument(
         "--synthetic-flows", type=int, default=1024, help="synthetic source size"
     )
-    p.add_argument("--out", default=None, help="training CSV path")
+    p.add_argument(
+        "--out", default=None,
+        help="output path: training CSV (train) or figure directory "
+        "(analyze)",
+    )
     p.add_argument(
         "--native-ingest",
         choices=("auto", "on", "off"),
@@ -315,8 +324,14 @@ def _print_table(engine, model, predict, args) -> None:
     idx = np.asarray(predict(model.params, X))
     fwd_active = np.asarray(engine.table.fwd.active)[:-1]
     rev_active = np.asarray(engine.table.rev.active)[:-1]
+    # Classification is batched over the WHOLE table on device; the table
+    # *render* samples at most --table-rows flows (the reference prints
+    # everything because it tracks dozens, traffic_classifier.py:99-118 —
+    # at the 2²⁰-flow target a full render would be O(N) Python per tick).
+    limit = args.table_rows if args.table_rows > 0 else None
+    n_flows = engine.num_flows()
     rows = []
-    for slot, (src, dst) in sorted(engine.slot_metadata().items()):
+    for slot, (src, dst) in sorted(engine.slot_metadata(limit).items()):
         rows.append(
             (
                 slot,
@@ -330,6 +345,9 @@ def _print_table(engine, model, predict, args) -> None:
             )
         )
     print(render_table(CLASSIFIER_FIELDS, rows), flush=True)
+    if limit is not None and n_flows > len(rows):
+        print(f"... showing {len(rows)} of {n_flows} tracked flows",
+              flush=True)
 
 
 def _run_train(args) -> None:
@@ -413,14 +431,20 @@ def _run_retrain(args) -> None:
     n_classes = len(tr.classes)
     mod = MODEL_MODULES[family]
 
+    ckpt_every = getattr(args, "checkpoint_every", 0) or 0
+    if ckpt_every > 0 and family != "logreg":
+        print(
+            f"WARNING: --checkpoint-every only applies to the logreg SGD "
+            f"trainer; ignored for {family}", file=sys.stderr,
+        )
     if family == "logreg":
         from .train import logreg as t
 
-        ckpt_every = getattr(args, "checkpoint_every", 0) or 0
         if ckpt_every > 0 and not args.train_state_dir:
             sys.exit(
-                "ERROR: --checkpoint-every needs --train-state-dir (the "
-                "resumable SGD path has nowhere to save state)"
+                "ERROR: --checkpoint-every needs --train-state-dir (flag "
+                "or config train.train_state_dir) — the resumable SGD "
+                "path has nowhere to save state"
             )
         if ckpt_every > 0:
             # Resumable streaming path: consumes train.checkpoint_every;
@@ -526,6 +550,8 @@ def main(argv=None) -> None:
             args.native_checkpoint = cfg.model.native_checkpoint
         if args.checkpoint_every is None:
             args.checkpoint_every = cfg.train.checkpoint_every
+        if args.train_state_dir is None:
+            args.train_state_dir = cfg.train.train_state_dir
     # unset sentinels → built-in defaults
     if args.capacity is None:
         args.capacity = 65536

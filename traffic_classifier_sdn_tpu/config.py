@@ -56,6 +56,7 @@ class TrainConfig:
     seed: int = 101  # notebook random_state
     collect_duration_s: float = 15 * 60  # reference TIMEOUT (:27)
     checkpoint_every: int = 0  # steps between train-state saves (0 = off)
+    train_state_dir: str | None = None  # where resumable state lands
 
 
 @dataclass(frozen=True)

@@ -270,6 +270,17 @@ def clear_slots(table: FlowTable, slot: jax.Array) -> FlowTable:
     )
 
 
+@jax.jit
+def stale_mask(table: FlowTable, now, idle_seconds) -> jax.Array:
+    """(capacity+1,) bool: in-use slots with no telemetry in either
+    direction for ``idle_seconds``. Computed on device so eviction scans
+    transfer one bool array instead of three int arrays — the incremental
+    evict path that keeps the 2²⁰-flow serving loop off the host
+    (VERDICT r1 item 4)."""
+    last = jnp.maximum(table.fwd.last_time, table.rev.last_time)
+    return table.in_use & (now - last >= idle_seconds)
+
+
 def features12(table: FlowTable) -> jax.Array:
     """(capacity, 12) online feature matrix, order of
     traffic_classifier.py:104 — rows for unused slots are zero."""

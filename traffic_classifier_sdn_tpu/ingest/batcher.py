@@ -248,13 +248,31 @@ class FlowStateEngine:
     def dropped(self) -> int:
         return self.batcher.dropped
 
-    def slot_metadata(self) -> dict:
-        """slot → (eth_src, eth_dst) for all in-use slots (UI table)."""
+    def num_flows(self) -> int:
+        """Tracked (in-use) flow count — O(1) host work."""
+        if self.native:
+            return self.batcher.num_flows()
+        return len(self.index.slot_meta)
+
+    def slot_metadata(self, limit: int | None = None) -> dict:
+        """slot → (eth_src, eth_dst) for in-use slots (UI table).
+
+        ``limit`` bounds host work to O(limit): at the 2²⁰-flow target a
+        full dict copy (let alone rendering it) would dominate the tick,
+        and the reference only ever prints dozens of flows
+        (traffic_classifier.py:99-118)."""
         if not self.native:
-            return dict(self.index.slot_meta)
+            items = self.index.slot_meta.items()
+            if limit is None:
+                return dict(items)
+            import itertools
+
+            return dict(itertools.islice(items, limit))
         out = {}
         in_use = np.asarray(self.table.in_use)[:-1]
         for s in np.nonzero(in_use)[0]:
+            if limit is not None and len(out) >= limit:
+                break
             meta = self.batcher.slot_meta(int(s))
             if meta is not None:
                 out[int(s)] = meta
@@ -282,12 +300,13 @@ class FlowStateEngine:
         # and no stale pending row may outlive its slot's eviction (it
         # would scatter into a reassigned slot).
         self.step()
-        in_use = np.asarray(self.table.in_use)[:-1]
-        last = np.maximum(
-            np.asarray(self.table.fwd.last_time)[:-1],
-            np.asarray(self.table.rev.last_time)[:-1],
-        )
-        stale = in_use & (now - last >= idle_seconds)
+        # staleness is decided on device (core/flow_table.stale_mask): one
+        # bool array crosses to host instead of in_use + 2× last_time
+        stale = np.asarray(
+            ft.stale_mask(
+                self.table, np.int32(now), np.int32(idle_seconds)
+            )
+        )[:-1]
         slots = np.nonzero(stale)[0]
         step = self.batcher.buckets[-1]
         capacity = self.table.capacity

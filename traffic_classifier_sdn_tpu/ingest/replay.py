@@ -93,3 +93,31 @@ class SyntheticFlows:
             ))
         self.t += 1
         return out
+
+    def tick_bytes(self) -> bytes:
+        """One tick rendered straight to the monitor wire format — the
+        bulk path for scale tests (2²⁰ flows): building TelemetryRecord
+        objects per flow would dominate; this emits one bytes blob for
+        ``FlowStateEngine.ingest_bytes``/the C++ engine."""
+        dp = np.int64(self.pps_fwd * self._rng.poisson(1.0, self.n_flows))
+        self.cum_pkts_fwd += dp
+        self.cum_bytes_fwd += np.int64(dp * self.bpp_fwd)
+        dr = np.int64(self.pps_rev * self._rng.poisson(1.0, self.n_flows))
+        self.cum_pkts_rev += dr
+        self.cum_bytes_rev += np.int64(dr * self.bpp_rev)
+        if not hasattr(self, "_mac_cache"):
+            self._mac_cache = [
+                (self._mac(i, 0), self._mac(i, 1))
+                for i in range(self.n_flows)
+            ]
+        t = self.t
+        parts = []
+        pf, bf = self.cum_pkts_fwd, self.cum_bytes_fwd
+        pr, br = self.cum_pkts_rev, self.cum_bytes_rev
+        for i, (src, dst) in enumerate(self._mac_cache):
+            parts.append(
+                f"data\t{t}\t1\t1\t{src}\t{dst}\t2\t{pf[i]}\t{bf[i]}\n"
+                f"data\t{t}\t1\t2\t{dst}\t{src}\t1\t{pr[i]}\t{br[i]}\n"
+            )
+        self.t += 1
+        return "".join(parts).encode()

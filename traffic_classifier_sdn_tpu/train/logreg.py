@@ -45,7 +45,10 @@ def fit(
     optimum). ``feature_scale=True`` is kept for experimentation only.
     The returned Params operate on raw features, exactly like the
     reference's pickles (no online scaler — SURVEY.md §3.5)."""
-    X = jnp.asarray(X, jnp.float64)
+    # float64 when x64 is on (sklearn-exact parity mode, the test
+    # harness); plain float32 otherwise — avoids the per-run truncation
+    # warning in production CLIs.
+    X = jnp.asarray(X, jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
     y = jnp.asarray(y, jnp.int32)
     F = X.shape[1]
 
