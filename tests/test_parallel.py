@@ -196,6 +196,11 @@ def test_knn_ring_merge_matches_single_device(reference_models_dir, X256):
     ring = knn_sharded.ring_predict(m, params, pad_mask=dpad.get("pad_mask"))
     got = np.asarray(ring(X256))
     np.testing.assert_array_equal(got, want)
+    # the log-depth tournament merge must agree bit-for-bit too
+    tour = knn_sharded.tournament_predict(
+        m, params, pad_mask=dpad.get("pad_mask")
+    )
+    np.testing.assert_array_equal(np.asarray(tour(X256)), want)
 
 
 def test_bench_sharded_smoke(tmp_path):
@@ -219,3 +224,54 @@ def test_bench_sharded_smoke(tmp_path):
                     "svc_ms"):
             assert out["results"][shard][key] > 0
     assert out["results"]["data_8"]["forest_dp_ms"] > 0
+
+
+def test_knn_sharded_merges_with_padding_heavy_shards():
+    """A corpus so small that most shards hold only +inf-distance padding
+    (local top-k emits -inf candidates) must still merge exactly: the
+    rank merge's value reconstruction must not turn -inf into NaN."""
+    from traffic_classifier_sdn_tpu.models import knn
+    from traffic_classifier_sdn_tpu.parallel import knn_sharded
+
+    rng = np.random.RandomState(3)
+    d = {
+        "fit_X": rng.rand(8, 12) * 100.0,  # 8 rows over 8 shards, k=5
+        "y": rng.randint(0, 6, 8).astype(np.int32),
+        "n_neighbors": 5,
+        "classes": np.arange(6),
+    }
+    single = knn.from_numpy(dict(d), dtype=jnp.float32)
+    Xq = jnp.asarray(rng.rand(64, 12) * 100.0, jnp.float32)
+    want = np.asarray(knn.predict(single, Xq))
+
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    dpad = knn_sharded.pad_corpus(dict(d), 8)
+    params = knn.from_numpy(dpad, dtype=jnp.float32)
+    for builder in (
+        knn_sharded.sharded_predict,
+        knn_sharded.ring_predict,
+        knn_sharded.tournament_predict,
+    ):
+        fn = builder(m, params, pad_mask=dpad.get("pad_mask"))
+        got = np.asarray(fn(Xq))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_knn_merge_unpacked_fallback(reference_models_dir, X256, monkeypatch):
+    """Corpora with rows × classes ≥ 2^31 can't pack labels into the int32
+    index payload; the ring and tournament must fall back to a separate
+    label payload and still merge exactly."""
+    from traffic_classifier_sdn_tpu.parallel import knn_sharded
+
+    monkeypatch.setattr(knn_sharded, "_packable", lambda params: False)
+    d = ski.import_knn(f"{reference_models_dir}/KNeighbors")
+    single = knn.from_numpy(d, dtype=jnp.float32)
+    want = np.asarray(knn.predict(single, X256))
+
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    dpad = knn_sharded.pad_corpus(d, 8)
+    params = knn.from_numpy(dpad, dtype=jnp.float32)
+    for builder in (knn_sharded.ring_predict,
+                    knn_sharded.tournament_predict):
+        fn = builder(m, params, pad_mask=dpad.get("pad_mask"))
+        np.testing.assert_array_equal(np.asarray(fn(X256)), want)

@@ -92,6 +92,7 @@ def main() -> None:
                                "evict", "tick")}
     n_parsed = 0
     for ti, payload in enumerate(payloads):
+        eng.mark_tick()
         t0 = time.perf_counter()
         n_parsed += eng.ingest_bytes(payload)
         t1 = time.perf_counter()
@@ -99,11 +100,11 @@ def main() -> None:
         t2 = time.perf_counter()
         idx = np.asarray(predict(params, eng.features()))
         t3 = time.perf_counter()
-        # bounded render: sample + footer, exactly the CLI's shape
-        sample = eng.slot_metadata(limit=args.table_rows)
+        # bounded render: activity-ranked sample + footer, the CLI's shape
+        top = eng.top_slots(args.table_rows)
+        sample = eng.slot_metadata(slots=top)
         rows = [
-            (s, src, dst, int(idx[s]))
-            for s, (src, dst) in sorted(sample.items())
+            (s, *sample[s], int(idx[s])) for s in top if s in sample
         ]
         footer = f"showing {len(rows)} of {eng.num_flows()}"
         t4 = time.perf_counter()

@@ -101,6 +101,12 @@ def main() -> None:
             knn_sharded.ring_predict(mesh, kp, pad_mask=kr.get("pad_mask")),
             X,
         ) * 1e3
+        r["knn_tournament_ms"] = timed(
+            knn_sharded.tournament_predict(
+                mesh, kp, pad_mask=kr.get("pad_mask")
+            ),
+            X,
+        ) * 1e3
 
         fr = forest_sharded.pad_trees(dict(forest_raw), n_state)
         fp = forest.from_numpy(fr)

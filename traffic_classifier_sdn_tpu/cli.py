@@ -281,6 +281,7 @@ def _run_classify(args) -> None:
         for batch in _tick_source(
             args, raw=use_native and args.source in ("ryu", "controller")
         ):
+            engine.mark_tick()  # freshness floor for the render sample
             with m.time("ingest_s"):
                 if isinstance(batch, bytes):
                     m.inc("records", engine.ingest_bytes(batch))
@@ -330,8 +331,16 @@ def _print_table(engine, model, predict, args) -> None:
     # at the 2²⁰-flow target a full render would be O(N) Python per tick).
     limit = args.table_rows if args.table_rows > 0 else None
     n_flows = engine.num_flows()
+    if limit is not None:
+        # activity-ranked sample: the rendered rows track live traffic
+        # (device top_k over this tick's byte deltas), most active first
+        top = engine.top_slots(limit)
+        sample = engine.slot_metadata(slots=top)
+        ordered = [(s, sample[s]) for s in top if s in sample]
+    else:
+        ordered = sorted(engine.slot_metadata().items())
     rows = []
-    for slot, (src, dst) in sorted(engine.slot_metadata(limit).items()):
+    for slot, (src, dst) in ordered:
         rows.append(
             (
                 slot,
@@ -374,11 +383,16 @@ def _run_train(args) -> None:
             ticks += 1
             X16 = np.asarray(features16(engine.table))
             in_use = np.asarray(engine.table.in_use)[:-1]
-            for slot in np.nonzero(in_use)[0]:
-                vals = "\t".join(
-                    str(v) for v in X16[slot].astype(np.float64)
+            slots = np.nonzero(in_use)[0]
+            if slots.size:
+                # Bulk row write: one C-level format per row instead of 16
+                # str() + join per flow, so the tick cost stays flat as the
+                # tracked-flow count grows. ``newline`` carries the label
+                # column (savetxt appends it after each formatted row).
+                np.savetxt(
+                    f, X16[slots].astype(np.float64), fmt="%s",
+                    delimiter="\t", newline=f"\t{args.traffic_type}\n",
                 )
-                f.write(f"{vals}\t{args.traffic_type}\n")
             if time.time() >= deadline:
                 print("Finished collecting data.")  # reference :185
                 break

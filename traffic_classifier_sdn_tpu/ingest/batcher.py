@@ -202,6 +202,9 @@ class FlowStateEngine:
             self.batcher = Batcher(self.index, buckets)
         self._tail = b""  # partial line carried across ingest_bytes calls
         self._last_time = 0
+        # freshness floor for the activity-ranked render sample: flows
+        # with telemetry newer than this count as active (see mark_tick)
+        self._tick_floor = 0
 
     def ingest(self, records: Iterable[TelemetryRecord]) -> int:
         n = 0
@@ -254,13 +257,54 @@ class FlowStateEngine:
             return self.batcher.num_flows()
         return len(self.index.slot_meta)
 
-    def slot_metadata(self, limit: int | None = None) -> dict:
+    def mark_tick(self) -> None:
+        """Snapshot the freshness floor for ``top_slots`` — call at the
+        START of each poll tick (before ingesting its records). Flows with
+        telemetry strictly newer than the floor count as active; the
+        snapshot is the max timestamp of all *previous* ticks, so skew
+        between datapaths reporting within one tick cannot demote a busy
+        flow. Never calling it degrades ``top_slots`` to all-time
+        activity ranking."""
+        self._tick_floor = self.last_time
+
+    def top_slots(self, n: int) -> list[int]:
+        """Slots of the ≤n most active flows this tick, most active first
+        (device ``top_k`` over |Δbytes|, gated to slots with telemetry
+        newer than the ``mark_tick`` floor; see
+        flow_table.top_active_slots). The UI sample follows live traffic
+        instead of insertion order."""
+        n = min(n, self.table.capacity)
+        if n <= 0:
+            return []
+        idx, valid = ft.top_active_slots(
+            self.table, n, np.int32(self._tick_floor)
+        )
+        idx = np.asarray(idx)
+        return [int(s) for s in idx[np.asarray(valid)]]
+
+    def slot_metadata(self, limit: int | None = None,
+                      slots: Iterable[int] | None = None) -> dict:
         """slot → (eth_src, eth_dst) for in-use slots (UI table).
 
-        ``limit`` bounds host work to O(limit): at the 2²⁰-flow target a
-        full dict copy (let alone rendering it) would dominate the tick,
-        and the reference only ever prints dozens of flows
+        ``slots`` fetches exactly those slots (preserving none; the dict is
+        keyed by slot) — pair with ``top_slots`` for an activity-ranked
+        sample. ``limit`` bounds host work to O(limit): at the 2²⁰-flow
+        target a full dict copy (let alone rendering it) would dominate the
+        tick, and the reference only ever prints dozens of flows
         (traffic_classifier.py:99-118)."""
+        if slots is not None:
+            if self.native:
+                out = {}
+                for s in slots:
+                    meta = self.batcher.slot_meta(int(s))
+                    if meta is not None:
+                        out[int(s)] = meta
+                return out
+            return {
+                int(s): self.index.slot_meta[s]
+                for s in slots
+                if s in self.index.slot_meta
+            }
         if not self.native:
             items = self.index.slot_meta.items()
             if limit is None:

@@ -31,10 +31,24 @@ def from_numpy(d: dict, dtype=jnp.float32) -> Params:
     theta = np.asarray(d["theta"], dtype=np.float64)
     var = np.asarray(d["var"], dtype=np.float64)
     prior = np.asarray(d["class_prior"], dtype=np.float64)
-    log_const = np.log(prior) - 0.5 * np.sum(np.log(2.0 * math.pi * var), axis=1)
+    # Absent classes (zero prior — reachable when a fit sees no rows of a
+    # class, e.g. the distributed fit's padded class count) are made inert
+    # explicitly: zero mean/precision and a -inf score, so they can never
+    # win the argmax and their NaN moments can't poison present classes.
+    present = prior > 0.0
+    safe_prior = np.where(present, prior, 1.0)
+    safe_var = np.where(present[:, None], var, 1.0)
+    log_const = np.where(
+        present,
+        np.log(safe_prior)
+        - 0.5 * np.sum(np.log(2.0 * math.pi * safe_var), axis=1),
+        -np.inf,
+    )
     return Params(
-        theta=jnp.asarray(theta, dtype=dtype),
-        inv_var=jnp.asarray(1.0 / var, dtype=dtype),
+        theta=jnp.asarray(np.where(present[:, None], theta, 0.0), dtype=dtype),
+        inv_var=jnp.asarray(
+            np.where(present[:, None], 1.0 / safe_var, 0.0), dtype=dtype
+        ),
         log_const=jnp.asarray(log_const, dtype=dtype),
     )
 

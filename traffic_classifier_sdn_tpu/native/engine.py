@@ -40,7 +40,8 @@ def _build() -> None:
     tmp = f"{_LIB}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", tmp, _SRC],
+            ["g++", "-O3", "-std=c++17", "-pthread", "-fPIC", "-shared",
+             "-o", tmp, _SRC],
             check=True,
             capture_output=True,
             text=True,

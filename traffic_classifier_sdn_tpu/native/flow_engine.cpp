@@ -11,6 +11,23 @@
 // same contract as ingest/batcher.py's FlowIndex + Batcher, which remain
 // as the pure-Python fallback and behavioral oracle.
 //
+// Hot-path design (the serving loop budget is the monitor's 1 Hz poll
+// cadence, simple_monitor_13.py:36, at 2^20 tracked flows ≈ 1M records
+// per tick):
+//   - flow keys are deterministic 64-bit fingerprints of
+//     (datapath\0src\0dst) — same keying rule as the Python oracle's
+//     protocol.stable_flow_key, different (much faster) mix; see the
+//     fingerprint section below for the collision-equivalence argument —
+//     held in an open-addressing table: no per-record string allocation,
+//     no chained-bucket pointer chases
+//   - parsing (tokenize, int parse, UTF-8 validate, fingerprint) is
+//     side-effect-free per line, so large chunks are split at line
+//     boundaries and parsed on worker threads when the host has cores to
+//     spare; ROUTING stays sequential in original record order, so slot
+//     assignment is identical to the single-threaded oracle
+//   - on a single-core host the threaded path auto-degrades to inline
+//     parsing (no thread overhead)
+//
 // Semantics mirrored from the Python batcher (and ultimately from the
 // reference's key folding at traffic_classifier.py:157-165):
 //   - a record keys on (datapath, eth_src, eth_dst); if that key is new
@@ -29,10 +46,164 @@
 #include <cstring>
 #include <deque>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
 namespace {
+
+// ---------------------------------------------------------------------------
+// 64-bit flow fingerprint — a wyhash-style 128-bit-multiply mix over
+// dp\0src\0dst. Deterministic (fixed seed, stable across processes and
+// runs — the property the reference's per-process-randomized ``hash()``
+// lacks, SURVEY.md §2 defect list) and well-mixed, at ~10 ns per key where
+// a cryptographic digest costs ~220 ns — fingerprinting is the ingest hot
+// loop's largest single cost at 1M records/tick.
+//
+// The Python control plane (ingest/protocol.stable_flow_key) uses
+// BLAKE2b-64 for the same key. The two paths never share a table, and
+// routing behavior depends only on fingerprint hit/miss patterns, so
+// native and Python routing agree except when either function collides:
+// ~2^-44 birthday probability at 2^20 live flows, the same order as the
+// Python path's own collision acceptance. A collision merges two flows'
+// counters — the identical failure mode the oracle already accepts.
+// ---------------------------------------------------------------------------
+
+inline uint64_t mum_mix(uint64_t a, uint64_t b) {
+  __uint128_t r = static_cast<__uint128_t>(a) * b;
+  return static_cast<uint64_t>(r) ^ static_cast<uint64_t>(r >> 64);
+}
+
+inline uint64_t load64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+inline uint64_t load_partial(const uint8_t* p, size_t n) {
+  uint64_t v = 0;
+  std::memcpy(&v, p, n);  // little-endian host assumed (x86/ARM LE)
+  return v;
+}
+
+constexpr uint64_t kSeed0 = 0xa0761d6478bd642fULL;
+constexpr uint64_t kSeed1 = 0xe7037ed1a0b428dbULL;
+constexpr uint64_t kSeed2 = 0x8ebc6af09c88c6e3ULL;
+
+uint64_t hash_bytes(const uint8_t* s, size_t len) {
+  uint64_t h = kSeed0 ^ mum_mix(len, kSeed1);
+  size_t i = 0;
+  for (; i + 16 <= len; i += 16) {
+    h = mum_mix(load64(s + i) ^ kSeed1, load64(s + i + 8) ^ h);
+  }
+  uint64_t a = 0, b = 0;
+  size_t rem = len - i;
+  if (rem > 8) {
+    a = load64(s + i);
+    b = load_partial(s + i + 8, rem - 8);
+  } else if (rem > 0) {
+    a = load_partial(s + i, rem);
+  }
+  return mum_mix(kSeed2 ^ a, h ^ b);
+}
+
+// Fingerprint of dp\0src\0dst (the \0 separators carry the same
+// anti-ambiguity rule as protocol.stable_flow_key: 'ab'+'c' must not
+// collide with 'a'+'bc').
+uint64_t flow_fingerprint(const char* dp, size_t dpl, const char* src,
+                          size_t sl, const char* dst, size_t dl) {
+  const size_t total = dpl + sl + dl + 2;
+  uint8_t stackbuf[512];
+  std::vector<uint8_t> heapbuf;
+  uint8_t* buf = stackbuf;
+  if (total > sizeof(stackbuf)) {
+    heapbuf.resize(total);
+    buf = heapbuf.data();
+  }
+  std::memcpy(buf, dp, dpl);
+  buf[dpl] = 0;
+  std::memcpy(buf + dpl + 1, src, sl);
+  buf[dpl + 1 + sl] = 0;
+  std::memcpy(buf + dpl + 2 + sl, dst, dl);
+  return hash_bytes(buf, total);
+}
+
+// ---------------------------------------------------------------------------
+// Open-addressing fingerprint → slot map (linear probing, tombstones).
+// The mum_mix fingerprint above is well-mixed across all 64 bits, so the
+// fingerprint itself serves as the probe hash (no re-hash).
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kEmpty = 0xFFFFFFFFu;
+constexpr uint32_t kTomb = 0xFFFFFFFEu;
+
+struct FpMap {
+  std::vector<uint64_t> keys;
+  std::vector<uint32_t> vals;
+  size_t mask = 0;
+  size_t used = 0;    // live entries
+  size_t filled = 0;  // live + tombstones
+
+  explicit FpMap(size_t initial = 1024) { reset(initial); }
+
+  void reset(size_t cap) {
+    size_t n = 16;
+    while (n < cap) n <<= 1;
+    keys.assign(n, 0);
+    vals.assign(n, kEmpty);
+    mask = n - 1;
+    used = filled = 0;
+  }
+
+  uint32_t* find(uint64_t k) {
+    size_t i = k & mask;
+    while (true) {
+      uint32_t v = vals[i];
+      if (v == kEmpty) return nullptr;
+      if (v != kTomb && keys[i] == k) return &vals[i];
+      i = (i + 1) & mask;
+    }
+  }
+
+  void grow() {
+    std::vector<uint64_t> ok = std::move(keys);
+    std::vector<uint32_t> ov = std::move(vals);
+    size_t n = (used * 4 >= (mask + 1)) ? (mask + 1) * 2 : (mask + 1);
+    keys.assign(n, 0);
+    vals.assign(n, kEmpty);
+    mask = n - 1;
+    filled = used;
+    for (size_t j = 0; j < ov.size(); j++) {
+      if (ov[j] == kEmpty || ov[j] == kTomb) continue;
+      size_t i = ok[j] & mask;
+      while (vals[i] != kEmpty) i = (i + 1) & mask;
+      keys[i] = ok[j];
+      vals[i] = ov[j];
+    }
+  }
+
+  void insert(uint64_t k, uint32_t v) {
+    if ((filled + 1) * 2 >= mask + 1) grow();  // ≤50% load incl tombstones
+    size_t i = k & mask;
+    while (vals[i] != kEmpty && vals[i] != kTomb) i = (i + 1) & mask;
+    if (vals[i] == kEmpty) filled++;
+    keys[i] = k;
+    vals[i] = v;
+    used++;
+  }
+
+  void erase(uint64_t k) {
+    uint32_t* p = find(k);
+    if (p != nullptr) {
+      *p = kTomb;
+      used--;
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Engine
+// ---------------------------------------------------------------------------
 
 struct Row {
   uint32_t slot;
@@ -43,19 +214,39 @@ struct Row {
   uint8_t is_create;
 };
 
-// One flush unit: rows plus the per-(slot,dir) occupancy needed to detect
-// the one-create-plus-one-update-per-direction limit.
+// One flush unit. The per-(slot,dir) occupancy that enforces the
+// one-create-plus-one-update-per-direction limit lives in the Engine as
+// an epoch-stamped flat array (occ_epoch/occ_bits) — only the *newest*
+// generation ever accepts rows, so one array serves all generations and
+// a bump of gen_seq invalidates it in O(1) instead of clearing.
 struct Generation {
   std::vector<Row> rows;
-  // (slot << 1 | is_fwd) -> flags bit0=create present, bit1=update present
-  std::unordered_map<uint64_t, uint8_t> occ;
+};
+
+// A parsed-but-not-yet-routed telemetry record. String views point into
+// the feed buffer (or the tail scratch), valid for the duration of the
+// feed() call — routing happens before feed() returns.
+struct ParsedRec {
+  uint64_t fp;    // fingerprint of (dp, src, dst)
+  uint64_t rfp;   // fingerprint of (dp, dst, src); valid iff has_rfp
+  const char* src;
+  const char* dst;
+  uint32_t src_len;
+  uint32_t dst_len;
+  const char* dp;
+  uint32_t dp_len;
+  int32_t time;
+  uint64_t pkts;
+  uint64_t bytes;
+  uint8_t has_rfp;
 };
 
 struct Engine {
   uint32_t capacity;
   uint32_t max_batch;
-  std::unordered_map<std::string, uint32_t> key_to_slot;
-  std::vector<std::string> slot_key;  // "" when free
+  FpMap key_to_slot;
+  std::vector<uint64_t> slot_fp;
+  std::vector<uint8_t> slot_used;
   std::vector<std::string> slot_src;
   std::vector<std::string> slot_dst;
   std::vector<uint32_t> free_slots;
@@ -64,11 +255,18 @@ struct Engine {
   uint64_t parsed = 0;
   int32_t last_time = 0;  // max telemetry timestamp seen (eviction clock)
   std::deque<Generation> gens;
+  uint32_t gen_seq = 0;  // sequence of the newest generation
+  // (slot << 1 | is_fwd) → occupancy of the NEWEST generation only:
+  // bits valid iff occ_epoch[k] == gen_seq (bit0=create, bit1=update)
+  std::vector<uint32_t> occ_epoch;
+  std::vector<uint8_t> occ_bits;
   std::string tail;  // partial line carried across feed() calls
 
   explicit Engine(uint32_t cap, uint32_t mb)
-      : capacity(cap), max_batch(mb), slot_key(cap), slot_src(cap),
-        slot_dst(cap) {}
+      : capacity(cap), max_batch(mb), slot_fp(cap, 0), slot_used(cap, 0),
+        slot_src(cap), slot_dst(cap),
+        occ_epoch(static_cast<size_t>(cap) * 2, 0),
+        occ_bits(static_cast<size_t>(cap) * 2, 0) {}
 };
 
 // Python-int-compatible enough for the wire format: optional surrounding
@@ -100,9 +298,12 @@ bool parse_i64(const char* s, size_t len, int64_t* out) {
 
 // Strict UTF-8 validity — the Python oracle's parse_line rejects lines
 // whose string fields fail .decode() (ingest/protocol.py), so we must too
-// or slot metadata could carry bytes Python can't decode.
+// or slot metadata could carry bytes Python can't decode. ASCII fast path
+// first: telemetry fields are MACs/ports/datapath ids, almost always pure
+// ASCII.
 bool utf8_valid(const char* s, size_t len) {
   size_t i = 0;
+  while (i < len && static_cast<unsigned char>(s[i]) < 0x80) i++;
   while (i < len) {
     unsigned char c = s[i];
     size_t n;
@@ -131,75 +332,34 @@ bool utf8_valid(const char* s, size_t len) {
   return true;
 }
 
-std::string make_key(const char* dp, size_t dpl, const char* src, size_t sl,
-                     const char* dst, size_t dl) {
-  // \x00 separators, same anti-ambiguity rule as protocol.stable_flow_key.
-  std::string k;
-  k.reserve(dpl + sl + dl + 2);
-  k.append(dp, dpl);
-  k.push_back('\0');
-  k.append(src, sl);
-  k.push_back('\0');
-  k.append(dst, dl);
-  return k;
-}
-
 Generation& current_gen(Engine* e) {
-  if (e->gens.empty()) e->gens.emplace_back();
+  if (e->gens.empty()) {
+    ++e->gen_seq;
+    e->gens.emplace_back();
+  }
   return e->gens.back();
 }
 
 void push_row(Engine* e, uint32_t slot, uint8_t is_fwd, uint8_t is_create,
               int32_t time, uint64_t pkts, uint64_t bytes) {
-  uint64_t k = (static_cast<uint64_t>(slot) << 1) | is_fwd;
+  size_t k = (static_cast<size_t>(slot) << 1) | is_fwd;
   uint8_t bit = is_create ? 1 : 2;
   Generation* g = &current_gen(e);
-  uint8_t* occ = &g->occ[k];
-  if ((*occ & bit) || g->rows.size() >= e->max_batch) {
+  uint8_t occ = e->occ_epoch[k] == e->gen_seq ? e->occ_bits[k] : 0;
+  if ((occ & bit) || g->rows.size() >= e->max_batch) {
+    ++e->gen_seq;
     e->gens.emplace_back();
     g = &e->gens.back();
-    occ = &g->occ[k];
+    occ = 0;
   }
-  *occ |= bit;
+  e->occ_epoch[k] = e->gen_seq;
+  e->occ_bits[k] = occ | bit;
   g->rows.push_back(Row{slot, time, pkts, bytes, is_fwd, is_create});
 }
 
-// Route one parsed record (the FlowIndex.assign logic).
-void route(Engine* e, const char* dp, size_t dpl, const char* src, size_t sl,
-           const char* dst, size_t dl, int32_t time, uint64_t pkts,
-           uint64_t bytes) {
-  std::string key = make_key(dp, dpl, src, sl, dst, dl);
-  auto it = e->key_to_slot.find(key);
-  if (it != e->key_to_slot.end()) {
-    push_row(e, it->second, 1, 0, time, pkts, bytes);
-    return;
-  }
-  std::string rkey = make_key(dp, dpl, dst, dl, src, sl);
-  it = e->key_to_slot.find(rkey);
-  if (it != e->key_to_slot.end()) {
-    push_row(e, it->second, 0, 0, time, pkts, bytes);
-    return;
-  }
-  uint32_t slot;
-  if (!e->free_slots.empty()) {
-    slot = e->free_slots.back();
-    e->free_slots.pop_back();
-  } else if (e->next_slot < e->capacity) {
-    slot = e->next_slot++;
-  } else {
-    e->dropped++;
-    return;
-  }
-  e->key_to_slot.emplace(key, slot);
-  e->slot_key[slot] = std::move(key);
-  e->slot_src[slot].assign(src, sl);
-  e->slot_dst[slot].assign(dst, dl);
-  push_row(e, slot, 1, 1, time, pkts, bytes);
-}
-
-// Parse one complete line (no trailing \n). Returns true if it was a
-// telemetry record (counted), false for headers / controller logs.
-bool ingest_line(Engine* e, const char* line, size_t len) {
+// Parse one complete line (no trailing \n) without touching engine state.
+// Returns true iff it is a valid telemetry record.
+bool parse_rec(const char* line, size_t len, bool eager_rfp, ParsedRec* out) {
   // prefix match, like the reference's line.startswith('data')
   // (traffic_classifier.py:152)
   if (len < 4 || std::memcmp(line, "data", 4) != 0) return false;
@@ -233,13 +393,113 @@ bool ingest_line(Engine* e, const char* line, size_t len) {
   // f[2]=datapath f[4]=eth_src f[5]=eth_dst (f[3]=in_port f[6]=out_port
   // are carried by the wire format but unused for keying, same as the
   // reference)
-  route(e, f[2], fl[2], f[4], fl[4], f[5], fl[5],
-        static_cast<int32_t>(time), static_cast<uint64_t>(pkts),
-        static_cast<uint64_t>(bytes));
-  e->parsed++;
-  if (static_cast<int32_t>(time) > e->last_time)
-    e->last_time = static_cast<int32_t>(time);
+  out->dp = f[2];
+  out->dp_len = static_cast<uint32_t>(fl[2]);
+  out->src = f[4];
+  out->src_len = static_cast<uint32_t>(fl[4]);
+  out->dst = f[5];
+  out->dst_len = static_cast<uint32_t>(fl[5]);
+  out->time = static_cast<int32_t>(time);
+  out->pkts = static_cast<uint64_t>(pkts);
+  out->bytes = static_cast<uint64_t>(bytes);
+  out->fp = flow_fingerprint(f[2], fl[2], f[4], fl[4], f[5], fl[5]);
+  if (eager_rfp) {
+    // worker threads pre-hash the reverse key too: the sequential router
+    // then never hashes, only probes
+    out->rfp = flow_fingerprint(f[2], fl[2], f[5], fl[5], f[4], fl[4]);
+    out->has_rfp = 1;
+  } else {
+    out->has_rfp = 0;
+  }
   return true;
+}
+
+// Route one parsed record (the FlowIndex.assign logic). MUST run in
+// original record order — slot assignment is order-dependent and the
+// Python oracle is sequential.
+void route_rec(Engine* e, const ParsedRec& r) {
+  uint32_t* hit = e->key_to_slot.find(r.fp);
+  if (hit != nullptr) {
+    push_row(e, *hit, 1, 0, r.time, r.pkts, r.bytes);
+  } else {
+    uint64_t rfp = r.has_rfp
+                       ? r.rfp
+                       : flow_fingerprint(r.dp, r.dp_len, r.dst, r.dst_len,
+                                          r.src, r.src_len);
+    hit = e->key_to_slot.find(rfp);
+    if (hit != nullptr) {
+      push_row(e, *hit, 0, 0, r.time, r.pkts, r.bytes);
+    } else {
+      uint32_t slot;
+      if (!e->free_slots.empty()) {
+        slot = e->free_slots.back();
+        e->free_slots.pop_back();
+      } else if (e->next_slot < e->capacity) {
+        slot = e->next_slot++;
+      } else {
+        e->dropped++;
+        e->parsed++;
+        if (r.time > e->last_time) e->last_time = r.time;
+        return;
+      }
+      e->key_to_slot.insert(r.fp, slot);
+      e->slot_fp[slot] = r.fp;
+      e->slot_used[slot] = 1;
+      e->slot_src[slot].assign(r.src, r.src_len);
+      e->slot_dst[slot].assign(r.dst, r.dst_len);
+      push_row(e, slot, 1, 1, r.time, r.pkts, r.bytes);
+    }
+  }
+  e->parsed++;
+  if (r.time > e->last_time) e->last_time = r.time;
+}
+
+inline void parse_and_route(Engine* e, const char* line, size_t len) {
+  ParsedRec r;
+  if (parse_rec(line, len, /*eager_rfp=*/false, &r)) route_rec(e, r);
+}
+
+// Parse every line in [buf+begin, buf+end) into out (telemetry lines
+// only). begin must sit at a line start; end at a line end (past '\n').
+void parse_region(const char* buf, size_t begin, size_t end,
+                  std::vector<ParsedRec>* out) {
+  size_t start = begin;
+  while (start < end) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(buf + start, '\n', end - start));
+    if (nl == nullptr) break;  // caller guarantees end is past a '\n'
+    size_t i = static_cast<size_t>(nl - buf);
+    ParsedRec r;
+    if (parse_rec(buf + start, i - start, /*eager_rfp=*/true, &r))
+      out->push_back(r);
+    start = i + 1;
+  }
+}
+
+// Threaded feed: split [begin, end) at line boundaries, parse in
+// parallel, route sequentially. Only called when end-begin is large and
+// the host has >1 core.
+void feed_threaded(Engine* e, const char* buf, size_t begin, size_t end,
+                   size_t nthreads) {
+  std::vector<size_t> cut(nthreads + 1, begin);
+  cut[nthreads] = end;
+  size_t span = (end - begin) / nthreads;
+  for (size_t t = 1; t < nthreads; t++) {
+    size_t c = begin + t * span;
+    while (c < end && buf[c - 1] != '\n') c++;  // advance to a line start
+    cut[t] = c < cut[t - 1] ? cut[t - 1] : c;
+  }
+  std::vector<std::vector<ParsedRec>> outs(nthreads);
+  std::vector<std::thread> workers;
+  workers.reserve(nthreads - 1);
+  for (size_t t = 1; t < nthreads; t++) {
+    workers.emplace_back(parse_region, buf, cut[t], cut[t + 1], &outs[t]);
+  }
+  parse_region(buf, cut[0], cut[1], &outs[0]);
+  for (auto& w : workers) w.join();
+  for (size_t t = 0; t < nthreads; t++) {
+    for (const ParsedRec& r : outs[t]) route_rec(e, r);
+  }
 }
 
 }  // namespace
@@ -247,7 +507,8 @@ bool ingest_line(Engine* e, const char* line, size_t len) {
 extern "C" {
 
 void* tc_engine_create(uint32_t capacity, uint32_t max_batch) {
-  if (capacity == 0 || max_batch == 0) return nullptr;
+  // capacity is bounded below the FpMap sentinel slot values
+  if (capacity == 0 || max_batch == 0 || capacity >= kTomb) return nullptr;
   return new Engine(capacity, max_batch);
 }
 
@@ -258,19 +519,41 @@ void tc_engine_destroy(void* h) { delete static_cast<Engine*>(h); }
 uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len) {
   Engine* e = static_cast<Engine*>(h);
   uint64_t before = e->parsed;
-  size_t start = 0;
-  for (size_t i = 0; i < len; i++) {
-    if (buf[i] != '\n') continue;
-    if (e->tail.empty()) {
-      ingest_line(e, buf + start, i - start);
-    } else {
-      e->tail.append(buf + start, i - start);
-      ingest_line(e, e->tail.data(), e->tail.size());
-      e->tail.clear();
+  size_t begin = 0;
+  if (!e->tail.empty()) {
+    // complete the carried partial line first (routes before anything
+    // parsed from this chunk — order preserved)
+    const char* p = static_cast<const char*>(std::memchr(buf, '\n', len));
+    if (p == nullptr) {
+      e->tail.append(buf, len);
+      return 0;
     }
-    start = i + 1;
+    size_t nl = static_cast<size_t>(p - buf);
+    e->tail.append(buf, nl);
+    parse_and_route(e, e->tail.data(), e->tail.size());
+    e->tail.clear();
+    begin = nl + 1;
   }
-  if (start < len) e->tail.append(buf + start, len - start);
+  size_t last_nl = len;  // one past the final '\n'
+  while (last_nl > begin && buf[last_nl - 1] != '\n') last_nl--;
+  if (last_nl > begin) {
+    static const size_t hw = std::thread::hardware_concurrency();
+    const size_t nthreads = hw > 8 ? 8 : hw;
+    if (nthreads >= 2 && last_nl - begin >= (1u << 21)) {
+      feed_threaded(e, buf, begin, last_nl, nthreads);
+    } else {
+      size_t start = begin;
+      while (start < last_nl) {
+        const char* nl = static_cast<const char*>(
+            std::memchr(buf + start, '\n', last_nl - start));
+        if (nl == nullptr) break;
+        size_t i = static_cast<size_t>(nl - buf);
+        parse_and_route(e, buf + start, i - start);
+        start = i + 1;
+      }
+    }
+  }
+  if (last_nl < len) e->tail.append(buf + last_nl, len - last_nl);
   return e->parsed - before;
 }
 
@@ -318,7 +601,7 @@ int32_t tc_engine_last_time(void* h) {
 
 uint32_t tc_engine_num_flows(void* h) {
   Engine* e = static_cast<Engine*>(h);
-  return static_cast<uint32_t>(e->key_to_slot.size());
+  return static_cast<uint32_t>(e->key_to_slot.used);
 }
 
 // Copy the (src, dst) MAC strings for a slot into caller buffers of size
@@ -327,7 +610,7 @@ uint32_t tc_engine_num_flows(void* h) {
 int tc_engine_slot_meta(void* h, uint32_t slot, char* src_out, char* dst_out,
                         uint32_t cap) {
   Engine* e = static_cast<Engine*>(h);
-  if (slot >= e->capacity || e->slot_key[slot].empty() || cap == 0) return 0;
+  if (slot >= e->capacity || !e->slot_used[slot] || cap == 0) return 0;
   std::snprintf(src_out, cap, "%s", e->slot_src[slot].c_str());
   std::snprintf(dst_out, cap, "%s", e->slot_dst[slot].c_str());
   return 1;
@@ -338,9 +621,9 @@ int tc_engine_slot_meta(void* h, uint32_t slot, char* src_out, char* dst_out,
 // FlowStateEngine.evict_idle.
 void tc_engine_release_slot(void* h, uint32_t slot) {
   Engine* e = static_cast<Engine*>(h);
-  if (slot >= e->capacity || e->slot_key[slot].empty()) return;
-  e->key_to_slot.erase(e->slot_key[slot]);
-  e->slot_key[slot].clear();
+  if (slot >= e->capacity || !e->slot_used[slot]) return;
+  e->key_to_slot.erase(e->slot_fp[slot]);
+  e->slot_used[slot] = 0;
   e->slot_src[slot].clear();
   e->slot_dst[slot].clear();
   e->free_slots.push_back(slot);

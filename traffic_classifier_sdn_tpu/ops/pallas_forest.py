@@ -15,9 +15,12 @@ HBM traffic collapses to: read X once, write (N, C) probabilities once,
 re-stream ~1 MB of tree operands per row tile. Grid iterates tree-chunks
 fastest, so the output block stays resident and accumulates across chunks.
 
-Semantics match tree_gemm (and hence sklearn predict_proba) exactly; the
-parity test runs this kernel in interpreter mode on CPU and compiled on
-TPU.
+Semantics match tree_gemm (and hence sklearn predict_proba) exactly.
+Coverage: tests/test_tree_kernels.py runs this kernel in interpreter mode
+on CPU; compiled-on-TPU execution, argmax parity, and timing vs the XLA
+GEMM path are exercised by ``bench.py`` (``pallas_forest_*`` fields in the
+bench JSON) and by ``tools/tpu_proof.py``, which records the result in
+``docs/artifacts/``.
 """
 
 from __future__ import annotations
