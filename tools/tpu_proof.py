@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Compiled-on-TPU proof artifact for the two Pallas kernels.
+
+Runs ``ops/pallas_forest.py`` and ``ops/pallas_rbf.py`` COMPILED (never
+interpret mode) on the default platform, asserts argmax parity against
+independent oracles (vectorized NumPy node-walk of the checkpoint trees;
+sklearn's own ``SVC.predict``) and against the XLA production paths
+(``ops/tree_gemm``, ``models/svc``), races both pairs at two batch sizes,
+and writes one JSON artifact to ``docs/artifacts/`` — the evidence VERDICT
+round 2 found missing (the kernels had only ever run interpreted on CPU).
+
+Usage: tools/tpu_proof.py [--out docs/artifacts/tpu_proof.json]
+                          [--batches 16384,131072]
+
+The kernels' HBM-traffic claims live in their module docstrings
+(ops/pallas_forest.py, ops/pallas_rbf.py); the reference hot loop they
+replace is sklearn's fused Cython predict at traffic_classifier.py:103-106.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="docs/artifacts/tpu_proof.json")
+    ap.add_argument("--batches", default="16384,131072")
+    ap.add_argument("--models-dir", default="/root/reference/models")
+    ap.add_argument("--data-dir", default="/root/reference/datasets")
+    args = ap.parse_args()
+    batches = [int(b) for b in args.batches.split(",")]
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+    from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
+    from traffic_classifier_sdn_tpu.models import svc as svc_mod
+    from traffic_classifier_sdn_tpu.ops import pallas_forest, pallas_rbf, tree_gemm
+
+    t0 = time.time()
+    platform = jax.devices()[0].platform
+    out: dict = {
+        "metric": "pallas_compiled_proof",
+        "platform": platform,
+        "interpret_mode": False,
+        "batches": batches,
+    }
+    if platform != "tpu":
+        out["warning"] = (
+            "not running on TPU — Pallas compiles are Mosaic/TPU-only; "
+            "this artifact only proves the claim on platform=tpu"
+        )
+
+    ds = load_reference_datasets(args.data_dir)
+    rng = np.random.RandomState(0)
+    X_big = np.abs(
+        rng.gamma(1.5, 200.0, (max(batches), 12))
+    ).astype(np.float32)
+
+    # ---- forest: fused Pallas vs XLA GEMM form vs NumPy node-walk -------
+    forest_raw = ski.import_forest(f"{args.models_dir}/RandomForestClassifier")
+    g_gemm = tree_gemm.compile_forest(forest_raw)
+    g_pal = pallas_forest.compile_forest(forest_raw)
+    Xd = jnp.asarray(ds.X, jnp.float32)
+    want = bench._numpy_forest_labels(forest_raw, ds.X)
+    got_pal = np.asarray(jax.jit(pallas_forest.predict)(g_pal, Xd))
+    got_gemm = np.asarray(jax.jit(tree_gemm.predict)(g_gemm, Xd))
+    out["forest"] = {
+        "parity_rows": int(ds.X.shape[0]),
+        "pallas_vs_oracle_pct": round(
+            float((got_pal == want).mean() * 100.0), 3
+        ),
+        "xla_vs_oracle_pct": round(
+            float((got_gemm == want).mean() * 100.0), 3
+        ),
+        "pallas_vs_xla_pct": round(
+            float((got_pal == got_gemm).mean() * 100.0), 3
+        ),
+        "timings_device_ms": {},
+    }
+
+    def forest_sum(g, X):
+        return jnp.sum(tree_gemm.predict(g, X)).astype(jnp.float32)
+
+    def pallas_fsum(g, X):
+        return jnp.sum(pallas_forest.predict(g, X)).astype(jnp.float32)
+
+    for b in batches:
+        X = jnp.asarray(X_big[:b])
+        it = bench._loop_iters(b)
+        out["forest"]["timings_device_ms"][str(b)] = {
+            "pallas": round(bench._timed_loop(pallas_fsum, g_pal, X, it) * 1e3, 3),
+            "xla_gemm": round(bench._timed_loop(forest_sum, g_gemm, X, it) * 1e3, 3),
+        }
+    print(json.dumps({"forest": out["forest"]}), flush=True)
+
+    # ---- SVC: fused Pallas RBF vs XLA path vs sklearn -------------------
+    import pickle
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    svc_raw = ski.import_svc(f"{args.models_dir}/SVC")
+    svc_params = svc_mod.from_numpy(svc_raw, dtype=jnp.float32)
+    g_rbf = pallas_rbf.compile_svc(svc_params)
+    with open(f"{args.models_dir}/SVC", "rb") as fh:
+        est = pickle.load(fh)
+    lut = {str(c): i for i, c in enumerate(svc_raw["classes"])}
+    want_svc = np.array([lut[str(v)] for v in est.predict(ds.X)])
+    X_hi, X_lo = svc_mod.split_hilo(ds.X)
+    got_rbf = np.asarray(jax.jit(pallas_rbf.predict)(g_rbf, X_hi, X_lo))
+    got_xla = np.asarray(jax.jit(svc_mod.predict)(svc_params, X_hi, X_lo))
+    out["svc"] = {
+        "parity_rows": int(ds.X.shape[0]),
+        "pallas_vs_sklearn_pct": round(
+            float((got_rbf == want_svc).mean() * 100.0), 3
+        ),
+        "xla_vs_sklearn_pct": round(
+            float((got_xla == want_svc).mean() * 100.0), 3
+        ),
+        "timings_device_ms": {},
+    }
+
+    def svc_sum(p, X):
+        return jnp.sum(svc_mod.predict(p, X)).astype(jnp.float32)
+
+    def rbf_sum(g, X):
+        return jnp.sum(pallas_rbf.predict(g, X)).astype(jnp.float32)
+
+    for b in batches:
+        b = min(b, 1 << 16)  # the (N, S) kernel matrix bounds the XLA path
+        X = jnp.asarray(X_big[:b])
+        it = bench._loop_iters(b)
+        out["svc"]["timings_device_ms"][str(b)] = {
+            "pallas": round(bench._timed_loop(rbf_sum, g_rbf, X, it) * 1e3, 3),
+            "xla": round(bench._timed_loop(svc_sum, svc_params, X, it) * 1e3, 3),
+        }
+
+    out["elapsed_s"] = round(time.time() - t0, 1)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as fh:
+        fh.write(json.dumps(out) + "\n")
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

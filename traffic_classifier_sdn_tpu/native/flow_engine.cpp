@@ -64,9 +64,11 @@ namespace {
 // BLAKE2b-64 for the same key. The two paths never share a table, and
 // routing behavior depends only on fingerprint hit/miss patterns, so
 // native and Python routing agree except when either function collides:
-// ~2^-44 birthday probability at 2^20 live flows, the same order as the
-// Python path's own collision acceptance. A collision merges two flows'
-// counters — the identical failure mode the oracle already accepts.
+// birthday probability ~(2^20)²/2 / 2^64 = 2^-25 at 2^20 live flows —
+// the same order as the Python path's own BLAKE2b-64 collision
+// acceptance (both are 64-bit fingerprints; only the mixing function
+// differs). A collision merges two flows' counters — the identical
+// failure mode the oracle already accepts.
 // ---------------------------------------------------------------------------
 
 inline uint64_t mum_mix(uint64_t a, uint64_t b) {
