@@ -214,7 +214,9 @@ def measure(batches: list[int]) -> None:
             "sklearn RandomForestClassifier.predict (batched, same host "
             "CPU, faster of n_jobs=None and n_jobs=-1)"
         ),
-        "forest_path": "xla_tree_gemm",
+        # size-bucketed GEMM form (tree_gemm.ForestGemmGroups) — labeled
+        # distinctly from prior rounds' single-group "xla_tree_gemm"
+        "forest_path": "xla_tree_gemm_bucketed",
     }
 
     def emit() -> None:

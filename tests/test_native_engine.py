@@ -221,3 +221,63 @@ def test_malformed_counters_rejected_by_both_paths():
     assert FlowStateEngine(capacity=8, native=True).ingest_bytes(
         b"data\t3\t1\t1\taa\tbb\t2\t10\t40\x00\n"
     ) == 0
+
+
+def test_native_threaded_parse_matches_python():
+    """The multi-threaded parse path (worker threads split the chunk at
+    line boundaries; routing stays sequential) must be record-for-record
+    identical to the Python oracle. Single-core CI hosts never trigger it
+    by size, so force it via TC_ENGINE_THREADS in a fresh process (the
+    engine latches the env var on first feed)."""
+    import os
+    import subprocess
+    import sys
+
+    code = r"""
+import numpy as np
+from traffic_classifier_sdn_tpu.core import flow_table as ft
+from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+from traffic_classifier_sdn_tpu.ingest.protocol import TelemetryRecord, format_line
+
+rng = np.random.RandomState(11)
+macs = [f"00:00:00:00:{j:02x}:{i:02x}" for j in range(4) for i in range(32)]
+counters = {}
+py = FlowStateEngine(capacity=512, native=False)
+nat = FlowStateEngine(capacity=512, native=True)
+for t in range(1, 5):
+    recs = []
+    for _ in range(3000):
+        a, b = rng.choice(len(macs), 2, replace=False)
+        key = (macs[a], macs[b])
+        pk, by = counters.get(key, (0, 0))
+        pk += int(rng.randint(1, 50)); by += int(rng.randint(40, 5000))
+        counters[key] = (pk, by)
+        recs.append(TelemetryRecord(time=t, datapath="1", in_port=str(a),
+                    eth_src=macs[a], eth_dst=macs[b], out_port=str(b),
+                    packets=pk, bytes=by))
+    py.ingest(recs)
+    data = b"junk line\n" + b"".join(format_line(r) for r in recs)
+    # feed in two chunks split mid-line: the tail seam must compose with
+    # the threaded region
+    cut = len(data) // 2 + 3
+    n = nat.ingest_bytes(data[:cut]) + nat.ingest_bytes(data[cut:])
+    assert n == len(recs), (n, len(recs))
+    py.step(); nat.step()
+    np.testing.assert_array_equal(
+        np.asarray(ft.features12(py.table)),
+        np.asarray(ft.features12(nat.table)),
+    )
+    assert py.num_flows() == nat.num_flows()
+print("THREADED_PARITY_OK")
+"""
+    env = dict(os.environ)
+    env["TC_ENGINE_THREADS"] = "4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=240, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "THREADED_PARITY_OK" in r.stdout

@@ -53,3 +53,27 @@ def test_pallas_row_padding(forest_dict, X, want):
     g = pallas_forest.compile_forest(forest_dict, row_tile=512, tree_chunk=10)
     got = np.asarray(pallas_forest.predict(g, X[:777], interpret=True))
     np.testing.assert_array_equal(got, want[:777])
+
+
+def test_gemm_bucketed_matches_single_group(forest_dict, X, want):
+    """Size-bucketed compilation (per-bucket padding) must predict the
+    same argmax as the single-group form and the gather traversal, and
+    its group probabilities must sum to the ensemble mean."""
+    g1 = tree_gemm.compile_forest(forest_dict, n_buckets=1)
+    gb = tree_gemm.compile_forest(forest_dict, n_buckets=4)
+    assert isinstance(gb, tree_gemm.ForestGemmGroups)
+    assert len(gb.groups) == 4
+    np.testing.assert_array_equal(np.asarray(tree_gemm.predict(gb, X)), want)
+    p1 = np.asarray(tree_gemm.forest_proba_gemm(g1, X))
+    pb = np.asarray(tree_gemm.forest_proba_gemm(gb, X))
+    np.testing.assert_allclose(pb, p1, rtol=1e-5, atol=1e-7)
+    # padding actually shrank: total stage-2 operand volume is smaller
+    vol1 = g1.path.shape[0] * g1.path.shape[1] * g1.path.shape[2]
+    volb = sum(g.path.shape[0] * g.path.shape[1] * g.path.shape[2]
+               for g in gb.groups)
+    assert volb < 0.5 * vol1
+
+
+def test_gemm_bucketed_row_chunking(forest_dict, X, want):
+    gb = tree_gemm.compile_forest(forest_dict, row_chunk=256, n_buckets=3)
+    np.testing.assert_array_equal(np.asarray(tree_gemm.predict(gb, X)), want)

@@ -43,6 +43,7 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <string>
@@ -488,6 +489,9 @@ void feed_threaded(Engine* e, const char* buf, size_t begin, size_t end,
   size_t span = (end - begin) / nthreads;
   for (size_t t = 1; t < nthreads; t++) {
     size_t c = begin + t * span;
+    // never inspect buf[begin-1]: with a tiny forced-thread region span
+    // can be 0 and begin can be 0 (late cuts then collapse to empty)
+    if (c < begin + 1) c = begin + 1;
     while (c < end && buf[c - 1] != '\n') c++;  // advance to a line start
     cut[t] = c < cut[t - 1] ? cut[t - 1] : c;
   }
@@ -539,9 +543,18 @@ uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len) {
   size_t last_nl = len;  // one past the final '\n'
   while (last_nl > begin && buf[last_nl - 1] != '\n') last_nl--;
   if (last_nl > begin) {
+    // TC_ENGINE_THREADS overrides both the thread count and the size
+    // threshold (testing: forces the threaded path on single-core CI
+    // hosts, where it would otherwise never execute).
+    static const long forced = [] {
+      const char* v = std::getenv("TC_ENGINE_THREADS");
+      return v != nullptr ? std::atol(v) : 0L;
+    }();
     static const size_t hw = std::thread::hardware_concurrency();
-    const size_t nthreads = hw > 8 ? 8 : hw;
-    if (nthreads >= 2 && last_nl - begin >= (1u << 21)) {
+    const size_t nthreads =
+        forced > 0 ? static_cast<size_t>(forced) : (hw > 8 ? 8 : hw);
+    const size_t threshold = forced > 0 ? 1 : (1u << 21);
+    if (nthreads >= 2 && last_nl - begin >= threshold) {
       feed_threaded(e, buf, begin, last_nl, nthreads);
     } else {
       size_t start = begin;
