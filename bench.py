@@ -287,25 +287,35 @@ def measure(batches: list[int]) -> None:
     emit()
 
     # --- 4. Pallas forest kernel: compiled, parity-checked, raced --------
+    # both layouts race: one fused call over uniformly-padded trees vs
+    # size-bucketed per-group calls (smaller VMEM operands per tile)
     pallas_batch = min(max(batches), 1 << 17)
     try:
         from traffic_classifier_sdn_tpu.ops import pallas_forest
 
-        gp = pallas_forest.compile_forest(forest_raw)
         Xp = jnp.asarray(X_big[:pallas_batch])
 
         def pallas_sum(gp, X):
             return jnp.sum(pallas_forest.predict(gp, X)).astype(jnp.float32)
 
-        got_pf = np.asarray(
-            jax.jit(pallas_forest.predict)(gp, Xd32)
-        )
-        pf_parity = float((got_pf == want_forest).mean() * 100.0)
-        sec_pallas = _timed_loop(pallas_sum, gp, Xp, _loop_iters(pallas_batch))
+        sec_pallas, pf_parity, variant = np.inf, 0.0, "none"
+        for nb in (1, 8):
+            gp = pallas_forest.compile_forest(forest_raw, n_buckets=nb)
+            got_pf = np.asarray(jax.jit(pallas_forest.predict)(gp, Xd32))
+            pct = float((got_pf == want_forest).mean() * 100.0)
+            sec = _timed_loop(pallas_sum, gp, Xp, _loop_iters(pallas_batch))
+            line[f"pallas_forest_b{nb}_device_ms"] = round(sec * 1e3, 3)
+            line[f"pallas_forest_b{nb}_parity_pct"] = round(pct, 3)
+            pf_parity = max(pf_parity, pct)  # best observed, diagnostic
+            if pct == 100.0 and sec < sec_pallas:
+                sec_pallas, variant = sec, f"b{nb}"
+            emit()
+        line["pallas_forest_variant"] = variant
         sec_gemm_same = _timed_loop(
             forest_sum, g, Xp, _loop_iters(pallas_batch)
         )
-        line["pallas_forest_device_ms"] = round(sec_pallas * 1e3, 3)
+        if np.isfinite(sec_pallas):  # at least one variant passed parity
+            line["pallas_forest_device_ms"] = round(sec_pallas * 1e3, 3)
         line["pallas_forest_parity_pct"] = round(pf_parity, 3)
         line["xla_forest_device_ms_same_batch"] = round(sec_gemm_same * 1e3, 3)
         line["pallas_forest_batch"] = pallas_batch

@@ -77,3 +77,14 @@ def test_gemm_bucketed_matches_single_group(forest_dict, X, want):
 def test_gemm_bucketed_row_chunking(forest_dict, X, want):
     gb = tree_gemm.compile_forest(forest_dict, row_chunk=256, n_buckets=3)
     np.testing.assert_array_equal(np.asarray(tree_gemm.predict(gb, X)), want)
+
+
+def test_pallas_bucketed_interpret_matches(forest_dict, X, want):
+    """Bucketed Pallas compilation (per-bucket VMEM padding) must agree
+    with the gather traversal in interpreter mode."""
+    g = pallas_forest.compile_forest(
+        forest_dict, row_tile=256, tree_chunk=8, n_buckets=4
+    )
+    assert isinstance(g, pallas_forest.ForestPallasGroups)
+    got = np.asarray(pallas_forest.predict(g, X, interpret=True))
+    np.testing.assert_array_equal(got, want)

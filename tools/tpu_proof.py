@@ -72,16 +72,21 @@ def main() -> None:
 
     # ---- forest: fused Pallas vs XLA GEMM form vs NumPy node-walk -------
     forest_raw = ski.import_forest(f"{args.models_dir}/RandomForestClassifier")
-    g_gemm = tree_gemm.compile_forest(forest_raw)
+    g_gemm = tree_gemm.compile_forest(forest_raw)  # bucketed by default
     g_pal = pallas_forest.compile_forest(forest_raw)
+    g_pal_b = pallas_forest.compile_forest(forest_raw, n_buckets=8)
     Xd = jnp.asarray(ds.X, jnp.float32)
     want = bench._numpy_forest_labels(forest_raw, ds.X)
     got_pal = np.asarray(jax.jit(pallas_forest.predict)(g_pal, Xd))
+    got_pal_b = np.asarray(jax.jit(pallas_forest.predict)(g_pal_b, Xd))
     got_gemm = np.asarray(jax.jit(tree_gemm.predict)(g_gemm, Xd))
     out["forest"] = {
         "parity_rows": int(ds.X.shape[0]),
         "pallas_vs_oracle_pct": round(
             float((got_pal == want).mean() * 100.0), 3
+        ),
+        "pallas_bucketed_vs_oracle_pct": round(
+            float((got_pal_b == want).mean() * 100.0), 3
         ),
         "xla_vs_oracle_pct": round(
             float((got_gemm == want).mean() * 100.0), 3
@@ -103,7 +108,12 @@ def main() -> None:
         it = bench._loop_iters(b)
         out["forest"]["timings_device_ms"][str(b)] = {
             "pallas": round(bench._timed_loop(pallas_fsum, g_pal, X, it) * 1e3, 3),
-            "xla_gemm": round(bench._timed_loop(forest_sum, g_gemm, X, it) * 1e3, 3),
+            "pallas_bucketed": round(
+                bench._timed_loop(pallas_fsum, g_pal_b, X, it) * 1e3, 3
+            ),
+            "xla_gemm_bucketed": round(
+                bench._timed_loop(forest_sum, g_gemm, X, it) * 1e3, 3
+            ),
         }
     print(json.dumps({"forest": out["forest"]}), flush=True)
 

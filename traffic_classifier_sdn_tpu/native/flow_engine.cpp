@@ -548,7 +548,9 @@ uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len) {
     // hosts, where it would otherwise never execute).
     static const long forced = [] {
       const char* v = std::getenv("TC_ENGINE_THREADS");
-      return v != nullptr ? std::atol(v) : 0L;
+      long n = v != nullptr ? std::atol(v) : 0L;
+      return n > 16 ? 16L : n;  // clamp: typo'd values must not fork
+                                // thousands of threads in the hot path
     }();
     static const size_t hw = std::thread::hardware_concurrency();
     const size_t nthreads =

@@ -50,10 +50,44 @@ class ForestPallas(struct.PyTreeNode):
     tree_chunk: int = struct.field(pytree_node=False)
 
 
+class ForestPallasGroups(struct.PyTreeNode):
+    """Size-bucketed variant, mirroring tree_gemm.ForestGemmGroups: trees
+    sorted by D·L and compiled per-bucket so each bucket's VMEM operands
+    are padded only to its own (D, L) — smaller tree-chunk blocks for the
+    small trees, less streamed traffic per row tile. Group leaf values are
+    pre-divided by the FULL tree count; summing group probabilities gives
+    the ensemble mean."""
+
+    groups: tuple  # of ForestPallas
+    n_classes: int = struct.field(pytree_node=False)
+
+
 def compile_forest(
-    d: dict, row_tile: int = 512, tree_chunk: int = 20
+    d: dict, row_tile: int = 512, tree_chunk: int = 20, n_buckets: int = 1
+) -> ForestPallas | ForestPallasGroups:
+    buckets = tree_gemm.split_tree_buckets(d, n_buckets)
+    groups = [
+        _compile_single(
+            sub, row_tile,
+            min(tree_chunk, sub["left"].shape[0]),
+            n_features=nf, n_trees_total=nt,
+        )
+        for sub, nf, nt in buckets
+    ]
+    if len(groups) == 1:
+        return groups[0]
+    return ForestPallasGroups(
+        groups=tuple(groups), n_classes=groups[0].n_classes
+    )
+
+
+def _compile_single(
+    d: dict, row_tile: int, tree_chunk: int,
+    n_features: int | None = None, n_trees_total: int | None = None,
 ) -> ForestPallas:
-    ops = tree_gemm.build_gemm_operands(d)
+    ops = tree_gemm.build_gemm_operands(
+        d, n_features=n_features, n_trees_total=n_trees_total
+    )
     T, D, L = ops["n_trees"], ops["n_internal"], ops["n_leaves"]
     # pad tree count to a multiple of tree_chunk with inert trees
     # (zero leaf_values rows contribute nothing; depth 127 never matches)
@@ -129,9 +163,15 @@ def _kernel(
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def forest_proba_pallas(
-    g: ForestPallas, X: jax.Array, interpret: bool = False
+    g: ForestPallas | ForestPallasGroups, X: jax.Array,
+    interpret: bool = False,
 ) -> jax.Array:
     """(N, C) ensemble-mean class distributions via the fused kernel."""
+    if isinstance(g, ForestPallasGroups):
+        out = forest_proba_pallas(g.groups[0], X, interpret=interpret)
+        for sub in g.groups[1:]:
+            out = out + forest_proba_pallas(sub, X, interpret=interpret)
+        return out
     N, F = X.shape
     TILE, TC = g.row_tile, g.tree_chunk
     D, L, C = g.n_internal, g.n_leaves, g.n_classes
@@ -162,7 +202,10 @@ def forest_proba_pallas(
     return out[:N]
 
 
-def predict(g: ForestPallas, X: jax.Array, interpret: bool = False) -> jax.Array:
+def predict(
+    g: ForestPallas | ForestPallasGroups, X: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
     return jnp.argmax(
         forest_proba_pallas(g, X, interpret=interpret), axis=-1
     ).astype(jnp.int32)

@@ -193,6 +193,39 @@ def _tree_sizes(d: dict) -> np.ndarray:
     return np.asarray(sizes)
 
 
+def split_tree_buckets(
+    d: dict, n_buckets: int, n_features: int | None = None
+) -> list[tuple[dict, int, int]]:
+    """Partition an importer forest dict into size buckets for independent
+    compilation (shared by the XLA GEMM path and the fused Pallas kernel):
+    trees sorted by their D·L stage-2 FLOP weight, split into
+    ``n_buckets`` equal-count groups. Returns
+    ``[(sub_dict, n_features, n_trees_total), ...]`` — feature width is
+    resolved ONCE over the whole forest (a per-bucket fallback would infer
+    mismatched feat_onehot widths from each subset's own max split
+    feature), and the total tree count is the ensemble-mean divisor every
+    bucket must share."""
+    n_trees = d["left"].shape[0]
+    n_buckets = max(1, min(n_buckets, n_trees))
+    if n_features is None:
+        n_features = int(
+            d.get("n_features", int(np.max(d["feature"])) + 1)
+        )
+    if n_buckets == 1:
+        return [(d, n_features, n_trees)]
+    order = np.argsort(_tree_sizes(d), kind="stable")
+    tree_keys = ("left", "right", "feature", "threshold", "values")
+    out = []
+    for part in np.array_split(order, n_buckets):
+        if part.size == 0:
+            continue
+        sub = dict(d)
+        for k in tree_keys:
+            sub[k] = d[k][part]
+        out.append((sub, n_features, n_trees))
+    return out
+
+
 def compile_forest(
     d: dict, row_chunk: int = 32768, n_features: int | None = None,
     n_buckets: int = 8,
@@ -205,32 +238,16 @@ def compile_forest(
     is test- and bench-gated), substantially less padding FLOPs/traffic on
     heterogeneous forests (3.4×/1.9× on the reference checkpoint).
     """
-    n_trees = d["left"].shape[0]
-    n_buckets = max(1, min(n_buckets, n_trees))
-    if n_features is None:
-        # resolve ONCE over the whole forest: a per-bucket fallback would
-        # infer mismatched feat_onehot widths from each subset's own max
-        # split feature
-        n_features = int(
-            d.get("n_features", int(np.max(d["feature"])) + 1)
+    buckets = split_tree_buckets(d, n_buckets, n_features)
+    groups = [
+        _single_group(
+            build_gemm_operands(sub, n_features=nf, n_trees_total=nt),
+            row_chunk,
         )
-    if n_buckets == 1:
-        return _single_group(
-            build_gemm_operands(d, n_features=n_features), row_chunk
-        )
-    order = np.argsort(_tree_sizes(d), kind="stable")
-    tree_keys = ("left", "right", "feature", "threshold", "values")
-    groups = []
-    for part in np.array_split(order, n_buckets):
-        if part.size == 0:
-            continue
-        sub = dict(d)
-        for k in tree_keys:
-            sub[k] = d[k][part]
-        ops = build_gemm_operands(
-            sub, n_features=n_features, n_trees_total=n_trees
-        )
-        groups.append(_single_group(ops, row_chunk))
+        for sub, nf, nt in buckets
+    ]
+    if len(groups) == 1:
+        return groups[0]
     return ForestGemmGroups(
         groups=tuple(groups), n_classes=groups[0].n_classes
     )
