@@ -166,3 +166,17 @@ def test_forest_accuracy_on_reference_data(reference_models_dir, flow_dataset):
     pred_names = np.array(names)[got]
     true_names = np.array(flow_dataset.classes)[flow_dataset.y]
     assert (pred_names == true_names).mean() > 0.97
+
+
+def test_svc_predict_chunked_matches(reference_models_dir, flow_dataset):
+    """Row-chunked SVC predict (streamed (N,S) kernel matrix) must equal
+    the one-shot predict, with and without the hi/lo correction."""
+    d = ski.import_svc(_ref_path(reference_models_dir, "svc"))
+    params = svc.from_numpy(d, dtype=jnp.float32)
+    X_hi, X_lo = svc.split_hilo(flow_dataset.X[:1500])
+    want = np.asarray(svc.predict(params, X_hi, X_lo))
+    got = np.asarray(svc.predict_chunked(params, X_hi, X_lo, row_chunk=256))
+    np.testing.assert_array_equal(got, want)
+    want_plain = np.asarray(svc.predict(params, X_hi))
+    got_plain = np.asarray(svc.predict_chunked(params, X_hi, row_chunk=256))
+    np.testing.assert_array_equal(got_plain, want_plain)

@@ -39,6 +39,12 @@ def main() -> None:
         help="cpu (safe anywhere) or default (real TPU when healthy)",
     )
     ap.add_argument("--table-rows", type=int, default=64)
+    ap.add_argument(
+        "--model", choices=("gnb", "forest"), default="gnb",
+        help="predict stage: gnb (cheapest full-table predict; the CPU "
+        "default) or forest (the flagship 100-tree checkpoint via the "
+        "bucketed GEMM kernel — the realistic TPU serving configuration)",
+    )
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -67,17 +73,31 @@ def main() -> None:
     eng = FlowStateEngine(capacity=cap, native=native)
     syn = SyntheticFlows(n_flows=n_flows, seed=0)
 
-    # 6-class GNB params (synthetic moments — the model family is the
-    # cheapest full-table predict; the forest/SVC cost is bench.py's job)
-    rng = np.random.RandomState(0)
-    params = gnb.from_numpy(
-        {
-            "theta": rng.gamma(2.0, 100.0, (6, 12)),
-            "var": rng.gamma(2.0, 50.0, (6, 12)) + 1.0,
-            "class_prior": np.full(6, 1 / 6),
-        }
-    )
-    predict = jax.jit(gnb.predict)
+    if args.model == "forest":
+        # the flagship checkpoint through the size-bucketed GEMM kernel —
+        # what a TPU serving deployment would actually run per tick
+        from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+        from traffic_classifier_sdn_tpu.ops import tree_gemm
+
+        models_dir = os.environ.get(
+            "TCSDN_MODELS_DIR", "/root/reference/models"
+        )
+        params = tree_gemm.compile_forest(
+            ski.import_forest(f"{models_dir}/RandomForestClassifier")
+        )
+        predict = jax.jit(tree_gemm.predict)
+    else:
+        # 6-class GNB params (synthetic moments — the model family is the
+        # cheapest full-table predict; the forest/SVC cost is bench.py's job)
+        rng = np.random.RandomState(0)
+        params = gnb.from_numpy(
+            {
+                "theta": rng.gamma(2.0, 100.0, (6, 12)),
+                "var": rng.gamma(2.0, 50.0, (6, 12)) + 1.0,
+                "class_prior": np.full(6, 1 / 6),
+            }
+        )
+        predict = jax.jit(gnb.predict)
 
     print(
         f"# generating {args.ticks} ticks × {2 * n_flows} records "
@@ -140,6 +160,7 @@ def main() -> None:
                 },
                 "native_ingest": native,
                 "platform": jax.devices()[0].platform,
+                "predict_model": args.model,
                 "table_rows_rendered": args.table_rows,
             }
         ),
