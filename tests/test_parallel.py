@@ -275,3 +275,19 @@ def test_knn_merge_unpacked_fallback(reference_models_dir, X256, monkeypatch):
                     knn_sharded.tournament_predict):
         fn = builder(m, params, pad_mask=dpad.get("pad_mask"))
         np.testing.assert_array_equal(np.asarray(fn(X256)), want)
+
+
+def test_knn_ring_merge_non_power_of_two_shards(reference_models_dir, X256):
+    """The ring merge must stay exact on shard counts with no power-of-two
+    structure (the tournament rejects these; the ring must not)."""
+    d = ski.import_knn(f"{reference_models_dir}/KNeighbors")
+    single = knn.from_numpy(d, dtype=jnp.float32)
+    want = np.asarray(knn.predict(single, X256))
+
+    m = meshlib.make_mesh(n_data=1, n_state=5, devices=jax.devices()[:5])
+    dpad = knn_sharded.pad_corpus(d, 5)
+    params = knn.from_numpy(dpad, dtype=jnp.float32)
+    ring = knn_sharded.ring_predict(m, params, pad_mask=dpad.get("pad_mask"))
+    np.testing.assert_array_equal(np.asarray(ring(X256)), want)
+    with pytest.raises(ValueError, match="power-of-two"):
+        knn_sharded.tournament_predict(m, params)

@@ -138,28 +138,17 @@ def predict_chunked(
     params: Params, X: jax.Array, X_lo=None, row_chunk: int = 65536
 ) -> jax.Array:
     """``predict`` for batches whose (N, S) kernel matrix would blow HBM
-    (2²⁰ × 2281 f32 ≈ 9.5 GB): rows stream through ``lax.map`` in
-    ``row_chunk`` slices, exactly like tree_gemm's row chunking. The
-    lo-less mode maps over X alone (a zeros X_lo would be semantically
-    identical but costs an extra broadcast pass over the dominant
-    (chunk, S, F) stage — XLA cannot fold a traced map operand)."""
-    from jax import lax
+    (2²⁰ × 2281 f32 ≈ 9.5 GB): rows stream through the shared
+    ``ops.chunking.map_row_chunks`` helper. The lo-less mode maps over X
+    alone (a zeros X_lo would be semantically identical but costs an
+    extra broadcast pass over the dominant (chunk, S, F) stage — XLA
+    cannot fold a traced map operand)."""
+    from ..ops.chunking import map_row_chunks
 
-    N = X.shape[0]
-    chunk = min(row_chunk, N)
-    if N <= chunk:
-        return predict(params, X, X_lo)
-    n_chunks, rem = divmod(N, chunk)
-    Xm = X[: n_chunks * chunk].reshape(n_chunks, chunk, -1)
     if X_lo is None:
-        out = lax.map(lambda xc: predict(params, xc), Xm)
-    else:
-        Xlm = X_lo[: n_chunks * chunk].reshape(n_chunks, chunk, -1)
-        out = lax.map(lambda t: predict(params, t[0], t[1]), (Xm, Xlm))
-    out = out.reshape(-1)
-    if rem:
-        tail_lo = None if X_lo is None else X_lo[n_chunks * chunk:]
-        out = jnp.concatenate(
-            [out, predict(params, X[n_chunks * chunk:], tail_lo)]
+        return map_row_chunks(
+            lambda xc: predict(params, xc), row_chunk, X
         )
-    return out
+    return map_row_chunks(
+        lambda xc, xlo: predict(params, xc, xlo), row_chunk, X, X_lo
+    )

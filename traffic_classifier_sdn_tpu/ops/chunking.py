@@ -1,0 +1,36 @@
+"""Row-chunked mapping over big batches — shared by every predictor whose
+intermediate would not fit HBM in one shot (tree_gemm's (N, T·D)
+comparison matrix, svc's (N, S) kernel matrix).
+
+``lax.map`` keeps the loop on device with ONE compiled body per chunk
+shape; the remainder rows run as a second, smaller program rather than
+padding (the two shapes are stable across calls, so XLA compiles each
+once).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def map_row_chunks(fn, chunk: int, X, *rest):
+    """Apply ``fn(X_slice, *rest_slices)`` over ``chunk``-row slices and
+    concatenate along axis 0. ``rest`` arrays must share X's leading
+    dimension. Calls ``fn`` directly when the batch fits one chunk."""
+    N = X.shape[0]
+    chunk = min(chunk, N)
+    if N <= chunk:
+        return fn(X, *rest)
+    arrays = (X, *rest)
+    n_chunks, rem = divmod(N, chunk)
+    main = tuple(
+        a[: n_chunks * chunk].reshape(n_chunks, chunk, *a.shape[1:])
+        for a in arrays
+    )
+    out = lax.map(lambda t: fn(*t), main)
+    out = out.reshape(n_chunks * chunk, *out.shape[2:])
+    if rem:
+        tail = fn(*(a[n_chunks * chunk:] for a in arrays))
+        out = jnp.concatenate([out, tail])
+    return out

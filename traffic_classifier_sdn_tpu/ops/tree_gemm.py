@@ -281,22 +281,14 @@ def forest_proba_gemm(
     g: ForestGemm | ForestGemmGroups, X: jax.Array
 ) -> jax.Array:
     """(N, C) ensemble-mean class distributions, row-chunked."""
+    from .chunking import map_row_chunks
+
     if isinstance(g, ForestGemmGroups):
         out = forest_proba_gemm(g.groups[0], X)
         for sub in g.groups[1:]:
             out = out + forest_proba_gemm(sub, X)
         return out
-    N = X.shape[0]
-    chunk = min(g.row_chunk, N)
-    if N <= chunk:
-        return _proba_chunk(g, X)
-    n_chunks, rem = divmod(N, chunk)
-    Xmain = X[: n_chunks * chunk].reshape(n_chunks, chunk, -1)
-    out = lax.map(lambda xc: _proba_chunk(g, xc), Xmain)
-    out = out.reshape(n_chunks * chunk, -1)
-    if rem:
-        out = jnp.concatenate([out, _proba_chunk(g, X[n_chunks * chunk:])])
-    return out
+    return map_row_chunks(lambda xc: _proba_chunk(g, xc), g.row_chunk, X)
 
 
 def predict(g: ForestGemm | ForestGemmGroups, X: jax.Array) -> jax.Array:
