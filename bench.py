@@ -238,6 +238,7 @@ def measure(batches: list[int]) -> None:
 
     # --- 1. forest ladder, smallest batch first --------------------------
     ladder: dict = {}
+    flops_per_row = _forest_flops_per_row(g)  # loop-invariant
     best = None  # (flows_per_sec, batch, device_s, e2e_s)
     for b in sorted(batches):
         X = jnp.asarray(X_big[:b])
@@ -256,11 +257,9 @@ def measure(batches: list[int]) -> None:
                 "device_batch_ms": round(best[2] * 1e3, 3),
                 "e2e_p50_batch_ms": round(best[3] * 1e3, 3),
                 "latency_ladder_device_ms": ladder,
-                "forest_matmul_flops_per_row": round(
-                    _forest_flops_per_row(g), 1
-                ),
+                "forest_matmul_flops_per_row": round(flops_per_row, 1),
                 "forest_effective_tflops": round(
-                    _forest_flops_per_row(g) * best[0] / 1e12, 3
+                    flops_per_row * best[0] / 1e12, 3
                 ),
             }
         )

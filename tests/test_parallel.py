@@ -291,3 +291,47 @@ def test_knn_ring_merge_non_power_of_two_shards(reference_models_dir, X256):
     np.testing.assert_array_equal(np.asarray(ring(X256)), want)
     with pytest.raises(ValueError, match="power-of-two"):
         knn_sharded.tournament_predict(m, params)
+
+
+def test_merge_topk_property_vs_numpy_sort():
+    """Adversarial unit check of the sort-free rank merge: random blocks
+    with heavy value ties (quantized values), -inf padding candidates,
+    and unique indices must merge bit-identically to a NumPy
+    lexicographic (value desc, index asc) sort of the union."""
+    from traffic_classifier_sdn_tpu.parallel.knn_sharded import _merge_topk
+
+    rng = np.random.RandomState(5)
+    k = 5
+    for trial in range(20):
+        N = 7
+        # quantized values force cross-block ties; some -inf padding
+        av = np.round(rng.rand(N, k) * 4) / 4.0
+        bv = np.round(rng.rand(N, k) * 4) / 4.0
+        av[rng.rand(N, k) < 0.15] = -np.inf
+        bv[rng.rand(N, k) < 0.15] = -np.inf
+        # unique indices across the union; ints ride as the tie-break key
+        perm = np.stack([rng.permutation(100)[: 2 * k] for _ in range(N)])
+        ai, bi = perm[:, :k], perm[:, k:]
+
+        def order(v, i):
+            # each block must itself be sorted (value desc, index asc)
+            o = np.lexsort((i, -v), axis=-1)
+            return np.take_along_axis(v, o, 1), np.take_along_axis(i, o, 1)
+
+        av, ai = order(av, ai)
+        bv, bi = order(bv, bi)
+        mv, mi, _ = _merge_topk(
+            jnp.asarray(av, jnp.float32), jnp.asarray(ai, jnp.int32),
+            jnp.asarray(bv, jnp.float32), jnp.asarray(bi, jnp.int32), k,
+        )
+        uv = np.concatenate([av, bv], axis=1)
+        ui = np.concatenate([ai, bi], axis=1)
+        o = np.lexsort((ui, -uv), axis=-1)[:, :k]
+        np.testing.assert_array_equal(
+            np.asarray(mv), np.take_along_axis(uv, o, 1).astype(np.float32),
+            err_msg=f"values trial {trial}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(mi), np.take_along_axis(ui, o, 1),
+            err_msg=f"indices trial {trial}",
+        )
