@@ -59,34 +59,100 @@ def bin_features(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
     return out
 
 
+def resolve_max_features(max_features, n_features: int) -> int:
+    """sklearn's ``max_features='sqrt'`` rule, shared by the single-device
+    and distributed fits (a drifted copy would silently break their
+    bit-identity)."""
+    if max_features == "sqrt":
+        return max(1, int(np.sqrt(n_features)))
+    return int(max_features)
+
+
+def _bootstrap_weights(k_boot, n_total: int, window_start, window_len: int,
+                       axis_name: str | None = None):
+    """Multiplicities of rows [window_start, window_start + window_len)
+    under a global ``n_total``-draw bootstrap resample.
+
+    Picks are generated in fixed-size chunks and scattered only into the
+    caller's window, so peak memory is O(chunk + window) — a sharded fit
+    never materializes the global weight vector (each device keeps its own
+    row window). Deterministic in (key, n_total) alone: every shard layout
+    sees the same global resample, which is what keeps the distributed fit
+    bit-identical to the single-device one."""
+    chunk = min(n_total, 1 << 20)
+    n_chunks = -(-n_total // chunk)
+    keys = jax.random.split(k_boot, n_chunks)
+    cidx = jnp.arange(chunk)
+
+    def body(i, w):
+        p = jax.random.randint(keys[i], (chunk,), 0, n_total)
+        valid = i * chunk + cidx < n_total  # mask the final partial chunk
+        local = p - window_start
+        in_win = valid & (local >= 0) & (local < window_len)
+        # out-of-window picks land on the drop slot (index window_len)
+        return w.at[jnp.where(in_win, local, window_len)].add(
+            in_win.astype(jnp.float32)
+        )
+
+    w0 = jnp.zeros(window_len + 1, jnp.float32)
+    if axis_name is not None:
+        # the loop body's output varies per device (window_start comes
+        # from axis_index), so the initial carry must carry the same
+        # varying-manner type or the scan carry check rejects it
+        w0 = jax.lax.pvary(w0, axis_name)
+    w = jax.lax.fori_loop(0, n_chunks, body, w0)
+    return w[:window_len]
+
+
 @partial(
     jax.jit,
     static_argnames=(
-        "n_classes", "max_depth", "n_bins", "max_features", "bootstrap"
+        "n_classes", "max_depth", "n_bins", "max_features", "bootstrap",
+        "axis_name", "n_total_rows",
     ),
 )
 def _build_tree(
     key,
-    Xb,  # (N, F) int32 binned features
+    Xb,  # (N, F) int32 binned features (the LOCAL shard when distributed)
     y,  # (N,) int32
     edges,  # (F, B-1) f32 candidate thresholds
+    mask=None,  # (N,) f32 row validity (0 at distributed padding rows)
     *,
     n_classes: int,
     max_depth: int,
     n_bins: int,
     max_features: int,
     bootstrap: bool,
+    axis_name: str | None = None,
+    n_total_rows: int | None = None,
 ):
+    """One tree. With ``axis_name`` set (inside shard_map over a sharded
+    row axis), per-level class counts and histograms are psum'd, so every
+    device reaches the SAME split decisions — counts are integer-valued
+    f32 (exact under reassociation below 2²⁴ rows), making the
+    distributed fit bit-identical to the single-device one. Randomness
+    (bootstrap picks, feature subsampling) derives from the replicated
+    ``key`` over the GLOBAL row count, so it is shard-layout-invariant."""
     N, F = Xb.shape
     E = n_bins - 1  # candidate split count per feature
     M = 2 ** (max_depth + 1) - 1  # perfect-layout node capacity
+    n_total = N if n_total_rows is None else n_total_rows
 
     k_boot, k_feat = jax.random.split(key)
     if bootstrap:
-        picks = jax.random.randint(k_boot, (N,), 0, N)
-        w = jnp.zeros(N, jnp.float32).at[picks].add(1.0)
+        # global resample from the replicated key, scattered into this
+        # device's row window only (O(chunk + N) memory per device)
+        start = (
+            0 if axis_name is None else jax.lax.axis_index(axis_name) * N
+        )
+        w = _bootstrap_weights(k_boot, n_total, start, N, axis_name)
     else:
         w = jnp.ones(N, jnp.float32)
+    if mask is not None:
+        w = w * mask
+
+    def _global(a):
+        return a if axis_name is None else jax.lax.psum(a, axis_name)
 
     left = jnp.full(M, -1, jnp.int32)
     right = jnp.full(M, -1, jnp.int32)
@@ -105,17 +171,20 @@ def _build_tree(
         off = n_nodes - 1  # global offset of this level
 
         cnt = jnp.zeros((n_nodes, n_classes), jnp.float32)
-        cnt = cnt.at[pos, y].add(wa)  # (nodes, C) node class counts
+        cnt = _global(cnt.at[pos, y].add(wa))  # (nodes, C) class counts
         n_node = jnp.sum(cnt, axis=1)  # (nodes,)
         values = jax.lax.dynamic_update_slice_in_dim(values, cnt, off, 0)
 
         if d == max_depth:
             break  # deepest level: all leaves
 
-        # Class-count histogram over (node, feature, bin, class).
+        # Class-count histogram over (node, feature, bin, class); one
+        # psum per level when distributed (the only communication).
         H = jnp.zeros((n_nodes, F, n_bins, n_classes), jnp.float32)
-        H = H.at[pos[:, None], fi[None, :], Xb, y[:, None]].add(
-            wa[:, None]
+        H = _global(
+            H.at[pos[:, None], fi[None, :], Xb, y[:, None]].add(
+                wa[:, None]
+            )
         )
 
         # All left/right candidates at once: L[n,f,b,c] = count with
@@ -197,8 +266,7 @@ def fit(
     X = np.asarray(X, np.float32)
     y_np = np.asarray(y, np.int32)
     F = X.shape[1]
-    if max_features == "sqrt":
-        max_features = max(1, int(np.sqrt(F)))
+    max_features = resolve_max_features(max_features, F)
 
     edges = make_bins(X, n_bins)
     Xb = jnp.asarray(bin_features(X, edges))
