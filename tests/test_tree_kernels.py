@@ -88,3 +88,18 @@ def test_pallas_bucketed_interpret_matches(forest_dict, X, want):
     assert isinstance(g, pallas_forest.ForestPallasGroups)
     got = np.asarray(pallas_forest.predict(g, X, interpret=True))
     np.testing.assert_array_equal(got, want)
+
+
+def test_bench_vectorized_oracle_matches_scalar_walker(forest_dict, X):
+    """bench.py's parity gate uses a vectorized level-synchronous NumPy
+    node walk; the parity suite here uses a per-sample scalar walker
+    (test_model_parity._numpy_forest_predict). The two independent
+    oracles must agree — otherwise the bench gate could pass against a
+    wrong ground truth."""
+    import bench
+    from tests.test_model_parity import _numpy_forest_predict
+
+    Xn = np.asarray(X[:400], np.float64)
+    got = bench._numpy_forest_labels(forest_dict, Xn)
+    want = _numpy_forest_predict(forest_dict, Xn)
+    np.testing.assert_array_equal(got, want)

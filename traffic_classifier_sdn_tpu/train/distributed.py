@@ -169,8 +169,13 @@ def fit_forest(mesh, X, y, n_classes: int, *, n_trees: int = 100,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=P(),
     )
+    # inputs land on the mesh ALREADY sharded: a plain asarray would
+    # stage the full binned matrix on one device first — the OOM this
+    # path exists to avoid
     left, right, feature, threshold, values = jax.jit(shmapped)(
-        jnp.asarray(Xb), jnp.asarray(y), jnp.asarray(mask),
+        jax.device_put(jnp.asarray(Xb), batch_sharded(mesh)),
+        jax.device_put(jnp.asarray(y), batch_sharded(mesh)),
+        jax.device_put(jnp.asarray(mask), batch_sharded(mesh)),
         jnp.asarray(edges),
     )
     return forest_model.Params(

@@ -99,7 +99,7 @@ def _bootstrap_weights(k_boot, n_total: int, window_start, window_len: int,
         # the loop body's output varies per device (window_start comes
         # from axis_index), so the initial carry must carry the same
         # varying-manner type or the scan carry check rejects it
-        w0 = jax.lax.pvary(w0, axis_name)
+        w0 = jax.lax.pcast(w0, axis_name, to="varying")
     w = jax.lax.fori_loop(0, n_chunks, body, w0)
     return w[:window_len]
 
