@@ -187,6 +187,10 @@ def measure(batches: list[int]) -> None:
     import jax
     import jax.numpy as jnp
 
+    # liveness markers for the parent's progress watchdog: a slow-but-
+    # healthy init keeps talking, a wedged one goes silent
+    print(f"# devices: {jax.devices()}", flush=True)
+
     from traffic_classifier_sdn_tpu.io import sklearn_import as ski
     from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
     from traffic_classifier_sdn_tpu.ops import tree_gemm
@@ -266,6 +270,7 @@ def measure(batches: list[int]) -> None:
         emit()
 
     # --- 2. CPU baselines (single-thread AND all-cores, one fit) ---------
+    print("# stage: sklearn baselines", flush=True)
     base1, basep = bench_sklearn_forest(X_big)
     line["baseline_flows_per_sec"] = round(base1, 1)
     line["baseline_flows_per_sec_parallel"] = round(basep, 1)
@@ -273,6 +278,7 @@ def measure(batches: list[int]) -> None:
     emit()
 
     # --- 3. on-device accuracy parity vs independent oracles -------------
+    print("# stage: parity gates", flush=True)
     ds = load_reference_datasets(DATA_DIR)
     Xd32 = jnp.asarray(ds.X, jnp.float32)
     want_forest = _numpy_forest_labels(forest_raw, ds.X)
@@ -309,6 +315,7 @@ def measure(batches: list[int]) -> None:
     # both layouts race: one fused call over uniformly-padded trees vs
     # size-bucketed per-group calls (smaller VMEM operands per tile)
     pallas_batch = min(max(batches), 1 << 17)
+    print("# stage: pallas forest race", flush=True)
     try:
         from traffic_classifier_sdn_tpu.ops import pallas_forest
 
@@ -429,56 +436,117 @@ def measure(batches: list[int]) -> None:
         emit()
 
 
-def _parse_lines(out: str | None) -> dict | None:
-    """Last well-formed JSON line of a child's stdout, if any."""
-    best = None
-    for ln in (out or "").splitlines():
-        if ln.startswith("{"):
-            try:
-                d = json.loads(ln)
-            except ValueError:
-                continue
-            if d.get("value"):
-                best = d
-    return best
+def _parse_result_line(ln: str) -> dict | None:
+    """A well-formed result JSON line, else None."""
+    if not ln.startswith("{"):
+        return None
+    try:
+        d = json.loads(ln)
+    except ValueError:
+        return None
+    return d if d.get("value") else None
 
 
-def _run_child(args: list[str], timeout_s: float, env=None) -> dict | None:
-    """Run a measurement child; recover its stdout even on timeout (the
-    child prints its main line early, so a watchdog kill can still yield a
-    usable number)."""
+def _run_child(args: list[str], idle_timeout_s: float, deadline,
+               env=None) -> dict | None:
+    """Run a measurement child with a PROGRESS-based watchdog: the child
+    streams a line after every completed stage, so liveness — not wall
+    time — is the health signal. A wedged TPU init goes silent and dies
+    after ``idle_timeout_s``; a healthy-but-slow run keeps emitting and
+    runs until ``deadline(has_result)`` — an ABSOLUTE ``time.monotonic``
+    instant, so the allowance can grow once a first result exists. Every
+    JSON line the child prints is parsed; the last one wins."""
+    import queue
     import subprocess
     import sys
+    import tempfile
+    import threading
 
+    errf = tempfile.TemporaryFile(mode="w+")
+    p = subprocess.Popen(
+        [sys.executable, __file__, *args],
+        stdout=subprocess.PIPE,
+        stderr=errf,
+        text=True,
+        env=env,
+    )
+    q: queue.Queue = queue.Queue()
+
+    def reader():
+        for ln in p.stdout:
+            q.put(ln)
+        q.put(None)
+
+    threading.Thread(target=reader, daemon=True).start()
+    best = None
+    t0 = time.monotonic()
+    last_line = t0
+
+    def take(ln: str | None) -> bool:
+        """Consume one queue item; True when the child is done."""
+        nonlocal best, last_line
+        if ln is None:
+            return True
+        last_line = time.monotonic()
+        d = _parse_result_line(ln)
+        if d is not None:
+            best = d
+        return False
+
+    while True:
+        now = time.monotonic()
+        if now > deadline(best is not None) or (
+            now - last_line > idle_timeout_s
+        ):
+            p.kill()
+            why = (
+                "stalled" if now - last_line > idle_timeout_s else "deadline"
+            )
+            print(f"# attempt {args}: killed ({why}) after "
+                  f"{now - t0:.0f}s", flush=True)
+            # drain lines the child printed before the kill — the freshest
+            # enriched result may still be sitting in the queue
+            while True:
+                try:
+                    if take(q.get_nowait()):
+                        break
+                except queue.Empty:
+                    break
+            break
+        try:
+            ln = q.get(timeout=2.0)
+        except queue.Empty:
+            continue
+        if take(ln):
+            break  # child exited; stdout drained
     try:
-        r = subprocess.run(
-            [sys.executable, __file__, *args],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=env,
-        )
-        out, err = r.stdout, r.stderr
-    except subprocess.TimeoutExpired as e:
-        out = e.stdout if isinstance(e.stdout, str) else (
-            e.stdout.decode("utf-8", "replace") if e.stdout else ""
-        )
-        err = f"timeout after {timeout_s:.0f}s"
-    parsed = _parse_lines(out)
-    if parsed is None:
-        tail = (err or "").strip()[-200:]
-        print(f"# attempt {args} failed: {tail}", flush=True)
-    return parsed
+        p.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        p.kill()
+    if best is None:
+        try:
+            errf.seek(0)
+            tail = errf.read()[-300:].strip()
+        except OSError:
+            tail = ""
+        print(f"# attempt {args} produced no result line: {tail!r}",
+              flush=True)
+    errf.close()
+    return best
 
 
 def main() -> None:
     """Watchdog wrapper. One warm child runs the whole ladder + extras
     (TPU init and compile caches paid once); every stage prints an
     enriched line immediately, so the driver's read of the LAST JSON line
-    always sees the best completed state. The remote TPU backend on this
-    rig can wedge at init for 400+ s (observed) — if the warm child dies
-    without a number, a second smaller attempt and then a CPU-platform
-    floor (clearly marked ``"platform": "cpu"``) still produce a line."""
+    always sees the best completed state. The watchdog is PROGRESS-based:
+    a wedged TPU init (the remote backend on this rig can hang for
+    400+ s, observed) goes silent and is killed after ~3 idle minutes,
+    while a healthy run that keeps streaming stage lines may use the
+    whole budget — so the late stages (Pallas races, per-family rates)
+    are not sacrificed to a fixed per-attempt cap. If no TPU line ever
+    lands, a CPU-platform floor (clearly marked ``"platform": "cpu"``)
+    still produces one."""
     import os
     import sys
 
@@ -490,30 +558,24 @@ def main() -> None:
         return
 
     t_start = time.monotonic()
-    budget = 450.0  # leave headroom under any plausible driver timeout
+    budget = 560.0
+    floor_reserve = 170.0  # wall time kept back for the CPU-floor attempt
 
     def remaining() -> float:
         return budget - (time.monotonic() - t_start)
 
-    floor_reserve = 160.0  # wall time kept back for the CPU-floor attempt
-
-    best = None
-    attempts = [
-        (",".join(str(b) for b in LADDER), 260.0),
-        # retry with a small ladder if the first child's init wedged
-        (",".join(str(b) for b in LADDER[:2]), 120.0),
-    ]
-    for spec, cap in attempts:
-        tmo = min(cap, remaining() - (0 if best else floor_reserve))
-        if tmo < 60:
-            break
-        parsed = _run_child(["--measure", spec], tmo)
-        if parsed and (best is None or parsed["value"] >= best["value"]):
-            best = parsed
-            print(json.dumps(best), flush=True)
-        if best is not None:
-            break
-        time.sleep(5)  # brief backoff before poking the backend again
+    # One TPU attempt: dies in ~idle_timeout if the backend is wedged
+    # (leaving the floor its reserve); streams to completion when healthy
+    # (a first result waives the floor reserve).
+    best = _run_child(
+        ["--measure", ",".join(str(b) for b in LADDER)],
+        idle_timeout_s=170.0,
+        deadline=lambda has_result: t_start + (
+            budget if has_result else budget - floor_reserve
+        ),
+    )
+    if best is not None:
+        print(json.dumps(best), flush=True)
 
     if best is None and remaining() > 30:
         # Floor: same measurement on the host CPU platform, honestly marked.
@@ -521,7 +583,10 @@ def main() -> None:
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)  # disarm the TPU sitecustomize
         parsed = _run_child(
-            ["--measure", "4096,16384"], max(remaining() - 10, 30), env
+            ["--measure", "4096,16384"],
+            idle_timeout_s=150.0,
+            deadline=lambda _has: t_start + budget - 10,
+            env=env,
         )
         if parsed:
             parsed["platform"] = "cpu"

@@ -169,13 +169,13 @@ def fit_forest(mesh, X, y, n_classes: int, *, n_trees: int = 100,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=P(),
     )
-    # inputs land on the mesh ALREADY sharded: a plain asarray would
-    # stage the full binned matrix on one device first — the OOM this
-    # path exists to avoid
+    # host NumPy arrays go straight to device_put with the data-axis
+    # sharding: no jnp.asarray staging copy on a single device first —
+    # the OOM this path exists to avoid
     left, right, feature, threshold, values = jax.jit(shmapped)(
-        jax.device_put(jnp.asarray(Xb), batch_sharded(mesh)),
-        jax.device_put(jnp.asarray(y), batch_sharded(mesh)),
-        jax.device_put(jnp.asarray(mask), batch_sharded(mesh)),
+        jax.device_put(Xb, batch_sharded(mesh)),
+        jax.device_put(y, batch_sharded(mesh)),
+        jax.device_put(mask, batch_sharded(mesh)),
         jnp.asarray(edges),
     )
     return forest_model.Params(
