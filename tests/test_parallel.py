@@ -363,3 +363,27 @@ def test_distributed_forest_fit_bit_identical_to_single_device(flow_dataset):
     Xq = jnp.asarray(X[:256], jnp.float32)
     acc = (np.asarray(forest_model.predict(dist, Xq)) == y[:256]).mean()
     assert acc > 0.9
+
+
+def test_distributed_svc_fit_bit_identical_to_single_device(flow_dataset):
+    """Pair-sharded SVC training (15 independent ovo QPs over the state
+    axis) must produce the exact same Params as the single-device fit —
+    same solver per pair, no cross-pair coupling."""
+    from traffic_classifier_sdn_tpu.train import svc as svc_train
+    from traffic_classifier_sdn_tpu.train.distributed import fit_svc
+
+    rng = np.random.RandomState(0)
+    idx = rng.choice(flow_dataset.n, size=512, replace=False)
+    X, y = flow_dataset.X[idx], flow_dataset.y[idx]
+    n_classes = len(flow_dataset.classes)
+    kw = dict(n_iters=120, power_iters=12)
+    single = svc_train.fit(X, y, n_classes, **kw)
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    dist = fit_svc(m, X, y, n_classes, **kw)
+    for name in ("sv_hi", "sv_lo", "pair_coef", "intercept",
+                 "vote_i", "vote_j", "gamma"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dist, name)),
+            np.asarray(getattr(single, name)),
+            err_msg=name,
+        )

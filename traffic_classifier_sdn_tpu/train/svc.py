@@ -82,9 +82,11 @@ def _solve_pair(K, idx, t, Cbox, *, n_iters: int, power_iters: int):
             Kp, t * v, precision=jax.lax.Precision.HIGHEST
         )
 
-    # Power iteration for λmax(Q) → step size.
+    # Power iteration for λmax(Q) → step size. (The norm guard also keeps
+    # all-padding pairs — t ≡ 0, reachable when the pair axis is padded
+    # for sharding — NaN-free; their α clamps to the [0, 0] box anyway.)
     v0 = valid.astype(jnp.float32)
-    v0 = v0 / jnp.linalg.norm(v0)
+    v0 = v0 / jnp.maximum(jnp.linalg.norm(v0), 1e-12)
 
     def pw(_, v):
         w = matvec(v)
@@ -110,18 +112,10 @@ def _solve_pair(K, idx, t, Cbox, *, n_iters: int, power_iters: int):
     return a
 
 
-def fit(
-    X,
-    y,
-    n_classes: int,
-    *,
-    C: float = 1.0,
-    gamma: float | str = "scale",
-    n_iters: int = 800,
-    power_iters: int = 24,
-    sv_tol: float = 1e-6,
-) -> svc.Params:
-    """Fit ovo RBF-SVC on device; returns predict-ready Params."""
+def prepare_ovo(X, y, n_classes: int, C: float, gamma):
+    """Host-side problem setup shared by the single-device and the
+    pair-sharded distributed fits: resolve gamma, build the (N, N)
+    kernel, and pack the padded per-pair (index, target, box) operands."""
     X = np.asarray(X, np.float64)
     y = np.asarray(y, np.int32)
     N, F = X.shape
@@ -141,16 +135,21 @@ def fit(
         idx_all[p, : len(m)] = m
         t_all[p, : len(m)] = np.where(y[m] == i, 1.0, -1.0)
     Cbox_all = np.where(t_all != 0.0, np.float32(C), 0.0)
+    return {
+        "X": X, "gamma": gamma, "K": K, "pairs": pairs,
+        "members": members, "idx": idx_all, "t": t_all, "Cbox": Cbox_all,
+    }
 
-    solve = partial(_solve_pair, n_iters=n_iters, power_iters=power_iters)
-    alphas = jax.lax.map(
-        lambda args: solve(K, *args),
-        (jnp.asarray(idx_all), jnp.asarray(t_all), jnp.asarray(Cbox_all)),
-    )  # (P, Smax)
 
-    # Pack into dense (P, N) signed coefficients + recovered intercepts.
+def pack_params(prob: dict, alphas: np.ndarray, n_classes: int,
+                sv_tol: float) -> svc.Params:
+    """Dense (P, N) signed coefficients + recovered intercepts → Params
+    (shared packing for both fit paths)."""
+    pairs, members, t_all = prob["pairs"], prob["members"], prob["t"]
+    X = prob["X"]
+    N = X.shape[0]
     coef_dense = np.zeros((len(pairs), N), np.float64)
-    at = np.asarray(alphas, np.float64) * t_all
+    at = np.asarray(alphas, np.float64)[: len(pairs)] * t_all
     for p in range(len(pairs)):
         m = members[p]
         coef_dense[p, m] = at[p, : len(m)]
@@ -166,6 +165,32 @@ def fit(
         intercept=jnp.asarray(intercept, jnp.float32),
         vote_i=jnp.asarray([i for i, _ in pairs], jnp.int32),
         vote_j=jnp.asarray([j for _, j in pairs], jnp.int32),
-        gamma=jnp.asarray(gamma, jnp.float32),
+        gamma=jnp.asarray(prob["gamma"], jnp.float32),
         n_classes=n_classes,
     )
+
+
+def fit(
+    X,
+    y,
+    n_classes: int,
+    *,
+    C: float = 1.0,
+    gamma: float | str = "scale",
+    n_iters: int = 800,
+    power_iters: int = 24,
+    sv_tol: float = 1e-6,
+) -> svc.Params:
+    """Fit ovo RBF-SVC on device; returns predict-ready Params."""
+    prob = prepare_ovo(X, y, n_classes, C, gamma)
+    solve = partial(_solve_pair, n_iters=n_iters, power_iters=power_iters)
+    K = prob["K"]
+    alphas = jax.lax.map(
+        lambda args: solve(K, *args),
+        (
+            jnp.asarray(prob["idx"]),
+            jnp.asarray(prob["t"]),
+            jnp.asarray(prob["Cbox"]),
+        ),
+    )  # (P, Smax)
+    return pack_params(prob, np.asarray(alphas), n_classes, sv_tol)
