@@ -136,6 +136,40 @@ def main() -> None:
             "forest_dp_ms": round(timed(call, fp, X) * 1e3, 2)
         }
 
+    # Distributed TRAINING canaries (cold, one call: each fit builds its
+    # own shard_map closure, so compile time is included — the row exists
+    # to catch collective-layout regressions, e.g. a histogram psum that
+    # suddenly scales with the full corpus, not to be a precise timer).
+    from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
+    from traffic_classifier_sdn_tpu.train.distributed import (
+        fit_forest,
+        fit_svc,
+    )
+
+    ds = load_reference_datasets(
+        os.environ.get("TCSDN_DATA_DIR", "/root/reference/datasets")
+    )
+    Xt, yt = ds.X[:1024], ds.y[:1024]
+    C = len(ds.classes)
+    for n_data in (1, 8):
+        mesh = meshlib.make_mesh(
+            n_data=n_data, n_state=1, devices=devices[:n_data]
+        )
+        t0 = time.perf_counter()
+        fit_forest(mesh, Xt, yt, C, n_trees=4, max_depth=6, n_bins=32)
+        results.setdefault(f"data_{n_data}", {})["forest_fit_cold_ms"] = (
+            round((time.perf_counter() - t0) * 1e3, 1)
+        )
+    for n_state in (1, 8):
+        mesh = meshlib.make_mesh(
+            n_data=1, n_state=n_state, devices=devices[:n_state]
+        )
+        t0 = time.perf_counter()
+        fit_svc(mesh, Xt, yt, C, n_iters=100, power_iters=10)
+        results.setdefault(f"state_{n_state}", {})["svc_fit_cold_ms"] = (
+            round((time.perf_counter() - t0) * 1e3, 1)
+        )
+
     print(
         json.dumps(
             {
