@@ -78,6 +78,30 @@ def main() -> None:
     )(Xg)
     assert int(jax.block_until_ready(counted)) == X_np.shape[0]
 
+    # a real model path across the process boundary: corpus-sharded KNN
+    # with the all_gather top-k merge spanning both hosts
+    from traffic_classifier_sdn_tpu.models import knn
+    from traffic_classifier_sdn_tpu.parallel import knn_sharded
+
+    d = {
+        "fit_X": rng.rand(8 * n_devices, 12) * 100.0,
+        "y": rng.randint(0, 6, 8 * n_devices).astype(np.int32),
+        "n_neighbors": 5,
+        "classes": np.arange(6),
+    }
+    smesh = meshlib.make_mesh(n_data=1, n_state=n_devices)
+    dpad = knn_sharded.pad_corpus(dict(d), n_devices)
+    kp = knn.from_numpy(dpad, dtype=jnp.float32)
+    kfn = knn_sharded.sharded_predict(
+        smesh, kp, pad_mask=dpad.get("pad_mask")
+    )
+    Xq = jnp.asarray(X_np[:16])
+    got = np.asarray(jax.block_until_ready(kfn(Xq)))
+    want_knn = np.asarray(
+        knn.predict(knn.from_numpy(dict(d), dtype=jnp.float32), Xq)
+    )
+    np.testing.assert_array_equal(got, want_knn)
+
     print(f"MULTIHOST OK pid={pid} devices={n_devices}", flush=True)
     jax.distributed.shutdown()
 
