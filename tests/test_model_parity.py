@@ -180,3 +180,19 @@ def test_svc_predict_chunked_matches(reference_models_dir, flow_dataset):
     want_plain = np.asarray(svc.predict(params, X_hi))
     got_plain = np.asarray(svc.predict_chunked(params, X_hi, row_chunk=256))
     np.testing.assert_array_equal(got_plain, want_plain)
+
+
+def test_knn_predict_chunked_matches(reference_models_dir, flow_dataset):
+    """Row-chunked KNN predict (streamed (N,S) similarity) must equal the
+    one-shot predict in both the plain and hi/lo modes."""
+    d = ski.import_knn(_ref_path(reference_models_dir, "knn"))
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    X_hi, X_lo = svc.split_hilo(flow_dataset.X[:1500])
+    np.testing.assert_array_equal(
+        np.asarray(knn.predict_chunked(params, X_hi, X_lo, row_chunk=256)),
+        np.asarray(knn.predict(params, X_hi, X_lo)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(knn.predict_chunked(params, X_hi, row_chunk=256)),
+        np.asarray(knn.predict(params, X_hi)),
+    )

@@ -83,3 +83,19 @@ def scores(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
 
 def predict(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
     return jnp.argmax(scores(params, X, X_lo), axis=-1).astype(jnp.int32)
+
+
+def predict_chunked(
+    params: Params, X: jax.Array, X_lo=None, row_chunk: int = 65536
+) -> jax.Array:
+    """``predict`` for batches whose (N, S) similarity matrix would blow
+    HBM (2²⁰ rows × the reference's 4448-row corpus ≈ 18.6 GB f32):
+    rows stream through the shared ``ops.chunking.map_row_chunks``
+    helper, exactly like the SVC and forest GEMM paths."""
+    from ..ops.chunking import map_row_chunks
+
+    if X_lo is None:
+        return map_row_chunks(lambda xc: predict(params, xc), row_chunk, X)
+    return map_row_chunks(
+        lambda xc, xlo: predict(params, xc, xlo), row_chunk, X, X_lo
+    )
