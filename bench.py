@@ -491,6 +491,10 @@ def _run_child(args: list[str], idle_timeout_s: float, deadline,
         d = _parse_result_line(ln)
         if d is not None:
             best = d
+            # pass the line through IMMEDIATELY: the driver reads the
+            # LAST JSON line on stdout, so even if this parent is killed
+            # mid-run the freshest completed state is already out
+            print(ln.rstrip("\n"), flush=True)
         return False
 
     while True:
@@ -582,6 +586,9 @@ def main() -> None:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)  # disarm the TPU sitecustomize
+        # the child self-marks "platform": "cpu" (it reads jax.devices()
+        # under the forced-CPU env), so every streamed line is honest even
+        # if this parent is killed before it returns
         parsed = _run_child(
             ["--measure", "4096,16384"],
             idle_timeout_s=150.0,
@@ -589,7 +596,6 @@ def main() -> None:
             env=env,
         )
         if parsed:
-            parsed["platform"] = "cpu"
             best = parsed
             print(json.dumps(best), flush=True)
 

@@ -90,12 +90,10 @@ def predict_chunked(
 ) -> jax.Array:
     """``predict`` for batches whose (N, S) similarity matrix would blow
     HBM (2²⁰ rows × the reference's 4448-row corpus ≈ 18.6 GB f32):
-    rows stream through the shared ``ops.chunking.map_row_chunks``
-    helper, exactly like the SVC and forest GEMM paths."""
-    from ..ops.chunking import map_row_chunks
+    rows stream through the shared ``ops.chunking.chunked_predict``
+    dispatch, exactly like the SVC and forest GEMM paths."""
+    from ..ops.chunking import chunked_predict
 
-    if X_lo is None:
-        return map_row_chunks(lambda xc: predict(params, xc), row_chunk, X)
-    return map_row_chunks(
-        lambda xc, xlo: predict(params, xc, xlo), row_chunk, X, X_lo
+    return chunked_predict(
+        lambda xc, xlo=None: predict(params, xc, xlo), row_chunk, X, X_lo
     )

@@ -139,16 +139,10 @@ def predict_chunked(
 ) -> jax.Array:
     """``predict`` for batches whose (N, S) kernel matrix would blow HBM
     (2²⁰ × 2281 f32 ≈ 9.5 GB): rows stream through the shared
-    ``ops.chunking.map_row_chunks`` helper. The lo-less mode maps over X
-    alone (a zeros X_lo would be semantically identical but costs an
-    extra broadcast pass over the dominant (chunk, S, F) stage — XLA
-    cannot fold a traced map operand)."""
-    from ..ops.chunking import map_row_chunks
+    ``ops.chunking.chunked_predict`` dispatch (see its docstring for the
+    lo-less fast path)."""
+    from ..ops.chunking import chunked_predict
 
-    if X_lo is None:
-        return map_row_chunks(
-            lambda xc: predict(params, xc), row_chunk, X
-        )
-    return map_row_chunks(
-        lambda xc, xlo: predict(params, xc, xlo), row_chunk, X, X_lo
+    return chunked_predict(
+        lambda xc, xlo=None: predict(params, xc, xlo), row_chunk, X, X_lo
     )

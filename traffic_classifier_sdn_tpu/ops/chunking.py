@@ -14,6 +14,19 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def chunked_predict(predict_fn, row_chunk: int, X, X_lo=None):
+    """Row-chunked wrapper for the ``predict(params-bound, X, X_lo=None)``
+    family (SVC, KNN): dispatches the lo-less mode over X alone — a zeros
+    X_lo would be semantically identical but costs an extra broadcast
+    pass over the dominant distance stage, and XLA cannot fold a traced
+    map operand."""
+    if X_lo is None:
+        return map_row_chunks(lambda xc: predict_fn(xc), row_chunk, X)
+    return map_row_chunks(
+        lambda xc, xlo: predict_fn(xc, xlo), row_chunk, X, X_lo
+    )
+
+
 def map_row_chunks(fn, chunk: int, X, *rest):
     """Apply ``fn(X_slice, *rest_slices)`` over ``chunk``-row slices and
     concatenate along axis 0. ``rest`` arrays must share X's leading
