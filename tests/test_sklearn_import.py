@@ -69,3 +69,28 @@ def test_forest_node_stats(imported):
     assert counts.min() == 25
     assert counts.max() == 101
     assert abs(counts.mean() - 53.1) < 0.5
+
+
+def test_serve_predict_matches_canonical(reference_models_dir, flow_dataset):
+    """The serving-optimized path every loader fills in (GEMM-form
+    forest, chunked KNN/SVC, plain for the rest) must agree with the
+    canonical per-family predict on every reference checkpoint."""
+    import jax.numpy as jnp
+
+    from traffic_classifier_sdn_tpu.io.sklearn_import import (
+        REFERENCE_CHECKPOINTS,
+    )
+    from traffic_classifier_sdn_tpu.models import (
+        SUBCOMMAND_ALIASES,
+        load_reference_model,
+    )
+
+    X = jnp.asarray(flow_dataset.X[:512], jnp.float32)
+    for sub in ("logistic", "gaussiannb", "svm", "knearest",
+                "Randomforest", "kmeans"):
+        ckpt = REFERENCE_CHECKPOINTS[SUBCOMMAND_ALIASES[sub]]
+        m = load_reference_model(sub, f"{reference_models_dir}/{ckpt}")
+        serve_fn, serve_params = m.serving_path()
+        got = np.asarray(serve_fn(serve_params, X))
+        want = np.asarray(m.predict(m.params, X))
+        np.testing.assert_array_equal(got, want, err_msg=sub)

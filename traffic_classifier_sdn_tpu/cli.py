@@ -268,7 +268,10 @@ def _run_classify(args) -> None:
     else:
         ckpt = f"{args.checkpoint_dir}/{REFERENCE_CHECKPOINTS[name]}"
         model = load_reference_model(args.subcommand, ckpt)
-    predict = jax.jit(model.predict)
+    # the serving-optimized (predict_fn, params) pair, resolved as one
+    # unit (GEMM-form forest, chunked KNN/SVC; canonical otherwise)
+    serve_fn, serve_params = model.serving_path()
+    predict = jax.jit(serve_fn)
 
     from .utils.metrics import global_metrics as m
     from .utils.profiling import trace
@@ -308,21 +311,21 @@ def _run_classify(args) -> None:
                     dropped_seen = engine.dropped
                 m.set("flows_dropped", engine.dropped)
                 with m.time("predict_s"):
-                    _print_table(engine, model, predict, args)
+                    _print_table(engine, model, predict, serve_params, args)
             if args.metrics_every and ticks % args.metrics_every == 0:
                 print(m.report(), file=sys.stderr, flush=True)
             if args.max_ticks and ticks >= args.max_ticks:
                 break
 
 
-def _print_table(engine, model, predict, args) -> None:
+def _print_table(engine, model, predict, serve_params, args) -> None:
     from .utils.table import CLASSIFIER_FIELDS, render_table, status_str
 
     # The device flow table produces float32 features natively, so the
     # SVC/KNN hi/lo precise mode is moot here (lo would be identically
     # zero); it applies to float64 feature sources like the CSV pipeline.
     X = engine.features()
-    idx = np.asarray(predict(model.params, X))
+    idx = np.asarray(predict(serve_params, X))
     fwd_active = np.asarray(engine.table.fwd.active)[:-1]
     rev_active = np.asarray(engine.table.rev.active)[:-1]
     # Classification is batched over the WHOLE table on device; the table

@@ -88,7 +88,7 @@ def save_model(path: str, name: str, params, classes=None) -> None:
 
 def load_model(path: str):
     """Read a checkpoint directory → models.LoadedModel."""
-    from ..models import MODEL_MODULES, LoadedModel
+    from ..models import MODEL_MODULES, make_loaded_model
     from ..models.base import ClassList
 
     with open(os.path.join(path, _MANIFEST)) as f:
@@ -113,13 +113,7 @@ def load_model(path: str):
         if manifest["classes"]
         else None
     )
-    return LoadedModel(
-        name=name,
-        params=params,
-        classes=classes,
-        predict=mod.predict,
-        scores=mod.scores,
-    )
+    return make_loaded_model(name, params, classes)
 
 
 def save_train_state(path: str, state: Any, step: int) -> None:

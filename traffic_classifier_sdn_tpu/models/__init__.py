@@ -42,6 +42,34 @@ SUBCOMMAND_ALIASES = {
 }
 
 
+def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
+    """(predict_fn, params) for full-table serving at 2²⁰ capacity:
+    forest swaps the gather traversal for the bucketed GEMM kernel
+    (~1000× on TPU), KNN/SVC swap in the row-chunked predict (their
+    (N, S) matrices exceed HBM at 1M rows); everything else serves with
+    its canonical predict."""
+    mod = MODEL_MODULES[name]
+    if name in ("knn", "svc"):
+        return mod.predict_chunked, params
+    if name == "forest":
+        import numpy as np
+
+        from ..core.features import NUM_FEATURES
+        from ..ops import tree_gemm
+
+        node_arrays = {
+            k: np.asarray(getattr(params, k))
+            for k in ("left", "right", "feature", "threshold", "values")
+        }
+        # serving feature width is the framework's fixed 12-column matrix
+        # (a forest whose trees never split on the last feature must still
+        # compile a full-width selector)
+        return tree_gemm.predict, tree_gemm.compile_forest(
+            node_arrays, n_features=NUM_FEATURES
+        )
+    return mod.predict, params
+
+
 @dataclass(frozen=True)
 class LoadedModel:
     name: str
@@ -49,6 +77,34 @@ class LoadedModel:
     classes: ClassList
     predict: Callable
     scores: Callable
+    # lazily resolved serving pair — see serving_path()
+    serve_params: Any = None
+    serve_predict: Callable | None = None
+
+    def serving_path(self) -> tuple[Callable, Any]:
+        """The serving-optimized ``(predict_fn, params)`` pair, resolved
+        as ONE unit (the two are only valid together) and built lazily —
+        loaders that never serve (checkpoint round-trips, eval) skip the
+        forest GEMM compilation cost. ``params``/``predict`` remain the
+        canonical checkpoint-portable pair."""
+        if self.serve_predict is None:
+            fn, p = _build_serving_path(self.name, self.params)
+            object.__setattr__(self, "serve_predict", fn)
+            object.__setattr__(self, "serve_params", p)
+        return self.serve_predict, self.serve_params
+
+
+def make_loaded_model(name: str, params, classes) -> LoadedModel:
+    """Assemble a LoadedModel — shared by the sklearn-pickle importer and
+    the native checkpoint loader (io/checkpoint.load_model)."""
+    mod = MODEL_MODULES[name]
+    return LoadedModel(
+        name=name,
+        params=params,
+        classes=classes,
+        predict=mod.predict,
+        scores=mod.scores,
+    )
 
 
 def load_reference_model(
@@ -63,10 +119,4 @@ def load_reference_model(
         classes = ClassList(kmeans.CLUSTER_LABELS_CHECKPOINT)
     else:
         classes = ClassList.from_array(raw["classes"])
-    return LoadedModel(
-        name=name,
-        params=params,
-        classes=classes,
-        predict=mod.predict,
-        scores=mod.scores,
-    )
+    return make_loaded_model(name, params, classes)
