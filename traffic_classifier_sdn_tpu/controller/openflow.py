@@ -316,6 +316,13 @@ def parse_multipart_reply(body: bytes) -> tuple[int, list[FlowStat]]:
 # stream framing
 
 
+# Exception types a parser may raise on a malformed (but well-framed)
+# message body — the connection loop drops such frames; anything else is
+# a real bug and propagates. Single-sourced so the controller guard and
+# the codec fuzz test cannot drift apart.
+PARSE_ERRORS = (ValueError, struct.error, IndexError, KeyError)
+
+
 class MessageReader:
     """Accumulates raw TCP bytes and yields complete OpenFlow messages as
     (type, xid, body) tuples."""

@@ -25,6 +25,7 @@ Behavioral parity notes:
 from __future__ import annotations
 
 import asyncio
+import struct
 import sys
 import time
 from dataclasses import dataclass, field
@@ -42,6 +43,7 @@ class Datapath:
     writer: asyncio.StreamWriter
     dpid: int | None = None
     mac_to_port: dict = field(default_factory=dict)
+    malformed: int = 0  # dropped-frame count (warnings rate-limited)
     _xid: int = 0
 
     def next_xid(self) -> int:
@@ -109,10 +111,38 @@ class Controller:
                 if not data:
                     break
                 for mtype, xid, body in mr.feed(data):
-                    self._dispatch(dp, mtype, xid, body)
+                    try:
+                        self._dispatch(dp, mtype, xid, body)
+                    except of.PARSE_ERRORS as e:
+                        # one malformed message from a buggy/hostile
+                        # switch must not take the connection (or leak a
+                        # traceback into the telemetry stream): drop the
+                        # frame, keep serving — framing stays intact
+                        # because MessageReader already consumed it.
+                        # Rate-limited: a switch streaming garbage at
+                        # line rate must not stall the event loop on
+                        # synchronous stderr writes.
+                        dp.malformed += 1
+                        if dp.malformed <= 5:
+                            print(
+                                f"WARNING: dropped malformed OF message "
+                                f"type={mtype} "
+                                f"({type(e).__name__}: {e})"
+                                + (" — further drops counted silently"
+                                   if dp.malformed == 5 else ""),
+                                file=sys.stderr,
+                            )
                 await writer.drain()
         except (ConnectionResetError, asyncio.CancelledError):
             pass
+        except ValueError as e:
+            # unrecoverable FRAMING error (bad header length): the byte
+            # stream cannot be resynced — close this connection cleanly
+            print(
+                f"WARNING: closing datapath connection on framing error: "
+                f"{e}",
+                file=sys.stderr,
+            )
         finally:
             # DEAD_DISPATCHER unregistration (simple_monitor_13.py:26-29)
             if dp.dpid is not None:
