@@ -281,3 +281,60 @@ print("THREADED_PARITY_OK")
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "THREADED_PARITY_OK" in r.stdout
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_eviction_churn_reuses_slots_without_drops(native):
+    """Sustained flow churn: each even tick one churn cohort vanishes and
+    a new one appears; idle eviction must recycle slots fast enough that
+    the table never fills, and the native engine's tombstoned fingerprint
+    map must keep resolving the stable cohort exactly (FpMap reuse)."""
+    import numpy as np
+
+    from traffic_classifier_sdn_tpu.ingest.protocol import TelemetryRecord
+
+    cap = 4096
+    stable_n, churn_n = cap // 2, cap // 8  # peak: stable + 2 cohorts < cap
+    eng = FlowStateEngine(capacity=cap, native=native)
+    generation = 0
+    evicted_total = 0
+    for tick in range(1, 13):
+        if tick % 2 == 0:
+            generation += 1  # retire the old churn cohort, mint a new one
+        recs = [
+            TelemetryRecord(
+                time=tick, datapath="1", in_port="1",
+                eth_src=f"st-{i:04x}", eth_dst="gw",
+                out_port="2", packets=tick * 3, bytes=tick * 100,
+            )
+            for i in range(stable_n)
+        ] + [
+            TelemetryRecord(
+                time=tick, datapath="1", in_port="1",
+                eth_src=f"ch{generation}-{i:04x}", eth_dst="gw",
+                out_port="2", packets=tick * 3, bytes=tick * 100,
+            )
+            for i in range(churn_n)
+        ]
+        eng.ingest(recs)
+        eng.step()
+        evicted_total += eng.evict_idle(now=tick, idle_seconds=2)
+        assert eng.dropped == 0, f"tick {tick}: dropped flows"
+        assert eng.num_flows() <= stable_n + 2 * churn_n
+    assert evicted_total >= 4 * churn_n  # cohorts really were recycled
+    # drain: a stable-only tick two poll periods later ages out every
+    # churn cohort; only the stable population must remain — and it must
+    # still resolve exactly (no fingerprint-map corruption across the
+    # tombstone churn)
+    eng.ingest([
+        TelemetryRecord(
+            time=15, datapath="1", in_port="1",
+            eth_src=f"st-{i:04x}", eth_dst="gw",
+            out_port="2", packets=100, bytes=5000,
+        )
+        for i in range(stable_n)
+    ])
+    eng.step()
+    eng.evict_idle(now=15, idle_seconds=2)
+    assert eng.dropped == 0
+    assert eng.num_flows() == stable_n
