@@ -60,8 +60,8 @@ def _sync_scalar(x) -> float:
 
 
 def _loop_iters(batch: int) -> int:
-    # keep one timed repetition ~0.5–2 s: enough device work to swamp the
-    # tunnel round trip without risking worker-side watchdogs at 2^20 rows
+    # starting K only — _timed_loop escalates K until the timed signal
+    # clears min_signal; a big batch starts low to bound the first probe
     return 16 if batch <= (1 << 17) else 4
 
 
@@ -81,31 +81,48 @@ def _roundtrip_seconds() -> float:
     return float(np.median(times))
 
 
-def _timed_loop(predict_sum, params, X, iters: int) -> float:
+def _timed_loop(predict_sum, params, X, iters: int,
+                min_signal: float = 0.2) -> float:
     """Device seconds per predict: K dependent on-device iterations inside
     one jit, minus the round trip, ÷ K. ``predict_sum(params, X)`` must
-    return a f32 scalar reduction of the predictions."""
+    return a f32 scalar reduction of the predictions.
+
+    K escalates (geometric, capped) until one timed repetition costs at
+    least ``min_signal`` seconds beyond the round trip — cheap kernels
+    (GNB/logreg on this rig take single-digit µs) would otherwise be
+    swallowed whole by tunnel-RTT jitter and read as ~0 device seconds."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    @jax.jit
-    def loop(params, X):
-        def body(i, acc):
-            Xi = X.at[0, 0].set(acc * 1e-9 + jnp.float32(i))
-            return acc + predict_sum(params, Xi)
+    def make_loop(n: int):
+        @jax.jit
+        def loop(params, X):
+            def body(i, acc):
+                Xi = X.at[0, 0].set(acc * 1e-9 + jnp.float32(i))
+                return acc + predict_sum(params, Xi)
 
-        return lax.fori_loop(0, iters, body, jnp.float32(0.0))
+            return lax.fori_loop(0, n, body, jnp.float32(0.0))
 
-    _sync_scalar(loop(params, X))  # compile + warm
+        return loop
+
+    cap = 1 << 17
     rtt = _roundtrip_seconds()
-    times = []
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        _sync_scalar(loop(params, X))
-        times.append(time.perf_counter() - t0)
-    total = float(np.median(times))
-    return max(total - rtt, 1e-12) / iters
+    while True:
+        loop = make_loop(iters)
+        _sync_scalar(loop(params, X))  # compile + warm
+        times = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            _sync_scalar(loop(params, X))
+            times.append(time.perf_counter() - t0)
+        signal = float(np.median(times)) - rtt
+        if signal >= min_signal or iters >= cap:
+            return max(signal, 1e-12) / iters
+        grow = min(
+            64, max(4, int(np.ceil(2 * min_signal / max(signal, 1e-6))))
+        )
+        iters = min(iters * grow, cap)
 
 
 def _e2e_p50(one, *args) -> float:

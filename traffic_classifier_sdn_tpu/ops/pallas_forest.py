@@ -63,13 +63,12 @@ class ForestPallasGroups(struct.PyTreeNode):
 
 
 def compile_forest(
-    d: dict, row_tile: int = 512, tree_chunk: int = 20, n_buckets: int = 1
+    d: dict, row_tile: int = 512, tree_chunk: int = 16, n_buckets: int = 1
 ) -> ForestPallas | ForestPallasGroups:
     buckets = tree_gemm.split_tree_buckets(d, n_buckets)
     groups = [
         _compile_single(
-            sub, row_tile,
-            min(tree_chunk, sub["left"].shape[0]),
+            sub, row_tile, tree_chunk,
             n_features=nf, n_trees_total=nt,
         )
         for sub, nf, nt in buckets
@@ -89,6 +88,34 @@ def _compile_single(
         d, n_features=n_features, n_trees_total=n_trees_total
     )
     T, D, L = ops["n_trees"], ops["n_internal"], ops["n_leaves"]
+    # Mosaic block-shape rule: the last two dims of every block must be
+    # divisible by (8, 128) or equal the full array dim. Pad D to a
+    # multiple of 8 with inert columns (+inf threshold -> pm=+1, zero
+    # path row -> no score contribution) and force the tree chunk to a
+    # multiple of 16, so the (F, TC*D) / (1, TC*D) blocks end on a
+    # 128-multiple and the (TC, L) depth block starts on an 8-multiple.
+    dpad = (-D) % 8
+    if dpad:
+        ops["feat_onehot"] = np.concatenate(
+            [
+                ops["feat_onehot"].reshape(ops["n_features"], T, D),
+                np.zeros((ops["n_features"], T, dpad), np.float32),
+            ],
+            axis=2,
+        ).reshape(ops["n_features"], T * (D + dpad))
+        ops["thresholds"] = np.concatenate(
+            [
+                ops["thresholds"].reshape(T, D),
+                np.full((T, dpad), np.inf, np.float32),
+            ],
+            axis=1,
+        ).reshape(-1)
+        ops["path"] = np.concatenate(
+            [ops["path"], np.zeros((T, dpad, L), np.float32)], axis=1
+        )
+        D += dpad
+    tree_chunk = max(16, ((tree_chunk + 15) // 16) * 16)
+    assert (tree_chunk * D) % 128 == 0 and tree_chunk % 8 == 0
     # pad tree count to a multiple of tree_chunk with inert trees
     # (zero leaf_values rows contribute nothing; depth 127 never matches)
     pad = (-T) % tree_chunk
