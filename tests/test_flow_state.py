@@ -341,6 +341,72 @@ def test_top_active_slots_tracks_traffic(native):
     assert allslots[:3] == [4, 2, 5] and set(allslots) == set(range(6))
 
 
+def test_wire_pack_unpack_round_trip():
+    """pack_wire/unpack_wire must be bit-exact for every field, including
+    the flag bits sharing the slot word and the float bit-casts — the
+    serving spine's update batches all cross the device link this way."""
+    import numpy as np
+    from traffic_classifier_sdn_tpu.core import flow_table as ft
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(7)
+    n = 257
+    b = ft.UpdateBatch(
+        slot=rng.randint(0, 1 << 29, n).astype(np.int32),
+        time=rng.randint(0, 2**31 - 1, n).astype(np.int32),
+        pkts_lo=rng.randint(0, 2**32, n, np.uint64).astype(np.uint32),
+        pkts_f=(rng.rand(n) * 1e12).astype(np.float32),
+        bytes_lo=rng.randint(0, 2**32, n, np.uint64).astype(np.uint32),
+        bytes_f=(rng.rand(n) * 1e15).astype(np.float32),
+        is_fwd=rng.rand(n) < 0.5,
+        is_create=rng.rand(n) < 0.5,
+    )
+    got = ft.unpack_wire(jnp.asarray(ft.pack_wire(b)))
+    for field in (
+        "slot", "time", "pkts_lo", "pkts_f", "bytes_lo", "bytes_f",
+        "is_fwd", "is_create",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, field)), getattr(b, field), err_msg=field
+        )
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_render_sample_matches_unfused_path(native):
+    """The fused device render gather (one dispatch, O(n) fetched) must
+    agree row-for-row with top_slots + whole-vector label/active fetches
+    — the serving loop depends on it to avoid O(capacity) transfers."""
+    if native:
+        from traffic_classifier_sdn_tpu.native import engine as ne
+
+        if not ne.available():
+            pytest.skip("native engine unavailable")
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = FlowStateEngine(capacity=16, native=native)
+    eng.mark_tick()
+    eng.ingest([_rec(1, f"s{i}", f"d{i}", 10, 1000) for i in range(6)])
+    eng.step()
+    eng.mark_tick()
+    deltas = {0: 0, 1: 5, 2: 800, 3: 10, 4: 9000, 5: 20}
+    eng.ingest(
+        [_rec(2, f"s{i}", f"d{i}", 10 + d, 1000 + d)
+         for i, d in deltas.items()]
+    )
+    eng.step()
+    labels = jnp.arange(eng.table.capacity, dtype=jnp.int32) % 6
+    got = eng.render_sample(labels, 4)
+    top = eng.top_slots(4)
+    lab = np.asarray(labels)
+    fwd = np.asarray(eng.table.fwd.active)[:-1]
+    rev = np.asarray(eng.table.rev.active)[:-1]
+    want = [(s, int(lab[s]), bool(fwd[s]), bool(rev[s])) for s in top]
+    assert got == want
+    assert eng.render_sample(labels, 0) == []
+
+
 def test_top_active_slots_ignores_stale_deltas():
     """A flow that moved lots of bytes and then vanished from telemetry
     must not dominate the render: activity is gated to slots updated at

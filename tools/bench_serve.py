@@ -118,13 +118,17 @@ def main() -> None:
         t1 = time.perf_counter()
         eng.step()
         t2 = time.perf_counter()
-        idx = np.asarray(predict(params, eng.features()))
+        # full-table predict stays device-resident; the render gather
+        # fetches O(table_rows), not the (capacity,) label vector. The
+        # render stage's device fetch is the tick's first hard sync, so it
+        # also absorbs the (async-dispatched) scatter + predict time —
+        # "predict" here is dispatch-only, "render" is where the wait is.
+        labels = predict(params, eng.features())
         t3 = time.perf_counter()
-        # bounded render: activity-ranked sample + footer, the CLI's shape
-        top = eng.top_slots(args.table_rows)
-        sample = eng.slot_metadata(slots=top)
+        ranked = eng.render_sample(labels, args.table_rows)
+        sample = eng.slot_metadata(slots=[s for s, *_ in ranked])
         rows = [
-            (s, *sample[s], int(idx[s])) for s in top if s in sample
+            (s, *sample[s], c) for s, c, _fa, _ra in ranked if s in sample
         ]
         footer = f"showing {len(rows)} of {eng.num_flows()}"
         t4 = time.perf_counter()
@@ -145,6 +149,28 @@ def main() -> None:
 
     p50 = {k: float(np.median(v)) for k, v in timings.items()}
     ingest_rate = (total_records / args.ticks) / p50["ingest"]
+
+    # Per-tick host->device wire bytes actually moved for the update
+    # batches (padded flow_table.pack_wire matrices, counted by the
+    # engine) and the measured link bandwidth — on a slow device link the
+    # transfer can bound the tick; a local PCIe host moves the same bytes
+    # in single-digit ms. The bandwidth probe only means "device link"
+    # off the cpu platform, so it is omitted there (a cpu-platform probe
+    # would time a host memcpy).
+    wire_mb = eng.wire_bytes / args.ticks / 1e6
+    link_mb_s = None
+    if jax.devices()[0].platform != "cpu":
+        # sync by scalar fetch: on this rig's tunnel block_until_ready
+        # returns without waiting, which would time dispatch, not transfer
+        probe_mb = (4 << 20) / 1e6
+        blob = np.ones(4 << 20, np.uint8)
+        float(np.asarray(jnp.sum(jnp.asarray(blob))))  # warm
+        bw = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(np.asarray(jnp.sum(jnp.asarray(blob))))
+            bw.append(probe_mb / (time.perf_counter() - t0))
+        link_mb_s = float(np.median(bw))
     print(
         json.dumps(
             {
@@ -158,6 +184,11 @@ def main() -> None:
                 "stage_p50_ms": {
                     k: round(v * 1e3, 2) for k, v in p50.items()
                 },
+                "update_wire_mb_per_tick": round(wire_mb, 1),
+                **(
+                    {"host_to_device_mb_per_sec": round(link_mb_s, 1)}
+                    if link_mb_s is not None else {}
+                ),
                 "native_ingest": native,
                 "platform": jax.devices()[0].platform,
                 "predict_model": args.model,

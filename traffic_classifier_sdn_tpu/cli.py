@@ -325,37 +325,49 @@ def _print_table(engine, model, predict, serve_params, args) -> None:
     # SVC/KNN hi/lo precise mode is moot here (lo would be identically
     # zero); it applies to float64 feature sources like the CSV pipeline.
     X = engine.features()
-    idx = np.asarray(predict(serve_params, X))
-    fwd_active = np.asarray(engine.table.fwd.active)[:-1]
-    rev_active = np.asarray(engine.table.rev.active)[:-1]
+    labels = predict(serve_params, X)  # stays device-resident
     # Classification is batched over the WHOLE table on device; the table
     # *render* samples at most --table-rows flows (the reference prints
     # everything because it tracks dozens, traffic_classifier.py:99-118 —
-    # at the 2²⁰-flow target a full render would be O(N) Python per tick).
+    # at the 2²⁰-flow target a full render would be O(N) Python per tick,
+    # and a full label/active fetch ~6 MB per tick over the device link).
     limit = args.table_rows if args.table_rows > 0 else None
     n_flows = engine.num_flows()
+
+    def name(c: int) -> str:
+        return (
+            model.classes.names[c] if c < len(model.classes.names) else "?"
+        )
+
+    rows = []
     if limit is not None:
         # activity-ranked sample: the rendered rows track live traffic
-        # (device top_k over this tick's byte deltas), most active first
-        top = engine.top_slots(limit)
-        sample = engine.slot_metadata(slots=top)
-        ordered = [(s, sample[s]) for s in top if s in sample]
-    else:
-        ordered = sorted(engine.slot_metadata().items())
-    rows = []
-    for slot, (src, dst) in ordered:
-        rows.append(
-            (
-                slot,
-                src,
-                dst,
-                model.classes.names[idx[slot]]
-                if idx[slot] < len(model.classes.names)
-                else "?",
-                status_str(bool(fwd_active[slot])),
-                status_str(bool(rev_active[slot])),
+        # (device top_k over this tick's byte deltas), most active first;
+        # labels + active flags gathered device-side, O(limit) fetched
+        ranked = engine.render_sample(labels, limit)
+        sample = engine.slot_metadata(slots=[s for s, *_ in ranked])
+        for slot, c, fa, ra in ranked:
+            if slot not in sample:
+                continue
+            src, dst = sample[slot]
+            rows.append(
+                (slot, src, dst, name(c), status_str(fa), status_str(ra))
             )
-        )
+    else:
+        idx = np.asarray(labels)
+        fwd_active = np.asarray(engine.table.fwd.active)[:-1]
+        rev_active = np.asarray(engine.table.rev.active)[:-1]
+        for slot, (src, dst) in sorted(engine.slot_metadata().items()):
+            rows.append(
+                (
+                    slot,
+                    src,
+                    dst,
+                    name(int(idx[slot])),
+                    status_str(bool(fwd_active[slot])),
+                    status_str(bool(rev_active[slot])),
+                )
+            )
     print(render_table(CLASSIFIER_FIELDS, rows), flush=True)
     if limit is not None and n_flows > len(rows):
         print(f"... showing {len(rows)} of {n_flows} tracked flows",

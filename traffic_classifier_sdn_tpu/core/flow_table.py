@@ -114,97 +114,154 @@ def make_table(capacity: int) -> FlowTable:
     )
 
 
-def _updated_dir(
-    d: DirState, slot, time, pkts_lo, pkts_f, bytes_lo, bytes_f, time_start, apply_mask
+def pack_wire(b: UpdateBatch) -> "np.ndarray":
+    """Host-side: one contiguous (B, 6) uint32 wire matrix per batch —
+    24 B/record instead of eight separate arrays (26 B plus per-array
+    transfer overhead). Column 0 carries the slot with the two direction/
+    create flags in bits 31/30 (slot ≤ capacity < 2³⁰); float columns are
+    bit-cast, so the round trip through ``unpack_wire`` is exact."""
+    import numpy as np
+
+    if b.slot.size and int(b.slot.max()) >= (1 << 30):
+        raise ValueError(
+            "pack_wire: slot >= 2^30 collides with the flag bits — "
+            "table capacity must stay below 2^30"
+        )
+    w = np.empty((b.slot.shape[0], 6), np.uint32)
+    w[:, 0] = (
+        b.slot.astype(np.uint32)
+        | (b.is_fwd.astype(np.uint32) << 31)
+        | (b.is_create.astype(np.uint32) << 30)
+    )
+    w[:, 1] = b.time.view(np.uint32)
+    w[:, 2] = b.pkts_lo
+    w[:, 3] = b.pkts_f.view(np.uint32)
+    w[:, 4] = b.bytes_lo
+    w[:, 5] = b.bytes_f.view(np.uint32)
+    return w
+
+
+def unpack_wire(w: jax.Array) -> UpdateBatch:
+    """Device-side inverse of ``pack_wire`` (elementwise, fuses into the
+    scatter that follows)."""
+    col0 = w[:, 0]
+    bitcast = jax.lax.bitcast_convert_type
+    return UpdateBatch(
+        slot=(col0 & jnp.uint32(0x3FFFFFFF)).astype(jnp.int32),
+        time=bitcast(w[:, 1], jnp.int32),
+        pkts_lo=w[:, 2],
+        pkts_f=bitcast(w[:, 3], jnp.float32),
+        bytes_lo=w[:, 4],
+        bytes_f=bitcast(w[:, 5], jnp.float32),
+        is_fwd=(col0 >> 31) != 0,
+        is_create=((col0 >> 30) & jnp.uint32(1)) != 0,
+    )
+
+
+def apply_wire(table: FlowTable, w: jax.Array) -> FlowTable:
+    """``apply_batch`` over the packed wire format — the serving spine's
+    per-flush entry point: one host→device buffer per batch."""
+    return apply_batch(table, unpack_wire(w))
+
+
+def _inverse_index(mask, slot, n: int):
+    """(n,) int32 map: table row → index of the batch row addressing it
+    under ``mask``, or B (sentinel) for rows no batch row addresses.
+
+    ONE int32 scatter replaces a per-field scatter: masked-out rows are
+    routed past the end of the table (n + i, unique per row) and dropped,
+    so every remaining index is unique and ``unique_indices=True`` lets
+    XLA emit the vectorized lowering. TPU scatters without it serialize —
+    measured ~1.5 s of device time for one 2²⁰-row batch applied through
+    per-field scatters, vs ~ms for this inverse + gathers formulation.
+
+    Uniqueness precondition: the batcher guarantees at most one batch row
+    per (slot, direction) and per-slot create (ingest/batcher.Batcher
+    docstring); padding rows carry slot == scratch and are masked out by
+    the caller."""
+    B = slot.shape[0]
+    rows = jnp.arange(B, dtype=jnp.int32)
+    tgt = jnp.where(mask, slot, n + rows)
+    inv = jnp.full(n, B, jnp.int32)
+    return inv.at[tgt].set(rows, mode="drop", unique_indices=True)
+
+
+def _merged_dir(
+    d: DirState, b: UpdateBatch, gather, time_start,
+    inv_create, inv_update, counters_from_batch: bool, active_init: bool,
 ) -> DirState:
-    """Compute the reference's updateforward/updatereverse math
-    (traffic_classifier.py:63-96) for a batch of rows, then scatter."""
-    old_pkts_lo = d.pkts_lo[slot]
-    old_bytes_lo = d.bytes_lo[slot]
-    old_last = d.last_time[slot]
+    """One direction's create-then-update merge, all in table-row space.
+
+    ``gather(arr)`` pulls batch columns to table rows through the
+    direction's inverse index; old per-row values are already table-space
+    so the reference's read-modify-write update math
+    (traffic_classifier.py:63-96) becomes elementwise. Create first, then
+    update — a batch may hold a flow's create row and a same-tick update
+    row, and the update must read the freshly initialized counters,
+    exactly like the reference's sequential per-line processing."""
+    hit_c = inv_create != b.slot.shape[0]
+    time_c = gather(b.time, inv_create)
+
+    def init(old, batch_col, init_val):
+        created = gather(batch_col, inv_create) if counters_from_batch \
+            else jnp.full_like(old, init_val)
+        return jnp.where(hit_c, created, old)
+
+    zero = 0
+    pkts_lo = init(d.pkts_lo, b.pkts_lo, zero)
+    pkts_f = init(d.pkts_f, b.pkts_f, zero)
+    bytes_lo = init(d.bytes_lo, b.bytes_lo, zero)
+    bytes_f = init(d.bytes_f, b.bytes_f, zero)
+    delta_pkts = jnp.where(hit_c, 0, d.delta_pkts)
+    delta_bytes = jnp.where(hit_c, 0, d.delta_bytes)
+    inst_pps = jnp.where(hit_c, 0.0, d.inst_pps)
+    avg_pps = jnp.where(hit_c, 0.0, d.avg_pps)
+    inst_bps = jnp.where(hit_c, 0.0, d.inst_bps)
+    avg_bps = jnp.where(hit_c, 0.0, d.avg_bps)
+    last_time = jnp.where(hit_c, time_c, d.last_time)
+    active = jnp.where(hit_c, active_init, d.active)
+
+    # --- update pass (reference updateforward/updatereverse math) ---------
+    hit = inv_update != b.slot.shape[0]
+    time_u = gather(b.time, inv_update)
+    pkts_lo_u = gather(b.pkts_lo, inv_update)
+    pkts_f_u = gather(b.pkts_f, inv_update)
+    bytes_lo_u = gather(b.bytes_lo, inv_update)
+    bytes_f_u = gather(b.bytes_f, inv_update)
 
     # Exact deltas via mod-2^32 wraparound (see module docstring).
-    delta_pkts = (pkts_lo - old_pkts_lo).astype(jnp.int32)
-    delta_bytes = (bytes_lo - old_bytes_lo).astype(jnp.int32)
-
-    age = (time - time_start).astype(jnp.float32)
-    gap = (time - old_last).astype(jnp.float32)
+    d_pkts = (pkts_lo_u - pkts_lo).astype(jnp.int32)
+    d_bytes = (bytes_lo_u - bytes_lo).astype(jnp.int32)
+    age = (time_u - time_start).astype(jnp.float32)
+    gap = (time_u - last_time).astype(jnp.float32)
     # Guards replicate reference :66-67: keep the old value when the
     # denominator would be zero.
-    avg_pps = jnp.where(age != 0, pkts_f / age, d.avg_pps[slot])
-    avg_bps = jnp.where(age != 0, bytes_f / age, d.avg_bps[slot])
-    inst_pps = jnp.where(
-        gap != 0, delta_pkts.astype(jnp.float32) / gap, d.inst_pps[slot]
+    n_avg_pps = jnp.where(age != 0, pkts_f_u / age, avg_pps)
+    n_avg_bps = jnp.where(age != 0, bytes_f_u / age, avg_bps)
+    n_inst_pps = jnp.where(
+        gap != 0, d_pkts.astype(jnp.float32) / gap, inst_pps
     )
-    inst_bps = jnp.where(
-        gap != 0, delta_bytes.astype(jnp.float32) / gap, d.inst_bps[slot]
+    n_inst_bps = jnp.where(
+        gap != 0, d_bytes.astype(jnp.float32) / gap, inst_bps
     )
-    active = (delta_bytes != 0) & (delta_pkts != 0)  # reference :75-78
+    n_active = (d_bytes != 0) & (d_pkts != 0)  # reference :75-78
 
-    # Masked scatter: rows not applying to this direction are routed to the
-    # scratch row (last index). Never write identity values at the real slot —
-    # the same slot can appear in the batch for the *other* direction, and
-    # duplicate-index scatter order is undefined, so an identity write could
-    # clobber the real one.
-    scratch = d.pkts_lo.shape[0] - 1
-    eff_slot = jnp.where(apply_mask, slot, scratch)
-
-    def put(arr, new):
-        return arr.at[eff_slot].set(new, mode="drop")
+    def upd(old, new):
+        return jnp.where(hit, new, old)
 
     return DirState(
-        pkts_lo=put(d.pkts_lo, pkts_lo),
-        pkts_f=put(d.pkts_f, pkts_f),
-        bytes_lo=put(d.bytes_lo, bytes_lo),
-        bytes_f=put(d.bytes_f, bytes_f),
-        delta_pkts=put(d.delta_pkts, delta_pkts),
-        delta_bytes=put(d.delta_bytes, delta_bytes),
-        inst_pps=put(d.inst_pps, inst_pps),
-        avg_pps=put(d.avg_pps, avg_pps),
-        inst_bps=put(d.inst_bps, inst_bps),
-        avg_bps=put(d.avg_bps, avg_bps),
-        last_time=put(d.last_time, time),
-        active=put(d.active, active),
-    )
-
-
-def _created_dir(
-    d: DirState, b: UpdateBatch, counters_from_batch: bool, active_init: bool
-) -> DirState:
-    """Initialize rows for newly created flows (reference :38-60): the
-    forward side gets the first counters and starts ACTIVE
-    (``counters_from_batch=True, active_init=True``), the reverse side
-    starts at zero INACTIVE. Both sides' last_time starts at time_start."""
-    # Route non-create rows to the scratch row (see _updated_dir on why
-    # identity writes at the real slot are unsafe).
-    scratch = d.pkts_lo.shape[0] - 1
-    eff_slot = jnp.where(b.is_create, b.slot, scratch)
-
-    def put(arr, new):
-        return arr.at[eff_slot].set(new, mode="drop")
-
-    if counters_from_batch:
-        pk_lo, pk_f, by_lo, by_f = b.pkts_lo, b.pkts_f, b.bytes_lo, b.bytes_f
-    else:
-        pk_lo = jnp.zeros_like(b.pkts_lo)
-        pk_f = jnp.zeros_like(b.pkts_f)
-        by_lo = jnp.zeros_like(b.bytes_lo)
-        by_f = jnp.zeros_like(b.bytes_f)
-    zero_i = jnp.zeros_like(b.slot)
-    zero_f = jnp.zeros_like(b.pkts_f)
-    return DirState(
-        pkts_lo=put(d.pkts_lo, pk_lo),
-        pkts_f=put(d.pkts_f, pk_f),
-        bytes_lo=put(d.bytes_lo, by_lo),
-        bytes_f=put(d.bytes_f, by_f),
-        delta_pkts=put(d.delta_pkts, zero_i),
-        delta_bytes=put(d.delta_bytes, zero_i),
-        inst_pps=put(d.inst_pps, zero_f),
-        avg_pps=put(d.avg_pps, zero_f),
-        inst_bps=put(d.inst_bps, zero_f),
-        avg_bps=put(d.avg_bps, zero_f),
-        last_time=put(d.last_time, b.time),
-        active=put(d.active, jnp.full_like(b.is_create, active_init)),
+        pkts_lo=upd(pkts_lo, pkts_lo_u),
+        pkts_f=upd(pkts_f, pkts_f_u),
+        bytes_lo=upd(bytes_lo, bytes_lo_u),
+        bytes_f=upd(bytes_f, bytes_f_u),
+        delta_pkts=upd(delta_pkts, d_pkts),
+        delta_bytes=upd(delta_bytes, d_bytes),
+        inst_pps=upd(inst_pps, n_inst_pps),
+        avg_pps=upd(avg_pps, n_avg_pps),
+        inst_bps=upd(inst_bps, n_inst_bps),
+        avg_bps=upd(avg_bps, n_avg_bps),
+        last_time=upd(last_time, time_u),
+        active=upd(active, n_active),
     )
 
 
@@ -212,35 +269,49 @@ def _created_dir(
 def apply_batch(table: FlowTable, b: UpdateBatch) -> FlowTable:
     """Apply one padded update batch. Donate ``table`` at the call site
     (``jax.jit(apply_batch).lower`` …) or rely on XLA aliasing via the
-    wrapper in ingest/batcher.py for true in-place updates."""
-    slot = b.slot
-    create = b.is_create
-    upd_fwd = ~create & b.is_fwd
-    upd_rev = ~create & ~b.is_fwd
+    wrapper in ingest/batcher.py for true in-place updates.
 
-    # Creation: shared fields. Non-create rows route to the scratch row
-    # (duplicate-slot safety — see _updated_dir).
-    scratch = table.time_start.shape[0] - 1
-    create_slot = jnp.where(create, slot, scratch)
-    time_start = table.time_start.at[create_slot].set(b.time, mode="drop")
-    in_use = table.in_use.at[create_slot].set(True, mode="drop")
+    Formulated as three inverse-index builds (one int32 scatter each)
+    plus vectorized gathers and elementwise merges over the whole table —
+    never a per-field scatter (see _inverse_index on why)."""
+    n = table.time_start.shape[0]
+    scratch = n - 1
+    B = b.slot.shape[0]
+    real = b.slot < scratch  # padding rows carry slot == scratch
+    create = b.is_create & real
+    upd_fwd = ~b.is_create & b.is_fwd & real
+    upd_rev = ~b.is_create & ~b.is_fwd & real
 
-    # Creates BEFORE updates: a batch may contain both a flow's create row
-    # and a same-tick update row for either direction (the monitor reports
-    # both directions per poll). Updates must then read the freshly
-    # initialized counters, exactly like the reference's sequential
-    # per-line processing (create → updatereverse within one poll).
-    fwd = _created_dir(table.fwd, b, counters_from_batch=True, active_init=True)
-    rev = _created_dir(table.rev, b, counters_from_batch=False, active_init=False)
+    # The barrier pins each inverse to ONE materialization: without it XLA
+    # clones the scatter into every consumer fusion (~12 consumers × 3
+    # inverses = 36 scatters in the optimized HLO, ~66 GB modeled traffic,
+    # ~0.5 s/batch measured on TPU; barriered it is 3 scatters and ~ms).
+    inv_c, inv_f, inv_r = jax.lax.optimization_barrier((
+        _inverse_index(create, b.slot, n),
+        _inverse_index(upd_fwd, b.slot, n),
+        _inverse_index(upd_rev, b.slot, n),
+    ))
+    hit_c = inv_c != B
 
-    ts_for_rows = time_start[slot]
-    fwd = _updated_dir(
-        fwd, slot, b.time, b.pkts_lo, b.pkts_f, b.bytes_lo, b.bytes_f,
-        ts_for_rows, upd_fwd,
+    def gather(col, inv):
+        # sentinel row B appended so inv == B reads an inert value. The
+        # barrier keeps XLA from fusing the gather into its elementwise
+        # consumers — fused gathers serialize on TPU (measured ~130 ms per
+        # direction at 2²⁰ rows; barriered, the whole apply is ~ms).
+        return jax.lax.optimization_barrier(
+            jnp.concatenate([col, jnp.zeros((1,), col.dtype)])[inv]
+        )
+
+    time_start = jnp.where(hit_c, gather(b.time, inv_c), table.time_start)
+    in_use = table.in_use | hit_c
+
+    fwd = _merged_dir(
+        table.fwd, b, gather, time_start, inv_c, inv_f,
+        counters_from_batch=True, active_init=True,
     )
-    rev = _updated_dir(
-        rev, slot, b.time, b.pkts_lo, b.pkts_f, b.bytes_lo, b.bytes_f,
-        ts_for_rows, upd_rev,
+    rev = _merged_dir(
+        table.rev, b, gather, time_start, inv_c, inv_r,
+        counters_from_batch=False, active_init=False,
     )
 
     return FlowTable(time_start=time_start, in_use=in_use, fwd=fwd, rev=rev)
@@ -283,6 +354,14 @@ def stale_mask(table: FlowTable, now, idle_seconds) -> jax.Array:
     return table.in_use & (now - last >= idle_seconds)
 
 
+@jax.jit
+def stale_bits(table: FlowTable, now, idle_seconds):
+    """Bit-packed ``stale_mask`` — the eviction scan's one device→host
+    transfer shrinks 8× (1 MB → 128 KB at capacity 2²⁰; material on this
+    rig's ~12 MB/s tunnel). Host side unpacks with ``np.unpackbits``."""
+    return jnp.packbits(stale_mask(table, now, idle_seconds))
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def top_active_slots(table: FlowTable, n: int, floor):
     """Indices of the ≤n most active in-use slots this tick, ranked by
@@ -317,6 +396,27 @@ def top_active_slots(table: FlowTable, n: int, floor):
     )
     _, idx = jax.lax.top_k(score, n)
     return idx, jnp.take(table.in_use[:-1], idx)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def top_active_render(table: FlowTable, labels, n: int, floor):
+    """Everything one rendered table row needs, gathered on device in one
+    dispatch: ``(idx, valid, labels[idx], fwd_active[idx], rev_active[idx])``
+    for the ≤n most active slots (ranking of ``top_active_slots``).
+
+    ``labels`` is the (capacity,) vector from a full-table predict and
+    stays device-resident — only O(n) scalars cross to the host. A serving
+    tick that instead fetched the label and active vectors whole would
+    move ~6 MB per tick at capacity 2²⁰, which on this rig's ~12 MB/s
+    device tunnel costs more than the 2²⁰-row device predict itself."""
+    idx, valid = top_active_slots(table, n, floor)
+    return (
+        idx,
+        valid,
+        jnp.take(labels, idx),
+        jnp.take(table.fwd.active[:-1], idx),
+        jnp.take(table.rev.active[:-1], idx),
+    )
 
 
 def features12(table: FlowTable) -> jax.Array:

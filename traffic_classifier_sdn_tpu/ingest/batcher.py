@@ -82,7 +82,10 @@ class FlowIndex:
             self.free.append(slot)
 
 
-DEFAULT_BUCKETS = (256, 1024, 4096, 16384, 65536)
+# Top bucket covers a full 2²⁰-record tick in ONE flush: each flush costs
+# a device-link dispatch round trip (~65 ms on this rig's remote tunnel),
+# so at the million-flow scale fewer, larger scatters beat many small ones.
+DEFAULT_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144, 1048576)
 
 
 class Batcher:
@@ -176,7 +179,9 @@ class Batcher:
 
 
 # Donated so XLA updates the table in-place in HBM between poll ticks.
-_apply = jax.jit(ft.apply_batch, donate_argnums=0)
+# The batch crosses as one packed (B, 6) uint32 buffer (flow_table.pack_wire)
+# and unpacks on device — one transfer per flush instead of eight.
+_apply = jax.jit(ft.apply_wire, donate_argnums=0)
 
 
 class FlowStateEngine:
@@ -202,6 +207,9 @@ class FlowStateEngine:
             self.batcher = Batcher(self.index, buckets)
         self._tail = b""  # partial line carried across ingest_bytes calls
         self._last_time = 0
+        # cumulative host→device update-batch bytes (padded wire matrices)
+        # — lets serving benches report what actually crosses the link
+        self.wire_bytes = 0
         # freshness floor for the activity-ranked render sample: flows
         # with telemetry newer than this count as active (see mark_tick)
         self._tick_floor = 0
@@ -282,6 +290,27 @@ class FlowStateEngine:
         idx = np.asarray(idx)
         return [int(s) for s in idx[np.asarray(valid)]]
 
+    def render_sample(self, labels, n: int) -> list[tuple]:
+        """Activity-ranked render rows with O(n) host transfer:
+        ``(slot, label, fwd_active, rev_active)`` for the ≤n most active
+        flows this tick, most active first. ``labels`` is the (capacity,)
+        device vector from a full-table predict — it never crosses to the
+        host (a whole-vector fetch at capacity 2²⁰ costs more tunnel time
+        than the device predict; see flow_table.top_active_render)."""
+        n = min(n, self.table.capacity)
+        if n <= 0:
+            return []
+        idx, valid, lab, fa, ra = ft.top_active_render(
+            self.table, labels, n, np.int32(self._tick_floor)
+        )
+        idx, valid = np.asarray(idx), np.asarray(valid)
+        lab, fa, ra = np.asarray(lab), np.asarray(fa), np.asarray(ra)
+        return [
+            (int(s), int(c), bool(f), bool(r))
+            for s, v, c, f, r in zip(idx, valid, lab, fa, ra)
+            if v
+        ]
+
     def slot_metadata(self, limit: int | None = None,
                       slots: Iterable[int] | None = None) -> dict:
         """slot → (eth_src, eth_dst) for in-use slots (UI table).
@@ -327,7 +356,9 @@ class FlowStateEngine:
         Loops because one tick can exceed the largest batch bucket."""
         applied = False
         while (batch := self.batcher.flush()) is not None:
-            self.table = _apply(self.table, batch)
+            w = ft.pack_wire(batch)
+            self.wire_bytes += w.nbytes  # padded, i.e. what actually moves
+            self.table = _apply(self.table, w)
             applied = True
         return applied
 
@@ -344,13 +375,15 @@ class FlowStateEngine:
         # and no stale pending row may outlive its slot's eviction (it
         # would scatter into a reassigned slot).
         self.step()
-        # staleness is decided on device (core/flow_table.stale_mask): one
-        # bool array crosses to host instead of in_use + 2× last_time
-        stale = np.asarray(
-            ft.stale_mask(
-                self.table, np.int32(now), np.int32(idle_seconds)
-            )
-        )[:-1]
+        # staleness is decided on device (core/flow_table.stale_mask) and
+        # crosses to host bit-packed: capacity/8 bytes instead of a bool
+        # per slot (1 MB -> 128 KB at 2²⁰ over the ~12 MB/s tunnel)
+        stale = np.unpackbits(
+            np.asarray(
+                ft.stale_bits(self.table, np.int32(now), np.int32(idle_seconds))
+            ),
+            count=self.table.capacity + 1,
+        ).astype(bool)[:-1]
         slots = np.nonzero(stale)[0]
         step = self.batcher.buckets[-1]
         capacity = self.table.capacity
