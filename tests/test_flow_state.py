@@ -248,6 +248,32 @@ def test_evict_idle_reclaims_slots():
     assert key_cd not in eng.index.key_to_slot
 
 
+@pytest.mark.parametrize("native", [False, True])
+def test_evict_storm_bulk_release(native):
+    """A mass eviction (every tracked flow idle at once) must clear the
+    device table and release every slot through the bulk path, leaving the
+    whole capacity reusable — the idle-storm shape that made per-slot
+    release calls and per-field clear scatters pathological at 2²⁰."""
+    if native:
+        from traffic_classifier_sdn_tpu.native import engine as ne
+
+        if not ne.available():
+            pytest.skip("native engine unavailable")
+    eng = FlowStateEngine(capacity=512, native=native)
+    eng.ingest([_rec(1, f"s{i}", f"d{i}", 5, 500) for i in range(300)])
+    eng.step()
+    assert eng.num_flows() == 300
+    assert eng.evict_idle(now=100, idle_seconds=10) == 300
+    assert eng.num_flows() == 0
+    assert np.asarray(eng.table.in_use).sum() == 0
+    assert not np.asarray(eng.features()).any()
+    # every slot is reusable after the storm
+    eng.ingest([_rec(101, f"x{i}", f"y{i}", 1, 10) for i in range(300)])
+    eng.step()
+    assert eng.num_flows() == 300
+    assert eng.dropped == 0
+
+
 def test_bucketed_padding_no_recompile():
     """Batch sizes within one bucket reuse the same executable."""
     import jax

@@ -317,9 +317,9 @@ def apply_batch(table: FlowTable, b: UpdateBatch) -> FlowTable:
     return FlowTable(time_start=time_start, in_use=in_use, fwd=fwd, rev=rev)
 
 
-def _cleared_dir(d: DirState, slot) -> DirState:
+def _cleared_dir(d: DirState, keep) -> DirState:
     def put(arr):
-        return arr.at[slot].set(jnp.zeros((), arr.dtype), mode="drop")
+        return jnp.where(keep, arr, jnp.zeros((), arr.dtype))
 
     return DirState(
         pkts_lo=put(d.pkts_lo), pkts_f=put(d.pkts_f),
@@ -334,12 +334,19 @@ def _cleared_dir(d: DirState, slot) -> DirState:
 @jax.jit
 def clear_slots(table: FlowTable, slot: jax.Array) -> FlowTable:
     """Reset the given slots to the empty state (eviction). ``slot`` is a
-    fixed-length int32 batch padded with ``capacity`` (the scratch row)."""
+    fixed-length int32 batch padded with ``capacity`` (the scratch row).
+
+    One boolean-mask scatter (barriered — see apply_batch) followed by
+    elementwise clears: the former 26 per-field scatters serialize on TPU
+    and would cost ~seconds in a 2²⁰-slot eviction storm."""
+    n = table.time_start.shape[0]
+    cleared = jnp.zeros(n, bool).at[slot].set(True, mode="drop")
+    keep = jax.lax.optimization_barrier(~cleared)
     return FlowTable(
-        time_start=table.time_start.at[slot].set(0, mode="drop"),
-        in_use=table.in_use.at[slot].set(False, mode="drop"),
-        fwd=_cleared_dir(table.fwd, slot),
-        rev=_cleared_dir(table.rev, slot),
+        time_start=jnp.where(keep, table.time_start, 0),
+        in_use=table.in_use & keep,
+        fwd=_cleared_dir(table.fwd, keep),
+        rev=_cleared_dir(table.rev, keep),
     )
 
 

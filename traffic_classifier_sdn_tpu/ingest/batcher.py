@@ -81,6 +81,10 @@ class FlowIndex:
             self.slot_meta.pop(slot, None)
             self.free.append(slot)
 
+    def release_slots(self, slots) -> None:
+        for s in slots:
+            self.release_slot(int(s))
+
 
 # Top bucket covers a full 2²⁰-record tick in ONE flush: each flush costs
 # a device-link dispatch round trip (~65 ms on this rig's remote tunnel),
@@ -393,7 +397,7 @@ class FlowStateEngine:
             padded = np.full(size, capacity, np.int32)
             padded[: chunk.size] = chunk
             self.table = ft.clear_slots(self.table, padded)
-        release = (self.batcher if self.native else self.index).release_slot
-        for s in slots:
-            release(int(s))
+        # one bulk call: the native path crosses ctypes once for the whole
+        # eviction batch instead of once per slot
+        (self.batcher if self.native else self.index).release_slots(slots)
         return int(slots.size)

@@ -90,6 +90,9 @@ def _load():
             ct.c_void_p, ct.c_uint32, ct.c_char_p, ct.c_char_p, ct.c_uint32,
         ]
         lib.tc_engine_release_slot.argtypes = [ct.c_void_p, ct.c_uint32]
+        lib.tc_engine_release_slots.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_uint32,
+        ]
         _lib = lib
         return lib
 
@@ -228,3 +231,9 @@ class NativeBatcher:
 
     def release_slot(self, slot: int) -> None:
         self._lib.tc_engine_release_slot(self._h, slot)
+
+    def release_slots(self, slots) -> None:
+        """Bulk release: one ctypes crossing for the whole eviction batch
+        (``slots`` is any uint32-convertible array)."""
+        a = np.ascontiguousarray(slots, np.uint32)
+        self._lib.tc_engine_release_slots(self._h, _ptr(a), a.size)

@@ -646,4 +646,11 @@ void tc_engine_release_slot(void* h, uint32_t slot) {
   e->free_slots.push_back(slot);
 }
 
+// Bulk release: one ctypes crossing for an eviction batch instead of one
+// per slot — an idle-storm at the 2^20-flow scale releases hundreds of
+// thousands of slots in one tick.
+void tc_engine_release_slots(void* h, const uint32_t* slots, uint32_t n) {
+  for (uint32_t i = 0; i < n; ++i) tc_engine_release_slot(h, slots[i]);
+}
+
 }  // extern "C"
