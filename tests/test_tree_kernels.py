@@ -90,6 +90,53 @@ def test_pallas_bucketed_interpret_matches(forest_dict, X, want):
     np.testing.assert_array_equal(got, want)
 
 
+def _random_forest_dict(rng, n_trees: int, depth: int, n_classes: int = 6):
+    """Synthetic full binary trees of the importer's node-array shape."""
+    n_nodes = 2 ** (depth + 1) - 1
+    n_internal = 2 ** depth - 1
+    left = np.full((n_trees, n_nodes), -1, np.int32)
+    right = np.full((n_trees, n_nodes), -1, np.int32)
+    feature = np.zeros((n_trees, n_nodes), np.int32)
+    threshold = np.zeros((n_trees, n_nodes))
+    values = np.zeros((n_trees, n_nodes, n_classes))
+    for n in range(n_internal):
+        left[:, n] = 2 * n + 1
+        right[:, n] = 2 * n + 2
+    feature[:, :n_internal] = rng.randint(0, 12, (n_trees, n_internal))
+    threshold[:, :n_internal] = rng.rand(n_trees, n_internal) * 1000
+    values[:, n_internal:] = rng.rand(n_trees, n_nodes - n_internal,
+                                      n_classes) + 0.05
+    return {
+        "left": left, "right": right, "feature": feature,
+        "threshold": threshold, "values": values, "max_depth": depth,
+        "classes": np.arange(n_classes), "n_features": 12,
+    }
+
+
+@pytest.mark.parametrize(
+    "n_trees,depth",
+    [
+        (129, 3),   # shallow/many: tpd=16 packing, 8-indivisible group
+                    # count -> whole-axis chunk, bounded tree padding
+        (5, 7),     # D=127 -> pads to 128? (2^7-1=127 pads to 16-mult
+                    # 128 only via pow2 rule boundary), tpd=1
+        (3, 9),     # D=511 -> D > 128 branch, deep gL -> unfused leaf
+                    # accumulation path
+    ],
+)
+def test_pallas_synthetic_shapes_match_gather(n_trees, depth):
+    """The grouped block-diagonal packing must stay argmax-exact across
+    the packing regimes: multi-tree groups, single-tree groups, the
+    D > 128 padding branch, and the unfused deep-tree leaf path."""
+    rng = np.random.RandomState(depth * 100 + n_trees)
+    d = _random_forest_dict(rng, n_trees, depth)
+    Xs = jnp.asarray(rng.rand(513, 12).astype(np.float32) * 1000)
+    want_s = np.asarray(forest.predict(forest.from_numpy(d), Xs))
+    g = pallas_forest.compile_forest(d, row_tile=256)
+    got = np.asarray(pallas_forest.predict(g, Xs, interpret=True))
+    np.testing.assert_array_equal(got, want_s)
+
+
 def test_bench_vectorized_oracle_matches_scalar_walker(forest_dict, X):
     """bench.py's parity gate uses a vectorized level-synchronous NumPy
     node walk; the parity suite here uses a per-sample scalar walker

@@ -38,16 +38,24 @@ from . import tree_gemm
 
 
 class ForestPallas(struct.PyTreeNode):
-    feat_onehot: jax.Array  # (F, T*D) f32
-    thresholds: jax.Array  # (1, T*D) f32 (+inf padding)
-    path: jax.Array  # (T, D, L) bf16
-    leaf_depth: jax.Array  # (T, L) f32
-    leaf_values: jax.Array  # (T, L, C) f32 (pre-divided by T)
+    """Operands grouped tpd = 128//D trees at a time: ``path`` holds one
+    BLOCK-DIAGONAL (gD, gL) = (tpd·D, tpd·L) score operand per group, so
+    each score dot contracts a full 128-wide MXU tile; depth/values are
+    the matching concatenations. G below is the group count T//tpd."""
+
+    feat_onehot: jax.Array  # (F, G*gD) f32
+    thresholds: jax.Array  # (1, G*gD) f32 (+inf padding)
+    path: jax.Array  # (G, gD, gL) bf16, block-diagonal per group
+    leaf_depth: jax.Array  # (G, gL) f32
+    leaf_values: jax.Array  # (G, gL, C) f32 (pre-divided by total T)
     n_classes: int = struct.field(pytree_node=False)
-    n_internal: int = struct.field(pytree_node=False)  # D
-    n_leaves: int = struct.field(pytree_node=False)  # L
+    n_internal: int = struct.field(pytree_node=False)  # gD
+    n_leaves: int = struct.field(pytree_node=False)  # gL
     row_tile: int = struct.field(pytree_node=False)
-    tree_chunk: int = struct.field(pytree_node=False)
+    tree_chunk: int = struct.field(pytree_node=False)  # chunk_g groups/step
+    # one wide (TILE, chunk_g*gL) leaf GEMM per step when that buffer fits
+    # VMEM comfortably; per-group accumulation otherwise
+    fuse_leaf_gemm: bool = struct.field(pytree_node=False, default=True)
 
 
 class ForestPallasGroups(struct.PyTreeNode):
@@ -88,21 +96,34 @@ def _compile_single(
         d, n_features=n_features, n_trees_total=n_trees_total
     )
     T, D, L = ops["n_trees"], ops["n_internal"], ops["n_leaves"]
-    # Mosaic block-shape rule: the last two dims of every block must be
-    # divisible by (8, 128) or equal the full array dim. Pad D to a
-    # multiple of 8 with inert columns (+inf threshold -> pm=+1, zero
-    # path row -> no score contribution) and force the tree chunk to a
-    # multiple of 16, so the (F, TC*D) / (1, TC*D) blocks end on a
-    # 128-multiple and the (TC, L) depth block starts on an 8-multiple.
-    dpad = (-D) % 8
+    C = ops["n_classes"]
+    F = ops["n_features"]
+    # MXU shaping: a lone tree's score dot is (TILE, D) @ (D, L) with
+    # D ≈ 64, L ≈ 56 — a quarter-occupied 128×128 MXU tile. Pack
+    # tpd = 128//D trees into one BLOCK-DIAGONAL operand so every score
+    # dot runs K = tpd·D = 128 (one full tile of contraction), and fuse
+    # the per-tree (match @ leaf_values) dots into one wide K = TC·tpd·L
+    # GEMM per grid step. D first pads to a power of two ≤ 128 (inert
+    # columns: +inf threshold → pm=+1, zero path row → no score
+    # contribution), which also satisfies the Mosaic block rule (last two
+    # block dims divisible by (8, 128) or equal to the full dim).
+    # power-of-two padding only pays below 65 internal nodes, where it
+    # buys tpd >= 2 packing; above that tpd is 1 regardless, so a
+    # 16-multiple (the Mosaic minimum once chunk_g is a multiple of 8)
+    # wastes far fewer inert columns
+    if D <= 64:
+        Dp = max(8, 1 << (D - 1).bit_length())
+    else:
+        Dp = ((D + 15) // 16) * 16
+    dpad = Dp - D
     if dpad:
         ops["feat_onehot"] = np.concatenate(
             [
-                ops["feat_onehot"].reshape(ops["n_features"], T, D),
-                np.zeros((ops["n_features"], T, dpad), np.float32),
+                ops["feat_onehot"].reshape(F, T, D),
+                np.zeros((F, T, dpad), np.float32),
             ],
             axis=2,
-        ).reshape(ops["n_features"], T * (D + dpad))
+        ).reshape(F, T * Dp)
         ops["thresholds"] = np.concatenate(
             [
                 ops["thresholds"].reshape(T, D),
@@ -113,20 +134,29 @@ def _compile_single(
         ops["path"] = np.concatenate(
             [ops["path"], np.zeros((T, dpad, L), np.float32)], axis=1
         )
-        D += dpad
-    tree_chunk = max(16, ((tree_chunk + 15) // 16) * 16)
-    assert (tree_chunk * D) % 128 == 0 and tree_chunk % 8 == 0
-    # pad tree count to a multiple of tree_chunk with inert trees
-    # (zero leaf_values rows contribute nothing; depth 127 never matches)
-    pad = (-T) % tree_chunk
+        D = Dp
+    tpd = max(1, 128 // D)  # trees per block-diagonal dot group
+    # Grid chunking in GROUPS. The (chunk_g, gL) depth block needs
+    # chunk_g % 8 == 0 — unless chunk_g equals the whole group axis, so a
+    # small or 8-indivisible group count runs as one grid step instead of
+    # padding up to 7 inert groups (up to 7·tpd = 112 inert trees for
+    # shallow-tree buckets).
+    G_min = -(-T // tpd)
+    if G_min < 8 or (G_min <= 32 and G_min % 8 != 0):
+        chunk_g = G_min
+    else:
+        chunk_g = 8
+    # pad tree count so the group axis divides evenly (inert trees: zero
+    # leaf_values contribute nothing; depth 127 never matches)
+    pad = -(-G_min // chunk_g) * chunk_g * tpd - T
     if pad:
         ops["feat_onehot"] = np.concatenate(
             [
-                ops["feat_onehot"].reshape(-1, T, D),
-                np.zeros((ops["n_features"], pad, D), np.float32),
+                ops["feat_onehot"].reshape(F, T, D),
+                np.zeros((F, pad, D), np.float32),
             ],
             axis=1,
-        ).reshape(ops["n_features"], (T + pad) * D)
+        ).reshape(F, (T + pad) * D)
         ops["thresholds"] = np.concatenate(
             [
                 ops["thresholds"].reshape(T, D),
@@ -140,44 +170,68 @@ def _compile_single(
             [ops["leaf_depth"], np.full((pad, L), 127.0, np.float32)]
         )
         ops["leaf_values"] = np.concatenate(
-            [
-                ops["leaf_values"],
-                np.zeros((pad, L, ops["n_classes"]), np.float32),
-            ]
+            [ops["leaf_values"], np.zeros((pad, L, C), np.float32)]
         )
+        T += pad
+    G, gD, gL = T // tpd, tpd * D, tpd * L
+    path_blk = np.zeros((G, gD, gL), np.float32)
+    for g in range(G):
+        for j in range(tpd):
+            path_blk[g, j * D:(j + 1) * D, j * L:(j + 1) * L] = (
+                ops["path"][g * tpd + j]
+            )
+    assert (chunk_g * gD) % 128 == 0 or chunk_g == G
     return ForestPallas(
         feat_onehot=jnp.asarray(ops["feat_onehot"]),
         thresholds=jnp.asarray(ops["thresholds"][None, :]),
-        path=jnp.asarray(ops["path"], jnp.bfloat16),
-        leaf_depth=jnp.asarray(ops["leaf_depth"]),
-        leaf_values=jnp.asarray(ops["leaf_values"]),
-        n_classes=ops["n_classes"],
-        n_internal=D,
-        n_leaves=L,
+        path=jnp.asarray(path_blk, jnp.bfloat16),
+        leaf_depth=jnp.asarray(ops["leaf_depth"].reshape(G, gL)),
+        leaf_values=jnp.asarray(ops["leaf_values"].reshape(G, gL, C)),
+        n_classes=C,
+        n_internal=gD,
+        n_leaves=gL,
         row_tile=row_tile,
-        tree_chunk=tree_chunk,
+        tree_chunk=chunk_g,
+        fuse_leaf_gemm=(chunk_g * gL) <= 2048,
     )
 
 
 def _kernel(
     x_ref, a_ref, thr_ref, path_ref, depth_ref, vals_ref, out_ref,
-    *, tree_chunk: int, n_internal: int,
+    *, tree_chunk: int, n_internal: int, fuse_leaf_gemm: bool,
 ):
     t = pl.program_id(1)
     xf = jnp.dot(
         x_ref[:], a_ref[:], preferred_element_type=jnp.float32
-    )  # (TILE, TC*D)
+    )  # (TILE, chunk_g*gD)
     pm = jnp.where(xf <= thr_ref[:], 1.0, -1.0).astype(jnp.bfloat16)
-    acc = jnp.zeros((x_ref.shape[0], out_ref.shape[1]), jnp.float32)
+    # per-group score dots: (TILE, gD=128) @ block-diag (gD, gL) — each
+    # contracts a full MXU tile (tpd trees per pass instead of one)
+    matches = []
     for k in range(tree_chunk):
         pm_k = pm[:, k * n_internal:(k + 1) * n_internal]
         S = jnp.dot(
             pm_k, path_ref[k], preferred_element_type=jnp.float32
-        )  # (TILE, L)
-        match = (S == depth_ref[k][None, :]).astype(jnp.float32)
-        acc = acc + jnp.dot(
-            match, vals_ref[k], preferred_element_type=jnp.float32
+        )  # (TILE, gL)
+        matches.append(S == depth_ref[k][None, :])
+    if fuse_leaf_gemm:
+        # ONE wide leaf-value GEMM per grid step: (TILE, chunk_g*gL) @
+        # (.., C) replaces chunk_g skinny K=gL dots
+        match = jnp.concatenate(matches, axis=1).astype(jnp.float32)
+        acc = jnp.dot(
+            match,
+            vals_ref[:].reshape(-1, out_ref.shape[1]),
+            preferred_element_type=jnp.float32,
         )
+    else:
+        # deep-tree buckets: the concatenated match buffer would not fit
+        # VMEM — accumulate group by group instead
+        acc = jnp.zeros((x_ref.shape[0], out_ref.shape[1]), jnp.float32)
+        for k, m in enumerate(matches):
+            acc = acc + jnp.dot(
+                m.astype(jnp.float32), vals_ref[k],
+                preferred_element_type=jnp.float32,
+            )
 
     @pl.when(t == 0)
     def _():
@@ -210,7 +264,10 @@ def forest_proba_pallas(
         X = jnp.concatenate([X, jnp.zeros((padded, F), X.dtype)])
     n_tiles = X.shape[0] // TILE
 
-    kernel = functools.partial(_kernel, tree_chunk=TC, n_internal=D)
+    kernel = functools.partial(
+        _kernel, tree_chunk=TC, n_internal=D,
+        fuse_leaf_gemm=g.fuse_leaf_gemm,
+    )
     out = pl.pallas_call(
         kernel,
         grid=(n_tiles, n_chunks),
