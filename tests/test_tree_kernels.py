@@ -118,10 +118,10 @@ def _random_forest_dict(rng, n_trees: int, depth: int, n_classes: int = 6):
     [
         (129, 3),   # shallow/many: tpd=16 packing, 8-indivisible group
                     # count -> whole-axis chunk, bounded tree padding
-        (5, 7),     # D=127 -> pads to 128? (2^7-1=127 pads to 16-mult
-                    # 128 only via pow2 rule boundary), tpd=1
-        (3, 9),     # D=511 -> D > 128 branch, deep gL -> unfused leaf
-                    # accumulation path
+        (5, 7),     # D=127 -> 16-multiple padding branch, tpd=1
+        (3, 9),     # D=511, fused leaf GEMM at chunk_g*gL = 1536
+        (3, 10),    # D=1023, gL=1024 -> chunk_g*gL = 3072 > 2048:
+                    # the UNFUSED per-group leaf accumulation path
     ],
 )
 def test_pallas_synthetic_shapes_match_gather(n_trees, depth):

@@ -391,6 +391,15 @@ def top_active_slots(table: FlowTable, n: int, floor):
     Returns ``(idx, valid)``: unused slots score −inf and are masked out
     via ``valid``.
     """
+    _, idx = jax.lax.top_k(_activity_score(table, floor), n)
+    return idx, jnp.take(table.in_use[:-1], idx)
+
+
+def _activity_score(table: FlowTable, floor):
+    """(capacity,) ranking score: |Δbytes| for slots with telemetry newer
+    than ``floor``, 0 for stale in-use slots, −inf for unused — THE
+    activity definition every ranked surface shares (single-table render,
+    per-shard candidates, cross-shard merge ordering)."""
     act = (
         jnp.abs(table.fwd.delta_bytes.astype(jnp.float32))
         + jnp.abs(table.rev.delta_bytes.astype(jnp.float32))
@@ -398,11 +407,27 @@ def top_active_slots(table: FlowTable, n: int, floor):
     fresh = (
         jnp.maximum(table.fwd.last_time, table.rev.last_time)[:-1] > floor
     )
-    score = jnp.where(
+    return jnp.where(
         table.in_use[:-1], jnp.where(fresh, act, 0.0), -jnp.inf
     )
-    _, idx = jax.lax.top_k(score, n)
-    return idx, jnp.take(table.in_use[:-1], idx)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def top_active_scored(table: FlowTable, labels, n: int, floor):
+    """``top_active_render`` plus the activity scores — the per-shard half
+    of a cross-shard render merge (parallel/table_sharded.py): each shard
+    returns its local top-n with scores; the global top-n is the best n
+    of the concatenated candidates, exact because per-shard top-n sets
+    contain every global winner and the merge sorts by the same score."""
+    vals, idx = jax.lax.top_k(_activity_score(table, floor), n)
+    return (
+        idx,
+        jnp.take(table.in_use[:-1], idx),
+        vals,
+        jnp.take(labels, idx),
+        jnp.take(table.fwd.active[:-1], idx),
+        jnp.take(table.rev.active[:-1], idx),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("n",))

@@ -136,7 +136,8 @@ def _compile_single(
         )
         D = Dp
     tpd = max(1, 128 // D)  # trees per block-diagonal dot group
-    # Grid chunking in GROUPS. The (chunk_g, gL) depth block needs
+    # Grid chunking in GROUPS, honoring ``tree_chunk`` as the requested
+    # trees per grid step. The (chunk_g, gL) depth block needs
     # chunk_g % 8 == 0 — unless chunk_g equals the whole group axis, so a
     # small or 8-indivisible group count runs as one grid step instead of
     # padding up to 7 inert groups (up to 7·tpd = 112 inert trees for
@@ -145,7 +146,8 @@ def _compile_single(
     if G_min < 8 or (G_min <= 32 and G_min % 8 != 0):
         chunk_g = G_min
     else:
-        chunk_g = 8
+        pref = max(1, -(-tree_chunk // tpd))
+        chunk_g = max(8, ((pref + 7) // 8) * 8)
     # pad tree count so the group axis divides evenly (inert trees: zero
     # leaf_values contribute nothing; depth 127 never matches)
     pad = -(-G_min // chunk_g) * chunk_g * tpd - T

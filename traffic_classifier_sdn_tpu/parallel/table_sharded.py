@@ -1,0 +1,263 @@
+"""Flow-state table sharded across the device mesh — serving capacity
+beyond one chip's table.
+
+The reference tracks flows in one Python dict (traffic_classifier.py:24);
+the single-device replacement is ``core/flow_table.FlowTable``. This module
+scales that serving state across the mesh's data axis: each device owns an
+independent ``(local_capacity+1,)`` SoA shard, the host routes update
+records to shards by global slot range, and every device op runs under one
+``shard_map`` (no cross-device traffic in the steady state — flows are
+partitioned, not replicated; only the O(rows) render candidates and the
+bit-packed stale masks come home, where the tiny cross-shard merges happen
+on host).
+
+Scaling shape: capacity_total = n_shards × local_capacity, one scatter +
+one full-shard predict per shard per tick, all shards in parallel — an
+8-device mesh serves 2²³ concurrent flows at the same per-device cost the
+single-chip spine pays for 2²⁰.
+
+Device axis layout: every leaf carries a leading ``(n_shards, …)`` axis
+sharded over ``mesh``'s data axis; ``shard_map`` peels it to the local
+``[0]`` table inside each shard.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import flow_table as ft
+from ..ingest.batcher import DEFAULT_BUCKETS, FlowIndex, Batcher, bucket_size
+from .mesh import DATA_AXIS
+
+
+def _n_shards(mesh) -> int:
+    return mesh.shape[DATA_AXIS]
+
+
+def make_sharded_table(mesh, capacity_total: int) -> ft.FlowTable:
+    """A FlowTable pytree with leaves of shape (n_shards, local_cap+1),
+    dim 0 sharded over the mesh's data axis."""
+    n = _n_shards(mesh)
+    if capacity_total % n:
+        raise ValueError(f"capacity {capacity_total} not divisible by {n}")
+    local = ft.make_table(capacity_total // n)
+    stacked = jax.tree.map(lambda a: jnp.broadcast_to(a, (n,) + a.shape), local)
+    return jax.device_put(
+        stacked, NamedSharding(mesh, P(DATA_AXIS))
+    )
+
+
+def make_apply(mesh):
+    """jit'd (tables, wire) → tables: per-shard ``apply_wire`` under one
+    shard_map. ``wire`` is (n_shards, B, 6) uint32 — the host router pads
+    every shard's sub-batch to one common bucket size."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def apply(tables, wire):
+        def local(t, w):
+            t1 = jax.tree.map(lambda a: a[0], t)
+            out = ft.apply_wire(t1, w[0])
+            return jax.tree.map(lambda a: a[None], out)
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS),
+        )(tables, wire)
+
+    return apply
+
+
+def make_tick_outputs(mesh, predict_fn, n_rows: int):
+    """jit'd (tables, params, floor, now, idle_seconds) → per-shard render
+    candidates + stale bits, ONE dispatch for the whole tick's read side:
+    full-shard predict, scored local top-n (labels + active flags
+    gathered device-side), and the bit-packed eviction mask. Everything
+    that crosses to host is O(n_rows + capacity/8) per shard."""
+
+    @jax.jit
+    def tick(tables, params, floor, now, idle_seconds):
+        def local(t, p, fl, nw, idl):
+            t1 = jax.tree.map(lambda a: a[0], t)
+            labels = predict_fn(p, ft.features12(t1))
+            outs = ft.top_active_scored(t1, labels, n_rows, fl[0, 0])
+            bits = ft.stale_bits(t1, nw[0, 0], idl[0, 0])
+            return tuple(o[None] for o in outs) + (bits[None],)
+
+        scalar = lambda v: jnp.broadcast_to(  # noqa: E731
+            jnp.int32(v), (_n_shards(mesh), 1)
+        )
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS),
+        )(tables, params, scalar(floor), scalar(now), scalar(idle_seconds))
+
+    return tick
+
+
+def make_clear(mesh):
+    """jit'd (tables, slots) → tables: per-shard ``clear_slots``; ``slots``
+    is (n_shards, E) LOCAL slot ids padded with local_capacity."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def clear(tables, slots):
+        def local(t, s):
+            t1 = jax.tree.map(lambda a: a[0], t)
+            out = ft.clear_slots(t1, s[0])
+            return jax.tree.map(lambda a: a[None], out)
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS),
+        )(tables, slots)
+
+    return clear
+
+
+class ShardedFlowEngine:
+    """Host spine for the sharded table: ONE global flow index (slots
+    [0, capacity_total)), shard routing by slot range, shard_map device
+    ops. The single-device ``FlowStateEngine`` API shape, scaled across
+    the mesh.
+
+    Shard s owns global slots [s·local_cap, (s+1)·local_cap); the host
+    splits every flushed batch by that range, so a flow's whole lifetime
+    stays on one shard and no device ever sees another shard's state.
+    """
+
+    def __init__(self, mesh, capacity_total: int, buckets=DEFAULT_BUCKETS,
+                 predict_fn=None, params=None, table_rows: int = 64):
+        self.mesh = mesh
+        self.n_shards = _n_shards(mesh)
+        if capacity_total % self.n_shards:
+            raise ValueError("capacity must divide evenly across shards")
+        self.local_capacity = capacity_total // self.n_shards
+        self.capacity = capacity_total
+        self.index = FlowIndex(capacity_total)
+        self.batcher = Batcher(self.index, buckets)
+        self.buckets = buckets
+        self.tables = make_sharded_table(mesh, capacity_total)
+        self._apply = make_apply(mesh)
+        self._clear = make_clear(mesh)
+        self._tick_outputs = (
+            make_tick_outputs(mesh, predict_fn, table_rows)
+            if predict_fn is not None else None
+        )
+        self.params = params
+        self.table_rows = table_rows
+        self._tick_floor = 0
+        self._last_time = 0
+
+    # -- ingest (host) -----------------------------------------------------
+    def ingest(self, records) -> int:
+        n = 0
+        for r in records:
+            self._last_time = max(self._last_time, r.time)
+            if not self.batcher.add(r):
+                self.step()
+                self.batcher.add(r)
+            n += 1
+        return n
+
+    @property
+    def last_time(self) -> int:
+        return self._last_time
+
+    def mark_tick(self) -> None:
+        self._tick_floor = self._last_time
+
+    def num_flows(self) -> int:
+        return len(self.index.slot_meta)
+
+    # -- device ops --------------------------------------------------------
+    def _route(self, batch) -> np.ndarray:
+        """(n_shards, B, 6) uint32: the flushed batch split by owning
+        shard, each sub-batch rebased to local slots and padded (local
+        scratch = local_capacity) to one shared bucket size."""
+        w = ft.pack_wire(batch)
+        gslot = w[:, 0] & np.uint32(0x3FFFFFFF)
+        real = gslot < self.capacity
+        shard = np.minimum(
+            gslot // self.local_capacity, self.n_shards - 1
+        ).astype(np.int64)
+        counts = np.bincount(shard[real], minlength=self.n_shards)
+        B = bucket_size(int(counts.max()) if counts.size else 1, self.buckets)
+        out = np.empty((self.n_shards, B, 6), np.uint32)
+        # padding rows: local scratch slot, no flags
+        out[:, :, 0] = np.uint32(self.local_capacity)
+        out[:, :, 1:] = 0
+        for s in range(self.n_shards):
+            rows = w[real & (shard == s)]
+            rows[:, 0] -= np.uint32(s * self.local_capacity)
+            out[s, : rows.shape[0]] = rows
+        return out
+
+    def step(self) -> bool:
+        applied = False
+        while (batch := self.batcher.flush()) is not None:
+            self.tables = self._apply(self.tables, jnp.asarray(self._route(batch)))
+            applied = True
+        return applied
+
+    def tick_render(self, now: int, idle_seconds: int):
+        """One fused read-side dispatch for the whole mesh: returns
+        ``(rows, evicted)`` where rows are the global top table_rows
+        ``(global_slot, label, fwd_active, rev_active)`` merged across
+        shards by activity score, and evicted is the count of idle flows
+        released everywhere."""
+        if self._tick_outputs is None:
+            raise ValueError("engine built without a predict_fn")
+        self.step()
+        idx, valid, score, lab, fa, ra, bits = (
+            np.asarray(o)
+            for o in self._tick_outputs(
+                self.tables, self.params, self._tick_floor, now, idle_seconds
+            )
+        )
+        # global render merge: best table_rows of n_shards×table_rows
+        # candidates (tiny, host-side)
+        cand = []
+        for s in range(self.n_shards):
+            for j in range(idx.shape[1]):
+                if valid[s, j]:
+                    cand.append((
+                        float(score[s, j]),
+                        int(s * self.local_capacity + idx[s, j]),
+                        int(lab[s, j]), bool(fa[s, j]), bool(ra[s, j]),
+                    ))
+        cand.sort(key=lambda c: (-c[0], c[1]))
+        rows = [(g, c, f, r) for _sc, g, c, f, r in cand[: self.table_rows]]
+
+        # eviction: unpack each shard's bits, release + clear
+        evicted = 0
+        local_cap = self.local_capacity
+        clear_batches = []
+        for s in range(self.n_shards):
+            stale = np.unpackbits(bits[s], count=local_cap + 1)[:-1]
+            slots = np.nonzero(stale)[0]
+            evicted += slots.size
+            clear_batches.append(slots)
+            self.index.release_slots(slots + s * local_cap)
+        E = max((b.size for b in clear_batches), default=0)
+        if E:
+            E = bucket_size(E, self.buckets)
+            padded = np.full((self.n_shards, E), local_cap, np.int32)
+            for s, b in enumerate(clear_batches):
+                padded[s, : b.size] = b
+            self.tables = self._clear(self.tables, jnp.asarray(padded))
+        return rows, evicted
+
+    def slot_metadata(self, slots):
+        return {
+            int(s): self.index.slot_meta[s]
+            for s in slots
+            if s in self.index.slot_meta
+        }
