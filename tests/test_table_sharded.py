@@ -64,8 +64,9 @@ def test_sharded_state_matches_single_device(mesh):
         sharded.ingest(recs)
         single.step()
         sharded.step()
-    # identical global feature state: concatenate per-shard rows
-    Xs = np.concatenate(
+    # identical global feature state: global slot g lives on shard
+    # g % n_shards at local row g // n_shards, so interleave per-shard rows
+    shard_feats = np.stack(
         [
             np.asarray(
                 ft.features12(jax.tree.map(lambda a: a[s], sharded.tables))
@@ -73,6 +74,7 @@ def test_sharded_state_matches_single_device(mesh):
             for s in range(sharded.n_shards)
         ]
     )
+    Xs = shard_feats.transpose(1, 0, 2).reshape(-1, 12)
     X1 = np.asarray(ft.features12(single.table))
     np.testing.assert_array_equal(Xs, X1)
     assert sharded.num_flows() == single.num_flows() == 40

@@ -45,11 +45,29 @@ def main() -> None:
         "default) or forest (the flagship 100-tree checkpoint via the "
         "bucketed GEMM kernel — the realistic TPU serving configuration)",
     )
+    ap.add_argument(
+        "--shards", type=int, default=0,
+        help="shard the flow table over an N-device mesh "
+        "(parallel/table_sharded.py); on the cpu platform N virtual "
+        "devices are forced, so --shards 8 --capacity 8388608 exercises "
+        "the 2²³-flow sharded spine on one host",
+    )
     args = ap.parse_args()
 
     if args.platform == "cpu":
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"
+        if args.shards > 1:
+            import re
+
+            flags = re.sub(
+                r"--?xla_force_host_platform_device_count=\S*", "",
+                os.environ.get("XLA_FLAGS", ""),
+            )
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.shards}"
+            ).strip()
     sys.path.insert(
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
@@ -70,7 +88,6 @@ def main() -> None:
     native = (not args.no_native) and native_engine.available()
     cap = args.capacity
     n_flows = cap // 2  # two directions share one slot; stay under capacity
-    eng = FlowStateEngine(capacity=cap, native=native)
     syn = SyntheticFlows(n_flows=n_flows, seed=0)
 
     if args.model == "forest":
@@ -99,6 +116,22 @@ def main() -> None:
         )
         predict = jax.jit(gnb.predict)
 
+    if args.shards > 1:
+        from traffic_classifier_sdn_tpu.ops import tree_gemm as _tg
+        from traffic_classifier_sdn_tpu.parallel import (
+            mesh as meshlib,
+            table_sharded as tsh,
+        )
+
+        raw_fn = _tg.predict if args.model == "forest" else gnb.predict
+        eng = tsh.ShardedFlowEngine(
+            meshlib.make_mesh(n_data=args.shards, n_state=1),
+            cap, predict_fn=raw_fn, params=params,
+            table_rows=args.table_rows, native=native,
+        )
+    else:
+        eng = FlowStateEngine(capacity=cap, native=native)
+
     print(
         f"# generating {args.ticks} ticks × {2 * n_flows} records "
         f"(capacity {cap}, native={native})",
@@ -118,22 +151,39 @@ def main() -> None:
         t1 = time.perf_counter()
         eng.step()
         t2 = time.perf_counter()
-        # full-table predict stays device-resident; the render gather
-        # fetches O(table_rows), not the (capacity,) label vector. The
-        # render stage's device fetch is the tick's first hard sync, so it
-        # also absorbs the (async-dispatched) scatter + predict time —
-        # "predict" here is dispatch-only, "render" is where the wait is.
-        labels = predict(params, eng.features())
-        t3 = time.perf_counter()
-        ranked = eng.render_sample(labels, args.table_rows)
-        sample = eng.slot_metadata(slots=[s for s, *_ in ranked])
-        rows = [
-            (s, *sample[s], c) for s, c, _fa, _ra in ranked if s in sample
-        ]
-        footer = f"showing {len(rows)} of {eng.num_flows()}"
-        t4 = time.perf_counter()
-        evicted = eng.evict_idle(now=eng.last_time, idle_seconds=3600)
-        t5 = time.perf_counter()
+        if args.shards > 1:
+            # the sharded spine's whole read side (per-shard predict +
+            # scored render candidates + stale bits) is ONE dispatch; the
+            # "predict" stage carries it, "evict" only the clear/release
+            ranked, evicted = eng.tick_render(
+                now=eng.last_time, idle_seconds=3600
+            )
+            t3 = time.perf_counter()
+            sample = eng.slot_metadata([s for s, *_ in ranked])
+            rows = [
+                (s, *sample[s], c)
+                for s, c, _fa, _ra in ranked if s in sample
+            ]
+            footer = f"showing {len(rows)} of {eng.num_flows()}"
+            t4 = t5 = time.perf_counter()
+        else:
+            # full-table predict stays device-resident; the render gather
+            # fetches O(table_rows), not the (capacity,) label vector. The
+            # render stage's device fetch is the tick's first hard sync,
+            # so it also absorbs the (async-dispatched) scatter + predict
+            # time — "predict" is dispatch-only, "render" holds the wait.
+            labels = predict(params, eng.features())
+            t3 = time.perf_counter()
+            ranked = eng.render_sample(labels, args.table_rows)
+            sample = eng.slot_metadata(slots=[s for s, *_ in ranked])
+            rows = [
+                (s, *sample[s], c)
+                for s, c, _fa, _ra in ranked if s in sample
+            ]
+            footer = f"showing {len(rows)} of {eng.num_flows()}"
+            t4 = time.perf_counter()
+            evicted = eng.evict_idle(now=eng.last_time, idle_seconds=3600)
+            t5 = time.perf_counter()
         timings["ingest"].append(t1 - t0)
         timings["step"].append(t2 - t1)
         timings["predict"].append(t3 - t2)
@@ -190,6 +240,7 @@ def main() -> None:
                     if link_mb_s is not None else {}
                 ),
                 "native_ingest": native,
+                **({"shards": args.shards} if args.shards > 1 else {}),
                 "platform": jax.devices()[0].platform,
                 "predict_model": args.model,
                 "table_rows_rendered": args.table_rows,

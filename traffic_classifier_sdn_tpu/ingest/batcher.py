@@ -188,18 +188,15 @@ class Batcher:
 _apply = jax.jit(ft.apply_wire, donate_argnums=0)
 
 
-class FlowStateEngine:
-    """The full host↔device ingest spine: records in, feature matrix out.
+class HostSpine:
+    """The shared host half of a serving spine — batcher/index wiring,
+    record + raw-byte ingest (native C++ or Python fallback), the tick
+    clock, and slot-metadata lookups. ``FlowStateEngine`` (single device)
+    and ``parallel.table_sharded.ShardedFlowEngine`` (mesh-sharded) both
+    build on this; each owns its device half (step/predict/render/evict).
+    Subclass must call ``_init_spine`` and define ``step()``."""
 
-    Replaces the reference's ``run_ryu`` inner loop + ``flows`` dict
-    (traffic_classifier.py:144-171) — but where the reference touches every
-    flow object per line in Python, this applies one scatter per poll tick
-    and keeps all state device-resident.
-    """
-
-    def __init__(self, capacity: int, buckets=DEFAULT_BUCKETS,
-                 native: bool = False):
-        self.table = ft.make_table(capacity)
+    def _init_spine(self, capacity: int, buckets, native: bool) -> None:
         self.native = native
         if native:
             from ..native.engine import NativeBatcher
@@ -209,6 +206,7 @@ class FlowStateEngine:
         else:
             self.index = FlowIndex(capacity)
             self.batcher = Batcher(self.index, buckets)
+        self.buckets = buckets
         self._tail = b""  # partial line carried across ingest_bytes calls
         self._last_time = 0
         # cumulative host→device update-batch bytes (padded wire matrices)
@@ -270,14 +268,47 @@ class FlowStateEngine:
         return len(self.index.slot_meta)
 
     def mark_tick(self) -> None:
-        """Snapshot the freshness floor for ``top_slots`` — call at the
-        START of each poll tick (before ingesting its records). Flows with
-        telemetry strictly newer than the floor count as active; the
-        snapshot is the max timestamp of all *previous* ticks, so skew
-        between datapaths reporting within one tick cannot demote a busy
-        flow. Never calling it degrades ``top_slots`` to all-time
-        activity ranking."""
+        """Snapshot the freshness floor for the activity-ranked render —
+        call at the START of each poll tick (before ingesting its
+        records). Flows with telemetry strictly newer than the floor count
+        as active; the snapshot is the max timestamp of all *previous*
+        ticks, so skew between datapaths reporting within one tick cannot
+        demote a busy flow. Never calling it degrades the ranking to
+        all-time activity."""
         self._tick_floor = self.last_time
+
+    def _slot_meta_for(self, slots) -> dict:
+        """slot → (eth_src, eth_dst) for exactly the given slots."""
+        if self.native:
+            out = {}
+            for s in slots:
+                meta = self.batcher.slot_meta(int(s))
+                if meta is not None:
+                    out[int(s)] = meta
+            return out
+        return {
+            int(s): self.index.slot_meta[s]
+            for s in slots
+            if s in self.index.slot_meta
+        }
+
+    def step(self) -> bool:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class FlowStateEngine(HostSpine):
+    """The full host↔device ingest spine: records in, feature matrix out.
+
+    Replaces the reference's ``run_ryu`` inner loop + ``flows`` dict
+    (traffic_classifier.py:144-171) — but where the reference touches every
+    flow object per line in Python, this applies one scatter per poll tick
+    and keeps all state device-resident.
+    """
+
+    def __init__(self, capacity: int, buckets=DEFAULT_BUCKETS,
+                 native: bool = False):
+        self.table = ft.make_table(capacity)
+        self._init_spine(capacity, buckets, native)
 
     def top_slots(self, n: int) -> list[int]:
         """Slots of the ≤n most active flows this tick, most active first
@@ -326,18 +357,7 @@ class FlowStateEngine:
         tick, and the reference only ever prints dozens of flows
         (traffic_classifier.py:99-118)."""
         if slots is not None:
-            if self.native:
-                out = {}
-                for s in slots:
-                    meta = self.batcher.slot_meta(int(s))
-                    if meta is not None:
-                        out[int(s)] = meta
-                return out
-            return {
-                int(s): self.index.slot_meta[s]
-                for s in slots
-                if s in self.index.slot_meta
-            }
+            return self._slot_meta_for(slots)
         if not self.native:
             items = self.index.slot_meta.items()
             if limit is None:

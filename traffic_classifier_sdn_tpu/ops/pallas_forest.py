@@ -146,8 +146,10 @@ def _compile_single(
     if G_min < 8 or (G_min <= 32 and G_min % 8 != 0):
         chunk_g = G_min
     else:
-        pref = max(1, -(-tree_chunk // tpd))
-        chunk_g = max(8, ((pref + 7) // 8) * 8)
+        pref = max(8, ((max(1, -(-tree_chunk // tpd)) + 7) // 8) * 8)
+        # honor the requested trees/step, but never at the cost of more
+        # inert-group padding than the minimal 8-group chunking needs
+        chunk_g = pref if (-G_min) % pref <= (-G_min) % 8 else 8
     # pad tree count so the group axis divides evenly (inert trees: zero
     # leaf_values contribute nothing; depth 127 never matches)
     pad = -(-G_min // chunk_g) * chunk_g * tpd - T

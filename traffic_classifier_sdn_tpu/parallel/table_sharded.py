@@ -5,7 +5,7 @@ The reference tracks flows in one Python dict (traffic_classifier.py:24);
 the single-device replacement is ``core/flow_table.FlowTable``. This module
 scales that serving state across the mesh's data axis: each device owns an
 independent ``(local_capacity+1,)`` SoA shard, the host routes update
-records to shards by global slot range, and every device op runs under one
+records to shards round-robin by slot, and every device op runs under one
 ``shard_map`` (no cross-device traffic in the steady state — flows are
 partitioned, not replicated; only the O(rows) render candidates and the
 bit-packed stale masks come home, where the tiny cross-shard merges happen
@@ -31,7 +31,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import flow_table as ft
-from ..ingest.batcher import DEFAULT_BUCKETS, FlowIndex, Batcher, bucket_size
+from ..ingest.batcher import DEFAULT_BUCKETS, HostSpine, bucket_size
 from .mesh import DATA_AXIS
 
 
@@ -122,60 +122,40 @@ def make_clear(mesh):
     return clear
 
 
-class ShardedFlowEngine:
+class ShardedFlowEngine(HostSpine):
     """Host spine for the sharded table: ONE global flow index (slots
-    [0, capacity_total)), shard routing by slot range, shard_map device
-    ops. The single-device ``FlowStateEngine`` API shape, scaled across
-    the mesh.
+    [0, capacity_total)), shard routing by slot, shard_map device ops —
+    the single-device ``FlowStateEngine`` API shape, scaled across the
+    mesh (the host half is the shared ``HostSpine``).
 
-    Shard s owns global slots [s·local_cap, (s+1)·local_cap); the host
-    splits every flushed batch by that range, so a flow's whole lifetime
-    stays on one shard and no device ever sees another shard's state.
+    Global slot g lives on shard g % n_shards at local slot g // n_shards
+    — round-robin, NOT range partitioning, because the index assigns
+    slots sequentially: ranges would pile every new flow onto one shard
+    (and pad every other shard's sub-batch to the hot shard's bucket),
+    while interleaving keeps any allocation pattern balanced. A flow's
+    whole lifetime stays on one shard; no device sees another's state.
     """
 
     def __init__(self, mesh, capacity_total: int, buckets=DEFAULT_BUCKETS,
-                 predict_fn=None, params=None, table_rows: int = 64):
+                 predict_fn=None, params=None, table_rows: int = 64,
+                 native: bool = False):
         self.mesh = mesh
         self.n_shards = _n_shards(mesh)
         if capacity_total % self.n_shards:
             raise ValueError("capacity must divide evenly across shards")
         self.local_capacity = capacity_total // self.n_shards
         self.capacity = capacity_total
-        self.index = FlowIndex(capacity_total)
-        self.batcher = Batcher(self.index, buckets)
-        self.buckets = buckets
+        self._init_spine(capacity_total, buckets, native)
         self.tables = make_sharded_table(mesh, capacity_total)
         self._apply = make_apply(mesh)
         self._clear = make_clear(mesh)
+        # a shard's top_k cannot ask for more rows than it holds
+        self.table_rows = min(table_rows, self.local_capacity)
         self._tick_outputs = (
-            make_tick_outputs(mesh, predict_fn, table_rows)
+            make_tick_outputs(mesh, predict_fn, self.table_rows)
             if predict_fn is not None else None
         )
         self.params = params
-        self.table_rows = table_rows
-        self._tick_floor = 0
-        self._last_time = 0
-
-    # -- ingest (host) -----------------------------------------------------
-    def ingest(self, records) -> int:
-        n = 0
-        for r in records:
-            self._last_time = max(self._last_time, r.time)
-            if not self.batcher.add(r):
-                self.step()
-                self.batcher.add(r)
-            n += 1
-        return n
-
-    @property
-    def last_time(self) -> int:
-        return self._last_time
-
-    def mark_tick(self) -> None:
-        self._tick_floor = self._last_time
-
-    def num_flows(self) -> int:
-        return len(self.index.slot_meta)
 
     # -- device ops --------------------------------------------------------
     def _route(self, batch) -> np.ndarray:
@@ -185,25 +165,27 @@ class ShardedFlowEngine:
         w = ft.pack_wire(batch)
         gslot = w[:, 0] & np.uint32(0x3FFFFFFF)
         real = gslot < self.capacity
-        shard = np.minimum(
-            gslot // self.local_capacity, self.n_shards - 1
-        ).astype(np.int64)
+        shard = (gslot % np.uint32(self.n_shards)).astype(np.int64)
         counts = np.bincount(shard[real], minlength=self.n_shards)
         B = bucket_size(int(counts.max()) if counts.size else 1, self.buckets)
         out = np.empty((self.n_shards, B, 6), np.uint32)
         # padding rows: local scratch slot, no flags
         out[:, :, 0] = np.uint32(self.local_capacity)
         out[:, :, 1:] = 0
+        flags = w[:, 0] & np.uint32(0xC0000000)
         for s in range(self.n_shards):
-            rows = w[real & (shard == s)]
-            rows[:, 0] -= np.uint32(s * self.local_capacity)
+            sel = real & (shard == s)
+            rows = w[sel]
+            rows[:, 0] = (gslot[sel] // np.uint32(self.n_shards)) | flags[sel]
             out[s, : rows.shape[0]] = rows
         return out
 
     def step(self) -> bool:
         applied = False
         while (batch := self.batcher.flush()) is not None:
-            self.tables = self._apply(self.tables, jnp.asarray(self._route(batch)))
+            w = self._route(batch)
+            self.wire_bytes += w.nbytes
+            self.tables = self._apply(self.tables, jnp.asarray(w))
             applied = True
         return applied
 
@@ -230,7 +212,7 @@ class ShardedFlowEngine:
                 if valid[s, j]:
                     cand.append((
                         float(score[s, j]),
-                        int(s * self.local_capacity + idx[s, j]),
+                        int(idx[s, j]) * self.n_shards + s,
                         int(lab[s, j]), bool(fa[s, j]), bool(ra[s, j]),
                     ))
         cand.sort(key=lambda c: (-c[0], c[1]))
@@ -245,19 +227,25 @@ class ShardedFlowEngine:
             slots = np.nonzero(stale)[0]
             evicted += slots.size
             clear_batches.append(slots)
-            self.index.release_slots(slots + s * local_cap)
-        E = max((b.size for b in clear_batches), default=0)
-        if E:
-            E = bucket_size(E, self.buckets)
+            (self.batcher if self.native else self.index).release_slots(
+                slots * self.n_shards + s
+            )
+        # clear in largest-bucket chunks: an idle storm can mark more
+        # slots than the biggest padded shape admits (same chunking as
+        # FlowStateEngine.evict_idle)
+        E_max = max((b.size for b in clear_batches), default=0)
+        step = self.buckets[-1]
+        for off in range(0, E_max, step):
+            chunks = [b[off : off + step] for b in clear_batches]
+            widest = max(c.size for c in chunks)
+            if not widest:
+                break
+            E = bucket_size(widest, self.buckets)
             padded = np.full((self.n_shards, E), local_cap, np.int32)
-            for s, b in enumerate(clear_batches):
-                padded[s, : b.size] = b
+            for s, c in enumerate(chunks):
+                padded[s, : c.size] = c
             self.tables = self._clear(self.tables, jnp.asarray(padded))
         return rows, evicted
 
     def slot_metadata(self, slots):
-        return {
-            int(s): self.index.slot_meta[s]
-            for s in slots
-            if s in self.index.slot_meta
-        }
+        return self._slot_meta_for(slots)
