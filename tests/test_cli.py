@@ -62,6 +62,29 @@ def test_classify_synthetic(capsys, reference_models_dir):
     assert out.count("Flow ID") == 2  # rendered twice in 4 ticks
 
 
+def test_classify_synthetic_sharded_matches_single(capsys,
+                                                   reference_models_dir):
+    """--shards N serves through the mesh-sharded flow table
+    (parallel/table_sharded.py); the rendered table must be identical to
+    the single-device serve on the same synthetic traffic."""
+    common = [
+        "Randomforest",
+        "--source", "synthetic",
+        "--synthetic-flows", "8",
+        "--checkpoint-dir", reference_models_dir,
+        "--capacity", "32",
+        "--print-every", "2",
+        "--max-ticks", "4",
+        "--table-rows", "6",
+    ]
+    cli.main(common)
+    single = capsys.readouterr().out
+    cli.main(common + ["--shards", "8"])
+    sharded = capsys.readouterr().out
+    assert "Flow ID" in sharded
+    assert sharded == single
+
+
 def test_classify_synthetic_svm(capsys, reference_models_dir):
     cli.main(
         [
@@ -186,7 +209,10 @@ def test_e2e_own_controller_fake_switch(capsys, reference_models_dir):
                 "--checkpoint-dir", reference_models_dir,
                 "--capacity", "32",
                 "--print-every", "2",
-                "--max-ticks", "4",
+                # enough ticks to cover several 0.1 s controller polls:
+                # with warm jit caches the loop consumes ticks far faster
+                # than cold, and the early ticks carry no flow stats yet
+                "--max-ticks", "30",
             ]
         )
     finally:

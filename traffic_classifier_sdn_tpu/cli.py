@@ -122,6 +122,12 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--capacity", type=int, default=None)
     p.add_argument(
+        "--shards", type=int, default=0,
+        help="partition the flow table over an N-device mesh "
+        "(parallel/table_sharded.py) — serving capacity beyond one "
+        "chip's table; requires N visible devices",
+    )
+    p.add_argument(
         "--idle-timeout",
         type=int,
         default=None,
@@ -277,7 +283,26 @@ def _run_classify(args) -> None:
     from .utils.profiling import trace
 
     use_native = _use_native(args)
-    engine = FlowStateEngine(args.capacity, native=use_native)
+    sharded = args.shards > 1
+    if sharded:
+        from .parallel import mesh as meshlib
+        from .parallel import table_sharded as tsh
+
+        if args.table_rows <= 0:
+            # the sharded render merges bounded per-shard candidates; an
+            # unbounded ("0 = all") table would be an O(capacity) fetch
+            sys.exit(
+                "--shards requires a bounded --table-rows "
+                "(the sharded render merges per-shard top-k candidates)"
+            )
+        engine = tsh.ShardedFlowEngine(
+            meshlib.make_mesh(n_data=args.shards, n_state=1),
+            args.capacity, predict_fn=serve_fn, params=serve_params,
+            table_rows=args.table_rows,
+            native=use_native,
+        )
+    else:
+        engine = FlowStateEngine(args.capacity, native=use_native)
     ticks = 0
     dropped_seen = 0
     with trace(args.profile_dir):
@@ -294,11 +319,6 @@ def _run_classify(args) -> None:
             ticks += 1
             m.inc("ticks")
             if ticks % args.print_every == 0:
-                if args.idle_timeout and engine.last_time:
-                    m.inc(
-                        "evicted",
-                        engine.evict_idle(engine.last_time, args.idle_timeout),
-                    )
                 if engine.dropped > dropped_seen:
                     print(
                         f"WARNING: flow table full — "
@@ -310,8 +330,29 @@ def _run_classify(args) -> None:
                     )
                     dropped_seen = engine.dropped
                 m.set("flows_dropped", engine.dropped)
-                with m.time("predict_s"):
-                    _print_table(engine, model, predict, serve_params, args)
+                if sharded:
+                    # the sharded tick's whole read side (per-shard
+                    # predict + render candidates + stale masks) is one
+                    # dispatch, with eviction folded in
+                    with m.time("predict_s"):
+                        rows, evicted = engine.tick_render(
+                            now=engine.last_time,
+                            idle_seconds=args.idle_timeout or (1 << 30),
+                        )
+                    m.inc("evicted", evicted)
+                    _print_ranked(engine, model, rows, engine.num_flows())
+                else:
+                    if args.idle_timeout and engine.last_time:
+                        m.inc(
+                            "evicted",
+                            engine.evict_idle(
+                                engine.last_time, args.idle_timeout
+                            ),
+                        )
+                    with m.time("predict_s"):
+                        _print_table(
+                            engine, model, predict, serve_params, args
+                        )
             if args.metrics_every and ticks % args.metrics_every == 0:
                 print(m.report(), file=sys.stderr, flush=True)
             if args.max_ticks and ticks >= args.max_ticks:
@@ -339,37 +380,50 @@ def _print_table(engine, model, predict, serve_params, args) -> None:
             model.classes.names[c] if c < len(model.classes.names) else "?"
         )
 
-    rows = []
     if limit is not None:
         # activity-ranked sample: the rendered rows track live traffic
         # (device top_k over this tick's byte deltas), most active first;
         # labels + active flags gathered device-side, O(limit) fetched
-        ranked = engine.render_sample(labels, limit)
-        sample = engine.slot_metadata(slots=[s for s, *_ in ranked])
-        for slot, c, fa, ra in ranked:
-            if slot not in sample:
-                continue
-            src, dst = sample[slot]
-            rows.append(
-                (slot, src, dst, name(c), status_str(fa), status_str(ra))
+        _print_ranked(engine, model, engine.render_sample(labels, limit),
+                      n_flows)
+        return
+    rows = []
+    idx = np.asarray(labels)
+    fwd_active = np.asarray(engine.table.fwd.active)[:-1]
+    rev_active = np.asarray(engine.table.rev.active)[:-1]
+    for slot, (src, dst) in sorted(engine.slot_metadata().items()):
+        rows.append(
+            (
+                slot,
+                src,
+                dst,
+                name(int(idx[slot])),
+                status_str(bool(fwd_active[slot])),
+                status_str(bool(rev_active[slot])),
             )
-    else:
-        idx = np.asarray(labels)
-        fwd_active = np.asarray(engine.table.fwd.active)[:-1]
-        rev_active = np.asarray(engine.table.rev.active)[:-1]
-        for slot, (src, dst) in sorted(engine.slot_metadata().items()):
-            rows.append(
-                (
-                    slot,
-                    src,
-                    dst,
-                    name(int(idx[slot])),
-                    status_str(bool(fwd_active[slot])),
-                    status_str(bool(rev_active[slot])),
-                )
-            )
+        )
     print(render_table(CLASSIFIER_FIELDS, rows), flush=True)
-    if limit is not None and n_flows > len(rows):
+
+
+def _print_ranked(engine, model, ranked, n_flows) -> None:
+    """Render activity-ranked ``(slot, label, fwd, rev)`` rows — the shared
+    table surface for the single-device and mesh-sharded serve loops."""
+    from .utils.table import CLASSIFIER_FIELDS, render_table, status_str
+
+    names = model.classes.names
+    sample = engine.slot_metadata(slots=[s for s, *_ in ranked])
+    rows = []
+    for slot, c, fa, ra in ranked:
+        if slot not in sample:
+            continue
+        src, dst = sample[slot]
+        rows.append((
+            slot, src, dst,
+            names[c] if c < len(names) else "?",
+            status_str(fa), status_str(ra),
+        ))
+    print(render_table(CLASSIFIER_FIELDS, rows), flush=True)
+    if n_flows > len(rows):
         print(f"... showing {len(rows)} of {n_flows} tracked flows",
               flush=True)
 

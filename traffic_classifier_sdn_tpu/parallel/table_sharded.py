@@ -149,10 +149,14 @@ class ShardedFlowEngine(HostSpine):
         self.tables = make_sharded_table(mesh, capacity_total)
         self._apply = make_apply(mesh)
         self._clear = make_clear(mesh)
-        # a shard's top_k cannot ask for more rows than it holds
-        self.table_rows = min(table_rows, self.local_capacity)
+        # a shard's top_k cannot ask for more rows than it holds — but a
+        # shard also cannot CONTRIBUTE more than it holds, so clamping the
+        # per-shard k keeps the global top-table_rows merge exact
+        self.table_rows = table_rows
         self._tick_outputs = (
-            make_tick_outputs(mesh, predict_fn, self.table_rows)
+            make_tick_outputs(
+                mesh, predict_fn, min(table_rows, self.local_capacity)
+            )
             if predict_fn is not None else None
         )
         self.params = params
