@@ -135,6 +135,15 @@ def test_pallas_synthetic_shapes_match_gather(n_trees, depth):
     g = pallas_forest.compile_forest(d, row_tile=256)
     got = np.asarray(pallas_forest.predict(g, Xs, interpret=True))
     np.testing.assert_array_equal(got, want_s)
+    # the explicit fuse override flips the leaf-GEMM path; parity holds
+    # either way (the safe fallback if Mosaic rejects the fused form)
+    g2 = pallas_forest.compile_forest(
+        d, row_tile=256, fuse=not g.fuse_leaf_gemm
+        if not isinstance(g, pallas_forest.ForestPallasGroups)
+        else False,
+    )
+    got2 = np.asarray(pallas_forest.predict(g2, Xs, interpret=True))
+    np.testing.assert_array_equal(got2, want_s)
 
 
 def test_bench_vectorized_oracle_matches_scalar_walker(forest_dict, X):

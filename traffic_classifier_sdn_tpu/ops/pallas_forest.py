@@ -71,13 +71,18 @@ class ForestPallasGroups(struct.PyTreeNode):
 
 
 def compile_forest(
-    d: dict, row_tile: int = 512, tree_chunk: int = 16, n_buckets: int = 1
+    d: dict, row_tile: int = 512, tree_chunk: int = 16, n_buckets: int = 1,
+    fuse: bool | None = None,
 ) -> ForestPallas | ForestPallasGroups:
+    """``fuse`` overrides the VMEM-based choice of the wide leaf GEMM
+    (None = automatic): forcing False is the safe fallback if a target's
+    Mosaic build rejects the in-kernel concat/reshape the fused path
+    uses."""
     buckets = tree_gemm.split_tree_buckets(d, n_buckets)
     groups = [
         _compile_single(
             sub, row_tile, tree_chunk,
-            n_features=nf, n_trees_total=nt,
+            n_features=nf, n_trees_total=nt, fuse=fuse,
         )
         for sub, nf, nt in buckets
     ]
@@ -91,6 +96,7 @@ def compile_forest(
 def _compile_single(
     d: dict, row_tile: int, tree_chunk: int,
     n_features: int | None = None, n_trees_total: int | None = None,
+    fuse: bool | None = None,
 ) -> ForestPallas:
     ops = tree_gemm.build_gemm_operands(
         d, n_features=n_features, n_trees_total=n_trees_total
@@ -196,7 +202,9 @@ def _compile_single(
         n_leaves=gL,
         row_tile=row_tile,
         tree_chunk=chunk_g,
-        fuse_leaf_gemm=(chunk_g * gL) <= 2048,
+        fuse_leaf_gemm=(
+            fuse if fuse is not None else (chunk_g * gL) <= 2048
+        ),
     )
 
 
