@@ -139,3 +139,53 @@ def test_sharded_eviction_matches_single_device(mesh):
     sharded.ingest(more)
     sharded.step()
     assert sharded.num_flows() == 24
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_sharded_churn_recycles_slots_without_drops(mesh, native):
+    """Sustained churn through the sharded engine: cohorts retire and new
+    ones mint every other tick; tick_render's folded eviction must recycle
+    slots across ALL shards fast enough that the global table never fills,
+    with the round-robin routing keeping every shard in play."""
+    if native:
+        from traffic_classifier_sdn_tpu.native import engine as ne
+
+        if not ne.available():
+            pytest.skip("native engine unavailable")
+    from traffic_classifier_sdn_tpu.ingest.protocol import TelemetryRecord
+
+    cap = 512
+    stable_n, churn_n = cap // 2, cap // 8
+    eng = ts.ShardedFlowEngine(
+        mesh, cap, predict_fn=_label_fn, params=None, table_rows=8,
+        native=native,
+    )
+    generation = 0
+    evicted_total = 0
+    for tick in range(1, 13):
+        if tick % 2 == 0:
+            generation += 1
+        recs = [
+            TelemetryRecord(
+                time=tick, datapath="1", in_port="1",
+                eth_src=f"st-{i:04x}", eth_dst="gw",
+                out_port="2", packets=tick * 3, bytes=tick * 100,
+            )
+            for i in range(stable_n)
+        ] + [
+            TelemetryRecord(
+                time=tick, datapath="1", in_port="1",
+                eth_src=f"ch{generation}-{i:04x}", eth_dst="gw",
+                out_port="2", packets=tick * 3, bytes=tick * 100,
+            )
+            for i in range(churn_n)
+        ]
+        eng.mark_tick()
+        eng.ingest(recs)
+        eng.step()
+        rows, evicted = eng.tick_render(now=tick, idle_seconds=2)
+        evicted_total += evicted
+        assert len(rows) == 8  # the render stays full through churn
+        assert eng.dropped == 0, f"tick {tick}: dropped flows"
+        assert eng.num_flows() <= stable_n + 2 * churn_n
+    assert evicted_total >= 4 * churn_n
