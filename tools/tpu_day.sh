@@ -1,0 +1,32 @@
+#!/bin/sh
+# Land every TPU-bound measurement in one pass (run when the chip is up):
+#   1. quick liveness probe (exits 1 fast if the worker is wedged)
+#   2. bench.py            -> docs/artifacts/bench_tpu_r03.{json,log}
+#   3. tools/tpu_proof.py  -> docs/artifacts/tpu_proof.json
+#   4. serve bench on TPU  -> docs/artifacts/serve_2m_tpu.json
+# Artifacts are only overwritten by runs that actually produced output.
+set -e
+cd "$(dirname "$0")/.."
+
+timeout 90 python -c "
+import jax, numpy as np, jax.numpy as jnp
+jax.devices()
+print(float(np.asarray(jax.jit(lambda: jnp.sum(jnp.ones((128,128))))())))
+" >/dev/null 2>&1 || { echo "TPU worker down"; exit 1; }
+echo "TPU up — running the measurement suite"
+
+python bench.py 2>&1 | tee /tmp/tpu_day_bench.log
+if grep -q '"platform": "tpu"' /tmp/tpu_day_bench.log; then
+  cp /tmp/tpu_day_bench.log docs/artifacts/bench_tpu_r03.log
+  grep '^{' /tmp/tpu_day_bench.log | tail -1 \
+    > docs/artifacts/bench_tpu_r03.json
+fi
+
+python tools/tpu_proof.py
+
+python tools/bench_serve.py --platform default --model forest --ticks 6 \
+  2>&1 | tee /tmp/tpu_day_serve.log
+grep '^{' /tmp/tpu_day_serve.log | tail -1 \
+  > docs/artifacts/serve_2m_tpu.json
+
+echo "tpu_day: all artifacts written"
