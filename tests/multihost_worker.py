@@ -102,6 +102,42 @@ def main() -> None:
     )
     np.testing.assert_array_equal(got, want_knn)
 
+    # the sharded serving table across the process boundary: identical
+    # records ingested on every host (SPMD host pattern), shards living on
+    # both processes' devices, the render merge validated against a
+    # single-device engine computed locally
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+    from traffic_classifier_sdn_tpu.ingest.protocol import TelemetryRecord
+    from traffic_classifier_sdn_tpu.core import flow_table as ftab
+    from traffic_classifier_sdn_tpu.parallel import table_sharded as tsh
+
+    def label_fn(_p, Xt):
+        return (jnp.sum(Xt, axis=1).astype(jnp.int32) % 6).astype(jnp.int32)
+
+    dmesh = meshlib.make_mesh(n_data=n_devices, n_state=1)
+    eng = tsh.ShardedFlowEngine(
+        dmesh, 8 * n_devices, predict_fn=label_fn, params=None, table_rows=5
+    )
+    recs = [
+        TelemetryRecord(
+            time=2, datapath="1", in_port=1, eth_src=f"s{i:02d}",
+            eth_dst=f"d{i:02d}", out_port=2, packets=10 + i,
+            bytes=1000 + 137 * i,
+        )
+        for i in range(3 * n_devices)
+    ]
+    eng.mark_tick()
+    eng.ingest(recs)
+    eng.step()
+    rows, evicted = eng.tick_render(now=2, idle_seconds=3600)
+    assert evicted == 0
+    single = FlowStateEngine(capacity=8 * n_devices)
+    single.mark_tick()
+    single.ingest(recs)
+    single.step()
+    labels = label_fn(None, ftab.features12(single.table))
+    assert rows == single.render_sample(labels, 5), (rows,)
+
     print(f"MULTIHOST OK pid={pid} devices={n_devices}", flush=True)
     jax.distributed.shutdown()
 

@@ -141,6 +141,29 @@ def test_sharded_eviction_matches_single_device(mesh):
     assert sharded.num_flows() == 24
 
 
+def test_tick_outputs_replicated_across_shards(mesh):
+    """make_tick_outputs declares its outputs replicated (out_specs=P())
+    with the varying-axis checker disabled — so this guard asserts the
+    replication REALLY holds: every output must be bitwise identical on
+    every addressable shard. If a future edit drops an all_gather (or a
+    predict_fn leaks a shard-varying value), out_specs=P() would silently
+    publish one device's local value; this test is the tripwire."""
+    eng = ts.ShardedFlowEngine(
+        mesh, 64, predict_fn=_label_fn, params=None, table_rows=4
+    )
+    eng.mark_tick()
+    eng.ingest(_workload(24, 2, seed=3)[0])
+    eng.step()
+    outs = eng._tick_outputs(eng.tables, None, 0, 2, 3600)
+    for k, o in enumerate(outs):
+        shards = o.addressable_shards
+        base = np.asarray(shards[0].data)
+        for sh in shards[1:]:
+            np.testing.assert_array_equal(
+                np.asarray(sh.data), base, err_msg=f"output {k} varies"
+            )
+
+
 @pytest.mark.parametrize("native", [False, True])
 def test_sharded_churn_recycles_slots_without_drops(mesh, native):
     """Sustained churn through the sharded engine: cohorts retire and new

@@ -6,10 +6,11 @@ the single-device replacement is ``core/flow_table.FlowTable``. This module
 scales that serving state across the mesh's data axis: each device owns an
 independent ``(local_capacity+1,)`` SoA shard, the host routes update
 records to shards round-robin by slot, and every device op runs under one
-``shard_map`` (no cross-device traffic in the steady state — flows are
-partitioned, not replicated; only the O(rows) render candidates and the
-bit-packed stale masks come home, where the tiny cross-shard merges happen
-on host).
+``shard_map``. Flows are partitioned, never replicated; the write path has
+zero cross-device traffic, and the read path's only collective is one
+all_gather per tick of the render candidates (O(rows)) plus the
+bit-packed stale masks (capacity/8 bytes per shard — ~1 MiB/tick fleet-
+wide at the 2²³ target), so every process can run the host-side merge.
 
 Scaling shape: capacity_total = n_shards × local_capacity, one scatter +
 one full-shard predict per shard per tick, all shards in parallel — an
@@ -41,12 +42,16 @@ def _n_shards(mesh) -> int:
 
 def make_sharded_table(mesh, capacity_total: int) -> ft.FlowTable:
     """A FlowTable pytree with leaves of shape (n_shards, local_cap+1),
-    dim 0 sharded over the mesh's data axis."""
+    dim 0 sharded over the mesh's data axis. Built from host numpy (every
+    leaf starts zeroed) so the device_put also works on a multi-host mesh
+    — each process materializes only its addressable shards."""
     n = _n_shards(mesh)
     if capacity_total % n:
         raise ValueError(f"capacity {capacity_total} not divisible by {n}")
     local = ft.make_table(capacity_total // n)
-    stacked = jax.tree.map(lambda a: jnp.broadcast_to(a, (n,) + a.shape), local)
+    stacked = jax.tree.map(
+        lambda a: np.zeros((n,) + a.shape, a.dtype), local
+    )
     return jax.device_put(
         stacked, NamedSharding(mesh, P(DATA_AXIS))
     )
@@ -87,16 +92,25 @@ def make_tick_outputs(mesh, predict_fn, n_rows: int):
             labels = predict_fn(p, ft.features12(t1))
             outs = ft.top_active_scored(t1, labels, n_rows, fl[0, 0])
             bits = ft.stale_bits(t1, nw[0, 0], idl[0, 0])
-            return tuple(o[None] for o in outs) + (bits[None],)
+            # all_gather the per-shard outputs (O(rows) candidates plus
+            # capacity/8 stale-mask bytes per shard) so every device — and
+            # on a multi-host mesh every PROCESS — holds the full
+            # candidate set; the host-side merge can then run anywhere
+            return tuple(
+                jax.lax.all_gather(o, DATA_AXIS) for o in (*outs, bits)
+            )
 
         scalar = lambda v: jnp.broadcast_to(  # noqa: E731
             jnp.int32(v), (_n_shards(mesh), 1)
         )
+        # check_vma off: the varying-axis checker cannot see that an
+        # all_gather over the only mesh axis leaves every output replicated
         return jax.shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS)),
-            out_specs=P(DATA_AXIS),
+            out_specs=P(),
+            check_vma=False,
         )(tables, params, scalar(floor), scalar(now), scalar(idle_seconds))
 
     return tick
@@ -189,7 +203,9 @@ class ShardedFlowEngine(HostSpine):
         while (batch := self.batcher.flush()) is not None:
             w = self._route(batch)
             self.wire_bytes += w.nbytes
-            self.tables = self._apply(self.tables, jnp.asarray(w))
+            # w passes as host numpy (uncommitted): identical on every
+            # process, so jit treats it as replicated — multi-host safe
+            self.tables = self._apply(self.tables, w)
             applied = True
         return applied
 
@@ -248,7 +264,7 @@ class ShardedFlowEngine(HostSpine):
             padded = np.full((self.n_shards, E), local_cap, np.int32)
             for s, c in enumerate(chunks):
                 padded[s, : c.size] = c
-            self.tables = self._clear(self.tables, jnp.asarray(padded))
+            self.tables = self._clear(self.tables, padded)
         return rows, evicted
 
     def slot_metadata(self, slots):
