@@ -619,6 +619,8 @@ def main(argv=None) -> None:
         # are sentinels where config can override)
         if args.capacity is None:
             args.capacity = cfg.ingest.capacity
+        if args.shards == 0 and cfg.ingest.shards:
+            args.shards = cfg.ingest.shards
         if args.idle_timeout is None:
             args.idle_timeout = cfg.ingest.idle_timeout_s
         if args.print_every is None:

@@ -29,7 +29,10 @@ class IngestConfig:
     """Host-shell ingest policy (ingest/batcher.py, ingest/collector.py)."""
 
     capacity: int = 65536  # flow-table rows
-    buckets: tuple = (256, 1024, 4096, 16384, 65536)  # padded batch sizes
+    # padded batch sizes (mirror ingest/batcher.DEFAULT_BUCKETS: the top
+    # bucket covers a full 2²⁰-record tick in one flush)
+    buckets: tuple = (256, 1024, 4096, 16384, 65536, 262144, 1048576)
+    shards: int = 0  # >1: mesh-shard the flow table (--shards)
     idle_timeout_s: int = 60  # flow eviction horizon (0 = never)
     poll_period_s: float = 1.0  # monitor poll cadence (reference: 1 Hz)
     monitor_cmd: str | None = None  # None → reference's ryu command
