@@ -32,8 +32,6 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
 from . import tree_gemm
 
 
