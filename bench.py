@@ -463,6 +463,26 @@ def measure(batches: list[int]) -> None:
 
             sec = _timed_loop(fam_sum, params, Xf, _loop_iters(fam_batch))
             line[f"{name}_flows_per_sec"] = round(fam_batch / sec, 1)
+            if name == "knn":
+                # race the k argmax+mask passes against lax.top_k's sort
+                # network (identical output incl. ties — parity-tested);
+                # report both, promote the faster
+                def knn_am_sum(p, X):
+                    return jnp.sum(
+                        knn_mod.predict(p, X, top_k_impl="argmax")
+                    ).astype(jnp.float32)
+
+                sec_am = _timed_loop(
+                    knn_am_sum, params, Xf, _loop_iters(fam_batch)
+                )
+                line["knn_argmax_topk_flows_per_sec"] = round(
+                    fam_batch / sec_am, 1
+                )
+                if sec_am < sec:
+                    line["knn_flows_per_sec"] = round(fam_batch / sec_am, 1)
+                    line["knn_top_k_impl"] = "argmax"
+                else:
+                    line["knn_top_k_impl"] = "sort"
         except Exception as e:  # noqa: BLE001
             line[f"{name}_error"] = f"{type(e).__name__}: {e}"[:120]
         emit()

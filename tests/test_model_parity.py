@@ -122,6 +122,36 @@ def test_knn_parity(reference_models_dir, flow_dataset, dtype, hilo):
         np.testing.assert_array_equal(got, want)
 
 
+def test_knn_argmax_topk_matches_sort_topk(reference_models_dir,
+                                           flow_dataset):
+    """The iterative argmax+mask top-k (the VPU-friendly race candidate)
+    must order indices bitwise-identically to lax.top_k — including ties,
+    where both take the lowest corpus index — and must therefore predict
+    identically on the reference checkpoint."""
+    import jax
+    from jax import lax
+
+    from traffic_classifier_sdn_tpu.models.knn import _topk_argmax_idx
+
+    # adversarial ties: few distinct values, many duplicates per row
+    rng = np.random.RandomState(3)
+    sim = jnp.asarray(
+        rng.randint(0, 7, (64, 33)).astype(np.float32)
+    )
+    _, want_idx = lax.top_k(sim, 5)
+    got_idx = _topk_argmax_idx(sim, 5)
+    np.testing.assert_array_equal(np.asarray(got_idx), np.asarray(want_idx))
+
+    d = ski.import_knn(_ref_path(reference_models_dir, "knn"))
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    Xd = jnp.asarray(flow_dataset.X[:1024], jnp.float32)
+    a = np.asarray(jax.jit(
+        lambda p, X: knn.predict(p, X, top_k_impl="argmax")
+    )(params, Xd))
+    b = np.asarray(jax.jit(knn.predict)(params, Xd))
+    np.testing.assert_array_equal(a, b)
+
+
 def _numpy_forest_predict(d, X):
     """Golden reference: sequential per-tree traversal of the extracted node
     arrays — exactly the walk sklearn's Cython Tree.predict performs."""

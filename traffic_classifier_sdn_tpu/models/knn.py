@@ -67,22 +67,50 @@ def _neighbor_sim(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
     return -jnp.sum(diff * diff, axis=-1)
 
 
-def neighbor_votes(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
-    """(N, C) neighbor counts per class from the k nearest training points."""
+def neighbor_votes(params: Params, X: jax.Array, X_lo=None,
+                   top_k_impl: str = "sort") -> jax.Array:
+    """(N, C) neighbor counts per class from the k nearest training points.
+
+    ``top_k_impl``: "sort" uses ``lax.top_k`` (a partial sort network over
+    all S corpus columns); "argmax" runs k iterative max+mask passes —
+    O(k·S) elementwise VPU work instead of the sort network, exact
+    including ties (each pass takes the FIRST maximum, i.e. the lowest
+    corpus index — the same tie order sklearn's KDTree/brute force and
+    ``lax.top_k`` produce). The bench races both on real hardware."""
     sim = _neighbor_sim(params, X, X_lo)
-    _, nbr_idx = lax.top_k(sim, params.n_neighbors)  # (N, k)
+    if top_k_impl == "argmax":
+        nbr_idx = _topk_argmax_idx(sim, params.n_neighbors)
+    else:
+        _, nbr_idx = lax.top_k(sim, params.n_neighbors)  # (N, k)
     nbr_y = params.fit_y[nbr_idx]  # (N, k)
     return jnp.sum(
         jax.nn.one_hot(nbr_y, params.n_classes, dtype=jnp.int32), axis=1
     )
 
 
+def _topk_argmax_idx(sim: jax.Array, k: int) -> jax.Array:
+    """(N, k) indices of the k largest columns, descending, ties to the
+    lowest index — k argmax+mask passes (bitwise-identical ordering to
+    ``lax.top_k``)."""
+    idxs = []
+    for _ in range(k):
+        best = jnp.argmax(sim, axis=1)  # first (lowest-index) maximum
+        idxs.append(best)
+        sim = jnp.where(
+            jax.nn.one_hot(best, sim.shape[1], dtype=bool), -jnp.inf, sim
+        )
+    return jnp.stack(idxs, axis=1)
+
+
 def scores(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
     return neighbor_votes(params, X, X_lo)
 
 
-def predict(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
-    return jnp.argmax(scores(params, X, X_lo), axis=-1).astype(jnp.int32)
+def predict(params: Params, X: jax.Array, X_lo=None,
+            top_k_impl: str = "sort") -> jax.Array:
+    return jnp.argmax(
+        neighbor_votes(params, X, X_lo, top_k_impl=top_k_impl), axis=-1
+    ).astype(jnp.int32)
 
 
 def predict_chunked(
