@@ -114,7 +114,8 @@ def predict(params: Params, X: jax.Array, X_lo=None,
 
 
 def predict_chunked(
-    params: Params, X: jax.Array, X_lo=None, row_chunk: int = 65536
+    params: Params, X: jax.Array, X_lo=None, row_chunk: int = 65536,
+    top_k_impl: str = "sort",
 ) -> jax.Array:
     """``predict`` for batches whose (N, S) similarity matrix would blow
     HBM (2²⁰ rows × the reference's 4448-row corpus ≈ 18.6 GB f32):
@@ -123,5 +124,6 @@ def predict_chunked(
     from ..ops.chunking import chunked_predict
 
     return chunked_predict(
-        lambda xc, xlo=None: predict(params, xc, xlo), row_chunk, X, X_lo
+        lambda xc, xlo=None: predict(params, xc, xlo, top_k_impl=top_k_impl),
+        row_chunk, X, X_lo,
     )
