@@ -26,7 +26,10 @@ python tools/tpu_proof.py
 
 python tools/bench_serve.py --platform default --model forest --ticks 6 \
   2>&1 | tee /tmp/tpu_day_serve.log
-grep '^{' /tmp/tpu_day_serve.log | tail -1 \
-  > docs/artifacts/serve_2m_tpu.json
+if grep '^{' /tmp/tpu_day_serve.log | tail -1 \
+    | grep -q '"platform": "tpu"'; then
+  grep '^{' /tmp/tpu_day_serve.log | tail -1 \
+    > docs/artifacts/serve_2m_tpu.json
+fi
 
 echo "tpu_day: all artifacts written"
