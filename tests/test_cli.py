@@ -85,6 +85,26 @@ def test_classify_synthetic_sharded_matches_single(capsys,
     assert sharded == single
 
 
+def test_classify_serve_state_roundtrip(tmp_path, capsys,
+                                        reference_models_dir):
+    """--save-serve-state / --restore-serve-state: a restarted classify
+    resumes with every tracked flow (warm restart, io/serving_checkpoint)."""
+    ck = str(tmp_path / "serve.npz")
+    common = [
+        "gaussiannb",
+        "--source", "synthetic",
+        "--synthetic-flows", "8",
+        "--checkpoint-dir", reference_models_dir,
+        "--capacity", "64",
+        "--print-every", "2",
+    ]
+    cli.main(common + ["--max-ticks", "3", "--save-serve-state", ck])
+    capsys.readouterr()
+    cli.main(common + ["--max-ticks", "2", "--restore-serve-state", ck])
+    out = capsys.readouterr().out
+    assert "Flow ID" in out  # the restored engine serves immediately
+
+
 def test_classify_synthetic_svm(capsys, reference_models_dir):
     cli.main(
         [

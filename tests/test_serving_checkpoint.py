@@ -1,0 +1,163 @@
+"""Serving-state warm restart (io/serving_checkpoint.py): a restored
+engine must CONTINUE bit-identically — same features, same slot
+resolution for existing flows, same delta math against the stored
+counters, same eviction clock — versus an engine that never stopped."""
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.core import flow_table as ft
+from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+from traffic_classifier_sdn_tpu.ingest.protocol import TelemetryRecord
+from traffic_classifier_sdn_tpu.io import serving_checkpoint as sc
+
+
+def _rec(time, src, dst, pkts, bts):
+    return TelemetryRecord(
+        time=time, datapath="1", in_port=1, eth_src=src, eth_dst=dst,
+        out_port=2, packets=pkts, bytes=bts,
+    )
+
+
+def _tick(eng, t, n, base=0, prefix="f"):
+    eng.mark_tick()
+    eng.ingest([
+        _rec(t, f"{prefix}{i:03d}", "gw", base + 7 * t + i,
+             base + 1000 * t + 13 * i)
+        for i in range(n)
+    ])
+    eng.step()
+
+
+def _features(eng):
+    return np.asarray(ft.features16(eng.table))
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_save_restore_continues_bitwise(tmp_path, native):
+    if native:
+        from traffic_classifier_sdn_tpu.native import engine as ne
+
+        if not ne.available():
+            pytest.skip("native engine unavailable")
+    path = str(tmp_path / "serve_state.npz")
+
+    # two engines run the same two ticks; one checkpoints + restores
+    a = FlowStateEngine(capacity=64, native=native)
+    b = FlowStateEngine(capacity=64, native=native)
+    for eng in (a, b):
+        _tick(eng, 1, 20)
+        _tick(eng, 2, 20)
+    sc.save(a, path)
+    r = sc.restore(path)
+    assert r.native == native
+    np.testing.assert_array_equal(_features(r), _features(a))
+    assert r.num_flows() == a.num_flows() == 20
+    assert r.last_time == a.last_time
+
+    # continuation: a third tick updates existing flows and adds new ones
+    # — the restored engine must match the never-stopped engine exactly
+    # (same slots, same mod-2^32 deltas vs the stored counters)
+    for eng in (r, b):
+        _tick(eng, 3, 24)
+    np.testing.assert_array_equal(_features(r), _features(b))
+    assert r.num_flows() == b.num_flows() == 24
+
+    # eviction continuity: the restored clock ages flows identically, and
+    # freed slots are reusable
+    for eng in (r, b):
+        assert eng.evict_idle(now=100, idle_seconds=50) == 24
+        _tick(eng, 101, 5, prefix="n")
+    np.testing.assert_array_equal(_features(r), _features(b))
+    assert r.num_flows() == 5
+    assert r.dropped == 0
+
+
+def test_restore_after_partial_eviction_reuses_freed_slots(tmp_path):
+    """A checkpoint taken AFTER evictions must restore the free list: new
+    flows land in freed slots (below the frontier) instead of burning
+    fresh capacity."""
+    path = str(tmp_path / "s.npz")
+    eng = FlowStateEngine(capacity=16)
+    _tick(eng, 1, 12)
+    # refresh only even-numbered flows much later; odd ones go idle
+    eng.mark_tick()
+    eng.ingest([
+        _rec(60, f"f{i:03d}", "gw", 1000 + i, 100000 + i)
+        for i in range(0, 12, 2)
+    ])
+    eng.step()
+    assert eng.evict_idle(now=60, idle_seconds=30) == 6
+    sc.save(eng, path)
+    r = sc.restore(path)
+    assert r.num_flows() == 6
+    _tick(r, 61, 6, prefix="x")  # six new flows -> must fit in freed slots
+    assert r.num_flows() == 12
+    assert r.dropped == 0
+    # capacity frontier respected: nothing past what the original used
+    in_use = np.nonzero(np.asarray(r.table.in_use)[:-1])[0]
+    assert in_use.max() < 12
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_restore_preserves_lifo_free_order(tmp_path, native):
+    """Allocation pops the END of the free stack, so a restore must keep
+    the stack VERBATIM: two eviction rounds leave a non-ascending free
+    list, and the restored engine's next assignments must land in the
+    same slots a never-stopped engine uses."""
+    if native:
+        from traffic_classifier_sdn_tpu.native import engine as ne
+
+        if not ne.available():
+            pytest.skip("native engine unavailable")
+    path = str(tmp_path / "s.npz")
+    a = FlowStateEngine(capacity=16, native=native)
+    b = FlowStateEngine(capacity=16, native=native)
+
+    def drive(eng):
+        _tick(eng, 1, 12)  # flows f000..f011 in slots 0..11
+        # round 1: keep 0-3 and 8-11 fresh; 4-7 go idle -> free [4,5,6,7]
+        eng.mark_tick()
+        eng.ingest([
+            _rec(60, f"f{i:03d}", "gw", 500 + i, 50000 + i)
+            for i in (*range(4), *range(8, 12))
+        ])
+        eng.step()
+        assert eng.evict_idle(now=60, idle_seconds=30) == 4
+        # round 2: keep only 8-11; 0-3 go idle -> free [4,5,6,7,0,1,2,3]
+        eng.mark_tick()
+        eng.ingest([
+            _rec(120, f"f{i:03d}", "gw", 900 + i, 90000 + i)
+            for i in range(8, 12)
+        ])
+        eng.step()
+        assert eng.evict_idle(now=120, idle_seconds=30) == 4
+
+    drive(a)
+    drive(b)
+    sc.save(a, path)
+    r = sc.restore(path)
+    # the next four assignments must pop the same (non-ascending) stack
+    for eng in (r, b):
+        _tick(eng, 121, 4, prefix="z")
+    np.testing.assert_array_equal(_features(r), _features(b))
+    np.testing.assert_array_equal(
+        np.asarray(r.table.in_use), np.asarray(b.table.in_use)
+    )
+    assert r.slot_metadata(slots=range(16)) == b.slot_metadata(
+        slots=range(16)
+    )
+
+
+def test_restore_rejects_wrong_format(tmp_path):
+    path = str(tmp_path / "s.npz")
+    eng = FlowStateEngine(capacity=8)
+    _tick(eng, 1, 3)
+    sc.save(eng, path)
+    import numpy as np_
+
+    z = dict(np_.load(path))
+    z["format_version"] = np_.int64(99)
+    np_.savez_compressed(path, **z)
+    with pytest.raises(ValueError, match="format"):
+        sc.restore(path)

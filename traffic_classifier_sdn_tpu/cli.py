@@ -128,6 +128,16 @@ def _build_parser() -> argparse.ArgumentParser:
         "chip's table; requires N visible devices",
     )
     p.add_argument(
+        "--save-serve-state", default=None, metavar="FILE",
+        help="on exit, checkpoint the live serving state (flow table + "
+        "index) for a warm restart (io/serving_checkpoint.py)",
+    )
+    p.add_argument(
+        "--restore-serve-state", default=None, metavar="FILE",
+        help="start from a serving-state checkpoint: every tracked flow "
+        "resumes with its counters, rates, and slot intact",
+    )
+    p.add_argument(
         "--idle-timeout",
         type=int,
         default=None,
@@ -280,11 +290,28 @@ def _run_classify(args) -> None:
     predict = jax.jit(serve_fn)
 
     from .utils.metrics import global_metrics as m
-    from .utils.profiling import trace
 
     use_native = _use_native(args)
     sharded = args.shards > 1
-    if sharded:
+    if sharded and (args.restore_serve_state or args.save_serve_state):
+        sys.exit("serving-state checkpoints are single-device (no --shards)")
+    if args.restore_serve_state:
+        from .io import serving_checkpoint as _sc
+
+        engine = _sc.restore(args.restore_serve_state)
+        if engine.table.capacity != args.capacity:
+            print(
+                f"WARNING: --capacity {args.capacity} ignored — the "
+                f"checkpoint fixes capacity at {engine.table.capacity}",
+                file=sys.stderr,
+            )
+            args.capacity = engine.table.capacity
+        print(
+            f"restored {engine.num_flows()} tracked flows from "
+            f"{args.restore_serve_state}",
+            file=sys.stderr,
+        )
+    elif sharded:
         from .parallel import mesh as meshlib
         from .parallel import table_sharded as tsh
 
@@ -303,8 +330,29 @@ def _run_classify(args) -> None:
         )
     else:
         engine = FlowStateEngine(args.capacity, native=use_native)
+    try:
+        _serve_loop(args, engine, model, predict, serve_params, m, sharded,
+                    use_native, dropped_seen=0)
+    finally:
+        # the checkpoint must survive EVERY exit, including Ctrl-C on a
+        # long-running serve — the state is consistent between ticks
+        # (save() flushes pending rows first)
+        if args.save_serve_state:
+            from .io import serving_checkpoint as _sc
+
+            _sc.save(engine, args.save_serve_state)
+            print(
+                f"saved serving state ({engine.num_flows()} tracked "
+                f"flows) to {args.save_serve_state}",
+                file=sys.stderr,
+            )
+
+
+def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
+                use_native, dropped_seen) -> None:
+    from .utils.profiling import trace
+
     ticks = 0
-    dropped_seen = 0
     with trace(args.profile_dir):
         for batch in _tick_source(
             args, raw=use_native and args.source in ("ryu", "controller")

@@ -1,0 +1,154 @@
+"""Warm-restart checkpoints for the SERVING state.
+
+The reference loses every tracked flow on restart (its ``flows`` dict is
+process memory, traffic_classifier.py:24) and its only persistence is
+model pickles. Training-state resume lives in ``io/checkpoint.py``; this
+module checkpoints the OTHER stateful half of the system — the live
+serving spine — so a restarted classifier resumes with every flow's
+counters, rates, and slot assignments intact:
+
+- the device ``FlowTable`` (every SoA leaf, fetched to host numpy),
+- the host flow index (per-slot flow keys + metadata + the
+  sequential-assignment frontier; C++ engines export fingerprints via
+  ``tc_engine_export_index``, Python engines their key dicts),
+- the tick clock and render-freshness floor.
+
+Restore rebuilds a ``FlowStateEngine`` that continues EXACTLY: existing
+flows resolve to their old slots (same keys → same fingerprint map), the
+mod-2³² delta math picks up from the stored ``*_lo`` counters, and idle
+eviction keeps its clock. Bit-identical continuation is pinned by
+``tests/test_serving_checkpoint.py``.
+
+Key-space note: the Python index keys with BLAKE2b-64
+(ingest/protocol.stable_flow_key) while the C++ engine fingerprints with
+its wyhash-style mix — a checkpoint therefore records which index wrote
+it and restores only onto the same kind (a clear error otherwise).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flow_table as ft
+
+FORMAT_VERSION = 1
+
+_TABLE_LEAVES = (
+    "time_start", "in_use",
+    *(f"fwd.{f}" for f in ft.DirState.__dataclass_fields__),
+    *(f"rev.{f}" for f in ft.DirState.__dataclass_fields__),
+)
+
+
+def _get_leaf(table: ft.FlowTable, name: str):
+    if "." in name:
+        side, field = name.split(".")
+        return getattr(getattr(table, side), field)
+    return getattr(table, name)
+
+
+def save(engine, path: str) -> None:
+    """One ``.npz`` with the full serving state. Call between ticks (all
+    pending records stepped) — pending host-side rows are not captured."""
+    engine.step()  # flush: the device table is the only counter state
+    data: dict = {
+        "format_version": FORMAT_VERSION,
+        "capacity": engine.table.capacity,
+        "native": int(engine.native),
+        "last_time": int(engine.last_time),
+        "tick_floor": int(engine._tick_floor),
+    }
+    for name in _TABLE_LEAVES:
+        data[f"table/{name}"] = np.asarray(_get_leaf(engine.table, name))
+
+    if engine.native:
+        fp, used, next_slot, free = engine.batcher.export_index()
+        slots = np.nonzero(used)[0].astype(np.int64)
+        src_b, dst_b = engine.batcher.export_meta(slots)
+        src = np.array([s.decode() for s in src_b], dtype="U64")
+        dst = np.array([s.decode() for s in dst_b], dtype="U64")
+        keys = fp[slots]
+    else:
+        idx = engine.index
+        slots = np.array(sorted(idx.slot_to_key), dtype=np.int64)
+        keys = np.array(
+            [np.uint64(idx.slot_to_key[int(s)]) for s in slots], np.uint64
+        )
+        src = np.array(
+            [idx.slot_meta[int(s)][0] for s in slots], dtype="U64"
+        )
+        dst = np.array(
+            [idx.slot_meta[int(s)][1] for s in slots], dtype="U64"
+        )
+        next_slot = idx.next_slot
+        free = np.asarray(idx.free, np.uint32)
+    data["index/slots"] = slots
+    data["index/keys"] = keys
+    data["index/src"] = src
+    data["index/dst"] = dst
+    data["index/next_slot"] = int(next_slot)
+    # the free stack VERBATIM: allocation is LIFO, so preserving its exact
+    # order is what makes post-restore slot assignment identical to a
+    # never-stopped engine
+    data["index/free"] = free
+    np.savez_compressed(path, **data)
+
+
+def restore(path: str, buckets=None):
+    """Rebuild a ``FlowStateEngine`` from ``save`` output."""
+    from ..ingest.batcher import DEFAULT_BUCKETS, FlowStateEngine
+
+    z = np.load(path)
+    if int(z["format_version"]) != FORMAT_VERSION:
+        raise ValueError(
+            f"serving checkpoint format {int(z['format_version'])} != "
+            f"{FORMAT_VERSION}"
+        )
+    native = bool(int(z["native"]))
+    eng = FlowStateEngine(
+        int(z["capacity"]), buckets=buckets or DEFAULT_BUCKETS,
+        native=native,
+    )
+
+    leaves = {
+        name: jnp.asarray(z[f"table/{name}"]) for name in _TABLE_LEAVES
+    }
+
+    def dirstate(side: str) -> ft.DirState:
+        return ft.DirState(**{
+            f: leaves[f"{side}.{f}"]
+            for f in ft.DirState.__dataclass_fields__
+        })
+
+    eng.table = ft.FlowTable(
+        time_start=leaves["time_start"],
+        in_use=leaves["in_use"],
+        fwd=dirstate("fwd"),
+        rev=dirstate("rev"),
+    )
+
+    slots = z["index/slots"]
+    keys = z["index/keys"]
+    next_slot = int(z["index/next_slot"])
+    last_time = int(z["last_time"])
+    free = z["index/free"]
+    if native:
+        eng.batcher.import_index(
+            slots, keys,
+            np.char.encode(z["index/src"]), np.char.encode(z["index/dst"]),
+            next_slot, last_time, free,
+        )
+    else:
+        idx = eng.index
+        for s, k, src, dst in zip(
+            slots, keys, z["index/src"], z["index/dst"]
+        ):
+            idx.key_to_slot[int(k)] = int(s)
+            idx.slot_to_key[int(s)] = int(k)
+            idx.slot_meta[int(s)] = (str(src), str(dst))
+        idx.free = [int(s) for s in free]
+        idx.next_slot = next_slot
+    eng._last_time = last_time
+    eng._tick_floor = int(z["tick_floor"])
+    return eng

@@ -93,6 +93,22 @@ def _load():
         lib.tc_engine_release_slots.argtypes = [
             ct.c_void_p, ct.c_void_p, ct.c_uint32,
         ]
+        lib.tc_engine_export_index.restype = ct.c_uint32
+        lib.tc_engine_export_index.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_void_p,
+        ]
+        lib.tc_engine_export_free.restype = ct.c_uint32
+        lib.tc_engine_export_free.argtypes = [ct.c_void_p, ct.c_void_p]
+        lib.tc_engine_import_slots.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_void_p, ct.c_void_p,
+            ct.c_void_p, ct.c_uint32,
+        ]
+        lib.tc_engine_import_finish.argtypes = [
+            ct.c_void_p, ct.c_uint32, ct.c_int32, ct.c_void_p, ct.c_uint32,
+        ]
+        lib.tc_engine_export_meta.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_uint32, ct.c_void_p, ct.c_void_p,
+        ]
         _lib = lib
         return lib
 
@@ -237,3 +253,45 @@ class NativeBatcher:
         (``slots`` is any uint32-convertible array)."""
         a = np.ascontiguousarray(slots, np.uint32)
         self._lib.tc_engine_release_slots(self._h, _ptr(a), a.size)
+
+    def export_index(self):
+        """Serving-checkpoint export, all bulk crossings:
+        ``(fp, used, next_slot, free)`` — per-slot fingerprints,
+        occupancy, the sequential-assignment frontier, and the free-slot
+        stack VERBATIM (LIFO order decides future assignments)."""
+        fp = np.zeros(self.capacity, np.uint64)
+        used = np.zeros(self.capacity, np.uint8)
+        next_slot = self._lib.tc_engine_export_index(
+            self._h, _ptr(fp), _ptr(used)
+        )
+        free = np.zeros(self.capacity, np.uint32)
+        n_free = self._lib.tc_engine_export_free(self._h, _ptr(free))
+        return fp, used, int(next_slot), free[:n_free].copy()
+
+    def export_meta(self, slots):
+        """(src, dst) fixed-width byte arrays for the given slots — one
+        ctypes crossing for the whole table."""
+        slots = np.ascontiguousarray(slots, np.uint32)
+        src = np.zeros(slots.size, "S64")
+        dst = np.zeros(slots.size, "S64")
+        self._lib.tc_engine_export_meta(
+            self._h, _ptr(slots), slots.size, _ptr(src), _ptr(dst)
+        )
+        return src, dst
+
+    def import_index(self, slots, fps, src, dst, next_slot: int,
+                     last_time: int, free) -> None:
+        """Rebuild a FRESH engine's index from an export (same capacity):
+        one bulk crossing for the slots, one for the finish."""
+        slots = np.ascontiguousarray(slots, np.uint32)
+        fps = np.ascontiguousarray(fps, np.uint64)
+        src = np.ascontiguousarray(src, "S64")
+        dst = np.ascontiguousarray(dst, "S64")
+        self._lib.tc_engine_import_slots(
+            self._h, _ptr(slots), _ptr(fps), _ptr(src), _ptr(dst),
+            slots.size,
+        )
+        free = np.ascontiguousarray(free, np.uint32)
+        self._lib.tc_engine_import_finish(
+            self._h, next_slot, last_time, _ptr(free), free.size
+        )

@@ -653,4 +653,72 @@ void tc_engine_release_slots(void* h, const uint32_t* slots, uint32_t n) {
   for (uint32_t i = 0; i < n; ++i) tc_engine_release_slot(h, slots[i]);
 }
 
+// --- serving-state checkpoint support --------------------------------------
+// Export the index for a warm-restart checkpoint: per-slot fingerprints +
+// occupancy (metadata strings travel via tc_engine_slot_meta). Returns
+// next_slot — the sequential-assignment frontier a restore must resume.
+uint32_t tc_engine_export_index(void* h, uint64_t* fp_out, uint8_t* used_out) {
+  Engine* e = static_cast<Engine*>(h);
+  std::memcpy(fp_out, e->slot_fp.data(),
+              static_cast<size_t>(e->capacity) * sizeof(uint64_t));
+  std::memcpy(used_out, e->slot_used.data(), e->capacity);
+  return e->next_slot;
+}
+
+// Export the free-slot stack VERBATIM (bottom to top): allocation order
+// is LIFO, so a warm restart must preserve the exact stack for the
+// restored engine's future slot assignments to match a never-stopped one.
+uint32_t tc_engine_export_free(void* h, uint32_t* out) {
+  Engine* e = static_cast<Engine*>(h);
+  std::memcpy(out, e->free_slots.data(),
+              e->free_slots.size() * sizeof(uint32_t));
+  return static_cast<uint32_t>(e->free_slots.size());
+}
+
+// Bulk import into a FRESH engine of the same capacity: slots +
+// fingerprints + fixed 64-byte src/dst cells, ONE ctypes crossing for
+// the whole table (per-slot crossings would stall a 2^20-flow restart).
+void tc_engine_import_slots(void* h, const uint32_t* slots,
+                            const uint64_t* fps, const char* src,
+                            const char* dst, uint32_t n) {
+  Engine* e = static_cast<Engine*>(h);
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t s = slots[i];
+    if (s >= e->capacity || e->slot_used[s]) continue;
+    e->slot_fp[s] = fps[i];
+    e->slot_used[s] = 1;
+    e->slot_src[s] = src + static_cast<size_t>(i) * 64;
+    e->slot_dst[s] = dst + static_cast<size_t>(i) * 64;
+    e->key_to_slot.insert(fps[i], s);
+  }
+}
+
+// Finish an import: restore the assignment frontier, the eviction clock,
+// and the free stack verbatim.
+void tc_engine_import_finish(void* h, uint32_t next_slot, int32_t last_time,
+                             const uint32_t* free_list, uint32_t n_free) {
+  Engine* e = static_cast<Engine*>(h);
+  e->next_slot = next_slot;
+  e->last_time = last_time;
+  e->free_slots.assign(free_list, free_list + n_free);
+}
+
+// Bulk metadata export: fixed 64-byte NUL-terminated cells per string —
+// the one-crossing counterpart of tc_engine_slot_meta for checkpoints.
+void tc_engine_export_meta(void* h, const uint32_t* slots, uint32_t n,
+                           char* src_out, char* dst_out) {
+  Engine* e = static_cast<Engine*>(h);
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t s = slots[i];
+    char* so = src_out + static_cast<size_t>(i) * 64;
+    char* to = dst_out + static_cast<size_t>(i) * 64;
+    if (s < e->capacity && e->slot_used[s]) {
+      std::snprintf(so, 64, "%s", e->slot_src[s].c_str());
+      std::snprintf(to, 64, "%s", e->slot_dst[s].c_str());
+    } else {
+      so[0] = to[0] = '\0';
+    }
+  }
+}
+
 }  // extern "C"
