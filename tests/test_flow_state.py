@@ -367,6 +367,35 @@ def test_top_active_slots_tracks_traffic(native):
     assert allslots[:3] == [4, 2, 5] and set(allslots) == set(range(6))
 
 
+def test_device_update_scatter_budget():
+    """TPU scatters serialize, so the table update is formulated as THREE
+    inverse-index scatters plus gathers/elementwise merges, and the
+    eviction clear as ONE boolean-mask scatter. This pins those budgets
+    at the jaxpr level — a reintroduced per-field scatter (26+ of them
+    cost ~1.5 s/tick at 2²⁰ on real hardware) fails here, not on chip."""
+    import jax
+    import jax.numpy as jnp
+    from traffic_classifier_sdn_tpu.core import flow_table as ft
+
+    def count_scatters(jaxpr) -> int:
+        n = 0
+        for eqn in jaxpr.eqns:
+            if "scatter" in eqn.primitive.name:
+                n += 1
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    n += count_scatters(sub.jaxpr)
+        return n
+
+    table = ft.make_table(64)
+    w = jnp.zeros((32, 6), jnp.uint32)
+    assert count_scatters(jax.make_jaxpr(ft.apply_wire)(table, w).jaxpr) == 3
+    slots = jnp.zeros(16, jnp.int32)
+    assert count_scatters(
+        jax.make_jaxpr(ft.clear_slots)(table, slots).jaxpr
+    ) == 1
+
+
 def test_wire_pack_unpack_round_trip():
     """pack_wire/unpack_wire must be bit-exact for every field, including
     the flag bits sharing the slot word and the float bit-casts — the
