@@ -106,6 +106,15 @@ def restore(path: str, buckets=None):
             f"{FORMAT_VERSION}"
         )
     native = bool(int(z["native"]))
+    if native:
+        from ..native import engine as native_engine
+
+        if not native_engine.available():
+            raise RuntimeError(
+                "checkpoint was written by the native (C++) index, which "
+                "is unavailable here — its fingerprints are not "
+                "compatible with the Python index's keys"
+            )
     eng = FlowStateEngine(
         int(z["capacity"]), buckets=buckets or DEFAULT_BUCKETS,
         native=native,
