@@ -1,10 +1,13 @@
 #!/bin/sh
 # Land every TPU-bound measurement in one pass (run when the chip is up):
 #   1. quick liveness probe (exits 1 fast if the worker is wedged)
-#   2. bench.py            -> docs/artifacts/bench_tpu_r03.{json,log}
-#   3. tools/tpu_proof.py  -> docs/artifacts/tpu_proof.json
-#   4. serve bench on TPU  -> docs/artifacts/serve_2m_tpu.json
+#   2. bench.py             -> docs/artifacts/bench_tpu_r04.{json,log}
+#   3. tools/tpu_proof.py   -> docs/artifacts/tpu_proof.json
+#   4. serve bench on TPU   -> docs/artifacts/serve_2m_tpu.json
+#   5. tools/bench_e2e.py   -> docs/artifacts/e2e_budget_tpu.json
 # Artifacts are only overwritten by runs that actually produced output.
+# Each step redirects to a log and checks the exit status directly —
+# piping through tee would report tee's status and mask failures.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -15,21 +18,38 @@ print(float(np.asarray(jax.jit(lambda: jnp.sum(jnp.ones((128,128))))())))
 " >/dev/null 2>&1 || { echo "TPU worker down"; exit 1; }
 echo "TPU up — running the measurement suite"
 
-python bench.py 2>&1 | tee /tmp/tpu_day_bench.log
+run_step() {
+  # run_step <log> <cmd...>: fail loudly, always show the log
+  log="$1"; shift
+  if "$@" > "$log" 2>&1; then cat "$log"; else
+    cat "$log"; echo "tpu_day: FAILED: $*"; exit 1
+  fi
+}
+
+run_step /tmp/tpu_day_bench.log python bench.py
 if grep -q '"platform": "tpu"' /tmp/tpu_day_bench.log; then
-  cp /tmp/tpu_day_bench.log docs/artifacts/bench_tpu_r03.log
+  cp /tmp/tpu_day_bench.log docs/artifacts/bench_tpu_r04.log
   grep '^{' /tmp/tpu_day_bench.log | tail -1 \
-    > docs/artifacts/bench_tpu_r03.json
+    > docs/artifacts/bench_tpu_r04.json
 fi
 
-python tools/tpu_proof.py
+run_step /tmp/tpu_day_proof.log python tools/tpu_proof.py
 
-python tools/bench_serve.py --platform default --model forest --ticks 6 \
-  2>&1 | tee /tmp/tpu_day_serve.log
+run_step /tmp/tpu_day_serve.log python tools/bench_serve.py \
+  --platform default --model forest --ticks 6
 if grep '^{' /tmp/tpu_day_serve.log | tail -1 \
     | grep -q '"platform": "tpu"'; then
   grep '^{' /tmp/tpu_day_serve.log | tail -1 \
     > docs/artifacts/serve_2m_tpu.json
+fi
+
+if [ -f tools/bench_e2e.py ]; then
+  run_step /tmp/tpu_day_e2e.log python tools/bench_e2e.py
+  if grep '^{' /tmp/tpu_day_e2e.log | tail -1 \
+      | grep -q '"platform": "tpu"'; then
+    grep '^{' /tmp/tpu_day_e2e.log | tail -1 \
+      > docs/artifacts/e2e_budget_tpu.json
+  fi
 fi
 
 echo "tpu_day: all artifacts written"

@@ -385,7 +385,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                     with m.time("predict_s"):
                         rows, evicted = engine.tick_render(
                             now=engine.last_time,
-                            idle_seconds=args.idle_timeout or (1 << 30),
+                            idle_seconds=args.idle_timeout or None,
                         )
                     m.inc("evicted", evicted)
                     _print_ranked(engine, model, rows, engine.num_flows())

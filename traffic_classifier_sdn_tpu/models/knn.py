@@ -90,8 +90,16 @@ def neighbor_votes(params: Params, X: jax.Array, X_lo=None,
 
 def _topk_argmax_idx(sim: jax.Array, k: int) -> jax.Array:
     """(N, k) indices of the k largest columns, descending, ties to the
-    lowest index — k argmax+mask passes (bitwise-identical ordering to
-    ``lax.top_k``)."""
+    lowest index — k argmax+mask passes.
+
+    Precondition: every entry of ``sim`` is FINITE (true for
+    ``-sum(diff**2)`` over finite features, which is the only producer).
+    Under that precondition the ordering is bitwise-identical to
+    ``lax.top_k``. If a row held fewer than k finite entries the -inf
+    mask would make later passes return duplicate index 0 where
+    ``lax.top_k`` returns distinct indices — unreachable here; parity
+    is asserted by tests/test_model_parity.py
+    (test_knn_argmax_topk_matches_sort_topk)."""
     idxs = []
     for _ in range(k):
         best = jnp.argmax(sim, axis=1)  # first (lowest-index) maximum

@@ -687,8 +687,12 @@ void tc_engine_import_slots(void* h, const uint32_t* slots,
     if (s >= e->capacity || e->slot_used[s]) continue;
     e->slot_fp[s] = fps[i];
     e->slot_used[s] = 1;
-    e->slot_src[s] = src + static_cast<size_t>(i) * 64;
-    e->slot_dst[s] = dst + static_cast<size_t>(i) * 64;
+    // Cells are fixed 64-byte numpy 'S64' fields with NO guaranteed NUL
+    // terminator when the string fills the cell — bound the read.
+    const char* sp = src + static_cast<size_t>(i) * 64;
+    const char* dp = dst + static_cast<size_t>(i) * 64;
+    e->slot_src[s].assign(sp, strnlen(sp, 64));
+    e->slot_dst[s].assign(dp, strnlen(dp, 64));
     e->key_to_slot.insert(fps[i], s);
   }
 }

@@ -209,19 +209,28 @@ class ShardedFlowEngine(HostSpine):
             applied = True
         return applied
 
-    def tick_render(self, now: int, idle_seconds: int):
+    def tick_render(self, now: int, idle_seconds: int | None):
         """One fused read-side dispatch for the whole mesh: returns
         ``(rows, evicted)`` where rows are the global top table_rows
         ``(global_slot, label, fwd_active, rev_active)`` merged across
         shards by activity score, and evicted is the count of idle flows
-        released everywhere."""
+        released everywhere.
+
+        ``idle_seconds=None`` disables eviction: the device call still
+        runs (same compiled shape, with a 2^30 s horizon — note the
+        device may still mark long-idle/empty slots stale when ``now``
+        is epoch seconds), but the host discards the stale bits: the
+        unpack / release / clear loop is skipped entirely and evicted
+        is 0. Do not act on ``bits`` when ``evict`` is False."""
         if self._tick_outputs is None:
             raise ValueError("engine built without a predict_fn")
+        evict = idle_seconds is not None
         self.step()
         idx, valid, score, lab, fa, ra, bits = (
             np.asarray(o)
             for o in self._tick_outputs(
-                self.tables, self.params, self._tick_floor, now, idle_seconds
+                self.tables, self.params, self._tick_floor, now,
+                idle_seconds if evict else (1 << 30),
             )
         )
         # global render merge: best table_rows of n_shards×table_rows
@@ -240,6 +249,8 @@ class ShardedFlowEngine(HostSpine):
 
         # eviction: unpack each shard's bits, release + clear
         evicted = 0
+        if not evict:
+            return rows, evicted
         local_cap = self.local_capacity
         clear_batches = []
         for s in range(self.n_shards):
