@@ -152,6 +152,38 @@ def test_knn_argmax_topk_matches_sort_topk(reference_models_dir,
     np.testing.assert_array_equal(a, b)
 
 
+def test_knn_hier_topk_matches_sort_topk(reference_models_dir,
+                                         flow_dataset):
+    """The hierarchical (grouped) top-k must order indices
+    bitwise-identically to one lax.top_k over the full row — including
+    ties (contiguous groups + per-group ascending-index tie order keep
+    equal values in ascending global-index position order at the merge)
+    — across group sizes that exercise exact-fit, padding, and
+    single-group degenerate shapes."""
+    import jax
+    from jax import lax
+
+    from traffic_classifier_sdn_tpu.models.knn import _topk_hier_idx
+
+    rng = np.random.RandomState(4)
+    sim = jnp.asarray(rng.randint(0, 7, (64, 333)).astype(np.float32))
+    _, want_idx = lax.top_k(sim, 5)
+    for group in (8, 111, 333, 512):
+        got_idx = _topk_hier_idx(sim, 5, group=group)
+        np.testing.assert_array_equal(
+            np.asarray(got_idx), np.asarray(want_idx), err_msg=f"{group=}"
+        )
+
+    d = ski.import_knn(_ref_path(reference_models_dir, "knn"))
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    Xd = jnp.asarray(flow_dataset.X[:1024], jnp.float32)
+    a = np.asarray(jax.jit(
+        lambda p, X: knn.predict(p, X, top_k_impl="hier")
+    )(params, Xd))
+    b = np.asarray(jax.jit(knn.predict)(params, Xd))
+    np.testing.assert_array_equal(a, b)
+
+
 def _numpy_forest_predict(d, X):
     """Golden reference: sequential per-tree traversal of the extracted node
     arrays — exactly the walk sklearn's Cython Tree.predict performs."""

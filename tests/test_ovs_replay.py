@@ -1,0 +1,294 @@
+"""OpenFlow 1.3 interop beyond our own fake switch (VERDICT r3 item 7).
+
+No Open vSwitch binary exists in this image, so a live OVS smoke test is
+impossible; this is the capture-replay equivalent: a scripted peer speaks
+HAND-ASSEMBLED golden bytes to the real asyncio controller over TCP —
+every message packed field-by-field from the OpenFlow 1.3.5 wire layouts
+with explicit struct formats and offsets, never via
+``controller/openflow.py``'s encoder — so an encode/decode bug that is
+symmetric in our codec (the failure mode a fake-switch test cannot see)
+breaks these tests.
+
+The byte streams replicate what a real OVS 2.x emits, including its
+quirks our fake switch does not exercise:
+  - OFPT_HELLO carrying an OFPHET_VERSIONBITMAP element (length 16, not
+    a bare 8-byte header),
+  - OFPT_FEATURES_REPLY with n_buffers=0 (modern OVS disables packet
+    buffering) and capabilities 0x4f,
+  - OFPT_ECHO_REQUEST with a payload that must be echoed verbatim,
+  - OFPT_PACKET_IN with reason=OFPR_ACTION, a 16-byte OXM match
+    (in_port + 4 pad) and the 2 alignment bytes before the frame,
+  - OFPMP_FLOW reply whose entries carry nonzero duration/idle/flags
+    fields, a priority-0 table-miss entry (empty match, CONTROLLER
+    output) the monitor must filter out, and priority-1 entries with
+    (in_port, eth_src, eth_dst) OXM matches and APPLY_ACTIONS/OUTPUT
+    instructions.
+
+Assertions run in both directions: the controller's replies are parsed
+with the same hand-written framing (not our MessageReader), and the
+monitor's TSV telemetry must carry exactly the golden counters.
+
+Reference behavior being interoperated with: ``sudo ryu run
+simple_monitor_13.py`` against a live OVS bridge
+(/root/reference/README.md:26-35, simple_monitor_13.py:43-47).
+"""
+
+import asyncio
+import io
+import struct
+
+from traffic_classifier_sdn_tpu.controller.switch import Controller
+from traffic_classifier_sdn_tpu.ingest.protocol import parse_line
+
+# -- hand framing (deliberately NOT of.MessageReader) -----------------------
+
+HDR = struct.Struct("!BBHI")  # version, type, length, xid
+
+
+async def read_msg(reader):
+    hdr = await asyncio.wait_for(reader.readexactly(8), timeout=5.0)
+    version, mtype, length, xid = HDR.unpack(hdr)
+    assert version == 0x04, f"controller sent version {version}"
+    body = await asyncio.wait_for(
+        reader.readexactly(length - 8), timeout=5.0
+    )
+    return mtype, xid, body
+
+
+def msg(mtype: int, xid: int, body: bytes = b"") -> bytes:
+    return HDR.pack(0x04, mtype, 8 + len(body), xid) + body
+
+
+# -- golden OVS-style messages, packed field by field -----------------------
+
+DPID = 0x0000_1122_3344_5566
+
+
+def ovs_hello(xid: int) -> bytes:
+    # OFPHET_VERSIONBITMAP element: type=1 len=8, bitmap bit 4 (=0x10)
+    elem = struct.pack("!HH", 1, 8) + struct.pack("!I", 0x10)
+    return msg(0, xid, elem)  # OFPT_HELLO
+
+
+def ovs_features_reply(xid: int) -> bytes:
+    # datapath_id(8) n_buffers(4) n_tables(1) auxiliary_id(1) pad(2)
+    # capabilities(4) reserved(4); OVS: n_buffers=0, n_tables=254
+    body = struct.pack("!QIBB2xII", DPID, 0, 254, 0, 0x0000004F, 0)
+    return msg(6, xid, body)  # OFPT_FEATURES_REPLY
+
+
+def oxm_in_port(port: int) -> bytes:
+    # class 0x8000, field 0 (IN_PORT), no mask, len 4
+    return struct.pack("!I", 0x8000_0004) + struct.pack("!I", port)
+
+
+def oxm_eth(field: int, mac: bytes) -> bytes:
+    # field 3 = ETH_DST, 4 = ETH_SRC; header class<<16|field<<9|len
+    return struct.pack("!I", (0x8000 << 16) | (field << 9) | 6) + mac
+
+
+def match_in_port(port: int) -> bytes:
+    # ofp_match: type=1 (OXM), length=4+8=12, then pad to 16
+    return struct.pack("!HH", 1, 12) + oxm_in_port(port) + b"\x00" * 4
+
+
+def match_learned(port: int, src: bytes, dst: bytes) -> bytes:
+    # in_port(8) + eth_dst(10) + eth_src(10) OXMs: length 4+28=32,
+    # already 8-aligned -> no pad
+    fields = oxm_in_port(port) + oxm_eth(3, dst) + oxm_eth(4, src)
+    return struct.pack("!HH", 1, 4 + len(fields)) + fields
+
+
+def ovs_packet_in(xid: int, in_port: int, frame: bytes) -> bytes:
+    # buffer_id(4) total_len(2) reason(1)=OFPR_ACTION table_id(1)
+    # cookie(8), match, 2 pad bytes, frame
+    head = struct.pack("!IHBBQ", 0xFFFFFFFF, len(frame), 1, 0, 0)
+    return msg(10, xid, head + match_in_port(in_port) + b"\x00\x00" + frame)
+
+
+def flow_entry(priority: int, match: bytes, instructions: bytes,
+               packets: int, byts: int) -> bytes:
+    # ofp_flow_stats: length(2) table_id(1) pad(1) duration_sec(4)
+    # duration_nsec(4) priority(2) idle(2) hard(2) flags(2) pad(4)
+    # cookie(8) packet_count(8) byte_count(8)
+    length = 48 + len(match) + len(instructions)
+    head = struct.pack(
+        "!HBxIIHHHH4xQQQ",
+        length, 0, 1234, 567000000, priority, 0, 0, 0x0001,
+        0xDEADBEEF, packets, byts,
+    )
+    return head + match + instructions
+
+
+def instr_output(port: int, max_len: int = 0xFFFF) -> bytes:
+    # OFPIT_APPLY_ACTIONS(4) len 24, pad(4); OFPAT_OUTPUT(0) len 16,
+    # port(4) max_len(2) pad(6)
+    action = struct.pack("!HHIH6x", 0, 16, port, max_len)
+    return struct.pack("!HH4x", 4, 8 + len(action)) + action
+
+
+HOST_A = bytes.fromhex("0a0000000001")
+HOST_B = bytes.fromhex("0a0000000002")
+
+
+def ovs_flow_stats_reply(xid: int) -> bytes:
+    # type(2)=OFPMP_FLOW flags(2)=0 pad(4), then entries: the priority-0
+    # table-miss first (OVS dump order), then two learned flows
+    miss_match = struct.pack("!HH", 1, 4) + b"\x00" * 4
+    entries = (
+        flow_entry(0, miss_match, instr_output(0xFFFFFFFD), 99, 9999)
+        + flow_entry(
+            1, match_learned(1, HOST_A, HOST_B), instr_output(2), 10, 1000
+        )
+        + flow_entry(
+            1, match_learned(2, HOST_B, HOST_A), instr_output(1), 20, 2000
+        )
+    )
+    return msg(19, xid, struct.pack("!HH4x", 1, 0) + entries)
+
+
+def eth(dst: bytes, src: bytes, payload: bytes = b"x" * 46) -> bytes:
+    return dst + src + struct.pack("!H", 0x0800) + payload
+
+
+# -- the scripted session ---------------------------------------------------
+
+
+async def _scripted_session():
+    out = io.StringIO()
+    ctl = Controller(host="127.0.0.1", port=0, poll_interval=0.05, out=out)
+    await ctl.start()
+    reader, writer = await asyncio.open_connection(
+        "127.0.0.1", ctl.bound_port
+    )
+    seen: dict = {
+        "flow_mods": [], "packet_outs": [], "echo": None, "hello": False
+    }
+    try:
+        writer.write(ovs_hello(0x2A))
+        await writer.drain()
+
+        # controller greets with HELLO + FEATURES_REQUEST
+        deadline = asyncio.get_event_loop().time() + 5.0
+        features_xid = None
+        while features_xid is None:
+            mtype, xid, body = await read_msg(reader)
+            if mtype == 0:
+                seen["hello"] = True
+            elif mtype == 5:
+                features_xid = xid
+        writer.write(ovs_features_reply(features_xid))
+        # a keepalive echo with payload, mid-handshake
+        writer.write(msg(2, 0x77, b"ovs-echo"))
+        await writer.drain()
+
+        # expect: echo reply (verbatim payload) + table-miss flow-mod;
+        # then the 0.05 s poller starts asking for stats
+        got_miss = False
+        stats_xid = None
+        while not (got_miss and seen["echo"] and stats_xid):
+            mtype, xid, body = await read_msg(reader)
+            if mtype == 3:
+                seen["echo"] = body
+            elif mtype == 14:
+                seen["flow_mods"].append(body)
+                prio = struct.unpack_from("!H", body, 22)[0]
+                if prio == 0:
+                    got_miss = True
+            elif mtype == 18:
+                if struct.unpack_from("!H", body, 0)[0] == 1:  # OFPMP_FLOW
+                    stats_xid = xid
+
+        # packet-in A->B (dst unknown: flood, no flow-mod), then B->A
+        # (dst known: priority-1 flow-mod + packet-out)
+        writer.write(ovs_packet_in(0x100, 1, eth(HOST_B, HOST_A)))
+        writer.write(ovs_packet_in(0x101, 2, eth(HOST_A, HOST_B)))
+        # answer the poller with the golden stats so the monitor renders
+        writer.write(ovs_flow_stats_reply(stats_xid))
+        await writer.drain()
+
+        n_flow_mods = len(seen["flow_mods"])
+        end = asyncio.get_event_loop().time() + 3.0
+        while asyncio.get_event_loop().time() < end:
+            try:
+                mtype, xid, body = await asyncio.wait_for(
+                    read_msg(reader), timeout=0.3
+                )
+            except asyncio.TimeoutError:
+                if (
+                    len(seen["packet_outs"]) >= 2
+                    and len(seen["flow_mods"]) > n_flow_mods
+                    and "data\t" in out.getvalue()
+                ):
+                    break
+                continue
+            if mtype == 13:
+                seen["packet_outs"].append(body)
+            elif mtype == 14:
+                seen["flow_mods"].append(body)
+            elif mtype == 18:
+                if struct.unpack_from("!H", body, 0)[0] == 1:
+                    writer.write(ovs_flow_stats_reply(xid))
+                    await writer.drain()
+    finally:
+        writer.close()
+        registered = dict(ctl.datapaths)
+        await ctl.stop()
+    return seen, registered, out.getvalue()
+
+
+def _session():
+    return asyncio.run(_scripted_session())
+
+
+def test_ovs_style_handshake_and_learning():
+    seen, registered, telemetry = _session()
+    assert seen["hello"], "controller never sent HELLO"
+    assert seen["echo"] == b"ovs-echo", "echo payload not returned verbatim"
+    assert DPID in registered, "datapath with OVS-style features not registered"
+
+    # table-miss flow-mod: priority 0, CONTROLLER output, decoded by hand
+    miss = [
+        b for b in seen["flow_mods"]
+        if struct.unpack_from("!H", b, 22)[0] == 0
+    ]
+    assert miss, "no table-miss flow-mod installed"
+    assert struct.pack("!I", 0xFFFFFFFD) in miss[0]  # OFPP_CONTROLLER
+
+    # learned flow-mod for B->A (in_port=2, dst=HOST_A known): priority 1,
+    # output port 1
+    learned = [
+        b for b in seen["flow_mods"]
+        if struct.unpack_from("!H", b, 22)[0] == 1
+    ]
+    assert learned, "no priority-1 flow-mod after packet-in with known dst"
+    body = learned[0]
+    assert oxm_eth(3, HOST_A) in body, "learned match lacks eth_dst OXM"
+    assert oxm_eth(4, HOST_B) in body, "learned match lacks eth_src OXM"
+    # the OUTPUT action targets port 1 (where HOST_A was learned)
+    assert struct.pack("!HHIH", 0, 16, 1, 0xFFFF) in body
+
+    # both packet-ins were answered with packet-outs carrying the frame
+    assert len(seen["packet_outs"]) >= 2
+    assert any(eth(HOST_B, HOST_A) in b for b in seen["packet_outs"])
+
+
+def test_ovs_style_stats_render_telemetry():
+    _seen, _registered, telemetry = _session()
+    rows = [
+        parse_line((ln + "\n").encode())
+        for ln in telemetry.splitlines()
+        if ln.startswith("data\t")
+    ]
+    rows = [r for r in rows if r is not None]
+    assert rows, f"no parseable telemetry rows in:\n{telemetry}"
+    # the priority-0 table-miss entry (packets=99) must be filtered out
+    assert all(r.packets != 99 for r in rows)
+    # golden counters from the hand-packed multipart reply, sorted by
+    # (in_port, eth_dst) exactly like simple_monitor_13.py:53-56
+    a_to_b = [r for r in rows if r.packets == 10]
+    b_to_a = [r for r in rows if r.packets == 20]
+    assert a_to_b and a_to_b[0].bytes == 1000
+    assert b_to_a and b_to_a[0].bytes == 2000
+    first_pair = (rows[0].packets, rows[1].packets)
+    assert first_pair == (10, 20), f"sort order wrong: {first_pair}"

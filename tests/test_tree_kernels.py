@@ -79,6 +79,34 @@ def test_gemm_bucketed_row_chunking(forest_dict, X, want):
     np.testing.assert_array_equal(np.asarray(tree_gemm.predict(gb, X)), want)
 
 
+@pytest.mark.parametrize("stage3", ["dot", "gather"])
+@pytest.mark.parametrize("n_buckets", [1, 8])
+def test_gemm_v2_matches_gather(forest_dict, X, want, stage3, n_buckets):
+    """The traffic-lean v2 layout (transposed operands, int8 stage-2,
+    raced stage-3 variants) must predict the same argmax as the gather
+    traversal for every bucketing and stage-3 choice."""
+    g = tree_gemm.compile_forest_v2(
+        forest_dict, n_buckets=n_buckets, stage3=stage3
+    )
+    got = np.asarray(tree_gemm.predict_v2(g, X))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gemm_v2_row_chunking_and_probs(forest_dict, X, want):
+    """Row-chunked v2 agrees, and its probabilities match v1 closely
+    (identical selections; only f32 group/tree summation order differs)."""
+    g2 = tree_gemm.compile_forest_v2(forest_dict, row_chunk=256)
+    np.testing.assert_array_equal(np.asarray(tree_gemm.predict_v2(g2, X)), want)
+    g1 = tree_gemm.compile_forest(forest_dict)
+    p1 = np.asarray(tree_gemm.forest_proba_gemm(g1, X))
+    p2 = np.asarray(
+        tree_gemm.forest_proba_gemm_v2(
+            tree_gemm.compile_forest_v2(forest_dict), X
+        )
+    )
+    np.testing.assert_allclose(p2, p1, rtol=1e-5, atol=1e-7)
+
+
 def test_pallas_bucketed_interpret_matches(forest_dict, X, want):
     """Bucketed Pallas compilation (per-bucket VMEM padding) must agree
     with the gather traversal in interpreter mode."""

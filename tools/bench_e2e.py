@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""End-to-end latency budget for one 16k classification slice — the
+artifact VERDICT r3 item 8 asked for: decompose the per-batch p50 into
+device compute / transfers / control-path round trip / host spine, so
+the BASELINE.md north star ("<1 ms p50") can be restated with an explicit
+boundary of what is and is not under 1 ms on this rig.
+
+Why the decomposition matters: round 3 measured e2e_p50_batch_ms = 62.9
+at a 4k batch vs 0.18 ms device compute — a ~350x gap. This rig reaches
+its TPU through an axon tunnel (~12 MB/s payload, 7-15 ms control RTT
+spikes), so the naive e2e number mostly measures the tunnel, not the
+framework. A production deployment is co-located (PCIe/ICI: >10 GB/s,
+<100 us dispatch), so the honest claim splits into:
+  - device compute per 16k slice          (what the TPU design owns)
+  - payload bytes moved per slice          (what co-located PCIe would pay)
+  - control round trip                     (tunnel tax on this rig)
+  - host spine: parse+route+pack per slice (CPU work any deployment pays)
+
+Methodology per stage (tunnel-safe, see bench.py for the rationale):
+  rtt      — empty-kernel dispatch + scalar fetch, median of 15
+  device   — K dependent predicts in one jitted fori_loop, minus rtt, / K
+  h2d      — device_put of the (16384, 12) f32 slice + sync, minus rtt
+  d2h      — fetch of the (16384,) int32 labels, minus rtt
+  e2e      — full numpy -> device -> predict -> numpy cycle, median of 15
+  host     — C++ ingest of one 16k-record tick (parse + route + pack)
+
+Prints ONE JSON line; tools/tpu_day.sh lands it as
+docs/artifacts/e2e_budget_tpu.json when platform == "tpu".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+SLICE = 16384
+FEATURES = 12
+REPEATS = 15
+
+
+def _sync_scalar(x) -> float:
+    import numpy as np
+
+    return float(np.asarray(x))
+
+
+def _median_time(fn, repeats: int = REPEATS) -> float:
+    import numpy as np
+
+    fn()  # warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main() -> None:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+    from traffic_classifier_sdn_tpu.ops import tree_gemm
+
+    platform = jax.devices()[0].platform
+    print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
+
+    models_dir = os.environ.get("TCSDN_MODELS_DIR", "/root/reference/models")
+    g = tree_gemm.compile_forest(
+        ski.import_forest(f"{models_dir}/RandomForestClassifier")
+    )
+    predict = jax.jit(tree_gemm.predict)
+
+    rng = np.random.RandomState(0)
+    X_np = np.abs(rng.gamma(1.5, 200.0, (SLICE, FEATURES))).astype(np.float32)
+
+    # --- control-path round trip (empty kernel) --------------------------
+    trivial = jax.jit(lambda a: jnp.sum(a) * 0.0)
+    small = jnp.ones((8,), jnp.float32)
+    rtt = _median_time(lambda: _sync_scalar(trivial(small)))
+
+    # --- device compute: K dependent predicts in one jit, minus rtt -----
+    from jax import lax
+
+    K = 32
+
+    @jax.jit
+    def loop(g, X):
+        def body(i, acc):
+            Xi = X.at[0, 0].set(acc * 1e-9 + jnp.float32(i))
+            return acc + jnp.sum(tree_gemm.predict(g, Xi)).astype(jnp.float32)
+
+        return lax.fori_loop(0, K, body, jnp.float32(0.0))
+
+    Xd = jnp.asarray(X_np)
+    device_s = max(
+        _median_time(lambda: _sync_scalar(loop(g, Xd)), repeats=7) - rtt,
+        1e-12,
+    ) / K
+
+    # --- h2d: move the slice payload (16384x12 f32 = 786 kB) -------------
+    # jnp.asarray + a sum fetch forces the bytes across; subtract rtt to
+    # isolate payload time. (block_until_ready lies on the tunnel.)
+    h2d_bytes = X_np.nbytes
+
+    def h2d():
+        _sync_scalar(jnp.sum(jnp.asarray(X_np)))
+
+    h2d_s = max(_median_time(h2d) - rtt, 1e-12)
+
+    # --- d2h: fetch the (16384,) int32 labels (64 kB) --------------------
+    # jax.Array caches its numpy value after the first np.asarray, so a
+    # repeated fetch of ONE array times a host cache read (~0), not the
+    # transfer. Instead: one distinct device array per repetition, each
+    # synced device-side via an independent scalar reduction (which does
+    # NOT populate the source array's host cache), fetched exactly once.
+    labels_dev = predict(g, Xd)
+    labels_np = np.asarray(labels_dev)
+    d2h_bytes = int(labels_np.nbytes)
+    arrs = [jax.device_put(labels_np) for _ in range(REPEATS + 1)]
+    for a in arrs:
+        _sync_scalar(jnp.sum(a))  # transfer + compute done; host cache cold
+    np.asarray(arrs[0])  # warm the fetch path once
+    d2h_times = []
+    for a in arrs[1:]:
+        t0 = time.perf_counter()
+        np.asarray(a)
+        d2h_times.append(time.perf_counter() - t0)
+    d2h_s = max(float(np.median(d2h_times)) - rtt, 1e-12)
+
+    # --- full e2e cycle: numpy in -> labels in numpy out -----------------
+    def e2e():
+        np.asarray(predict(g, jnp.asarray(X_np)))
+
+    e2e_s = _median_time(e2e)
+
+    # --- host spine: parse + route + pack one 16k-record tick ------------
+    # The CPU work any deployment pays per slice before the device sees
+    # it. Uses the C++ ingest engine when built (the serving default).
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
+
+    native = native_engine.available()
+    eng = FlowStateEngine(capacity=1 << 15, native=native)
+    payload = SyntheticFlows(n_flows=SLICE // 2, seed=0).tick_bytes()
+    host_times = []
+    for _ in range(5):
+        eng.mark_tick()
+        t0 = time.perf_counter()
+        eng.ingest_bytes(payload)
+        host_times.append(time.perf_counter() - t0)
+    host_s = float(np.median(host_times))
+
+    # --- the budget, restated --------------------------------------------
+    # Co-located projection: same payload over PCIe gen3 x16 (~12 GB/s
+    # effective) + ~50 us dispatch, instead of this rig's tunnel.
+    pcie_bps = 12e9
+    colocated_ms = (
+        device_s + (h2d_bytes + d2h_bytes) / pcie_bps + 100e-6 + host_s
+    ) * 1e3
+
+    line = {
+        "metric": "e2e_latency_budget_16k_slice",
+        "value": round(e2e_s * 1e3, 3),
+        "unit": "ms",
+        "platform": platform,
+        "slice_rows": SLICE,
+        "model": "random_forest_100x6class",
+        "budget_p50_ms": {
+            "device_compute": round(device_s * 1e3, 3),
+            "h2d_payload": round(h2d_s * 1e3, 3),
+            "d2h_payload": round(d2h_s * 1e3, 3),
+            "control_rtt": round(rtt * 1e3, 3),
+            "host_spine_ingest": round(host_s * 1e3, 3),
+            "e2e_measured": round(e2e_s * 1e3, 3),
+        },
+        "payload_bytes": {"h2d": int(h2d_bytes), "d2h": int(d2h_bytes)},
+        "h2d_mb_per_sec": round(h2d_bytes / h2d_s / 1e6, 1),
+        "residual_ms": round(
+            (e2e_s - device_s - h2d_s - d2h_s - rtt) * 1e3, 3
+        ),
+        "colocated_projection_ms": round(colocated_ms, 3),
+        "north_star_boundary": (
+            f"device compute per 16k slice measured "
+            f"{device_s * 1e3:.3f} ms on platform={platform}; the gap to "
+            f"e2e_measured is control RTT + payload transfer (on this "
+            f"rig, tunnel tax — not framework cost); a co-located "
+            f"deployment pays device + PCIe + host spine = "
+            f"~{colocated_ms:.2f} ms per 16k slice"
+        ),
+        "native_ingest": native,
+    }
+    print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

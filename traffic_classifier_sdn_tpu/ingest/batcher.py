@@ -135,6 +135,15 @@ class Batcher:
             for e in self._pending.values()
         )
 
+    def last_flush_was_conflict(self) -> bool:
+        """Always False: one drain of this batcher's pending dict holds at
+        most one create and one update row per (slot, direction), however
+        many bucket-capped batches it spans — so consecutive flushes
+        within a drain are always safe to coalesce into one scatter.
+        (The native engine's generations CAN conflict; its override
+        returns the real flag — see NativeBatcher.)"""
+        return False
+
     def flush(self) -> ft.UpdateBatch | None:
         """Materialize up to one largest-bucket batch and clear what it
         consumed; None when empty. Rows beyond the largest bucket stay

@@ -77,6 +77,8 @@ def _load():
         lib.tc_engine_pending.argtypes = [ct.c_void_p]
         lib.tc_engine_flush.restype = ct.c_uint32
         lib.tc_engine_flush.argtypes = [ct.c_void_p] + [ct.c_void_p] * 8
+        lib.tc_engine_last_flush_conflict.restype = ct.c_int
+        lib.tc_engine_last_flush_conflict.argtypes = [ct.c_void_p]
         lib.tc_engine_dropped.restype = ct.c_uint64
         lib.tc_engine_dropped.argtypes = [ct.c_void_p]
         lib.tc_engine_parsed.restype = ct.c_uint64
@@ -179,6 +181,14 @@ class NativeBatcher:
         return int(self._lib.tc_engine_pending(self._h))
 
     # -- flush -------------------------------------------------------------
+    def last_flush_was_conflict(self) -> bool:
+        """True iff the batch returned by the most recent ``flush()`` was
+        a generation started by a same-(slot, direction, kind) conflict —
+        it must not be coalesced into the same device scatter as the
+        batch flushed before it. Size-rollover generations return False
+        (see flow_engine.cpp push_row)."""
+        return bool(self._lib.tc_engine_last_flush_conflict(self._h))
+
     def flush(self) -> ft.UpdateBatch | None:
         """Pop the oldest pending generation as a padded UpdateBatch
         (None when idle) — same contract as batcher.Batcher.flush."""

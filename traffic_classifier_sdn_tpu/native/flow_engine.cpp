@@ -35,8 +35,10 @@
 //     the existing flow
 //   - per (slot, direction) a batch generation holds at most one create
 //     row and one update row; a second same-direction update starts a new
-//     generation, so flushing generations in order reproduces the
-//     reference's sequential per-line semantics exactly
+//     generation (conflict_start=true), so flushing generations in order
+//     reproduces the reference's sequential per-line semantics exactly.
+//     Uniqueness is enforced per RUN (all generations between conflicts /
+//     drains), so consumers may concatenate a whole run into one scatter
 //   - table-full records are dropped and counted
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
@@ -219,11 +221,19 @@ struct Row {
 
 // One flush unit. The per-(slot,dir) occupancy that enforces the
 // one-create-plus-one-update-per-direction limit lives in the Engine as
-// an epoch-stamped flat array (occ_epoch/occ_bits) — only the *newest*
-// generation ever accepts rows, so one array serves all generations and
-// a bump of gen_seq invalidates it in O(1) instead of clearing.
+// an epoch-stamped flat array (occ_epoch/occ_bits) scoped to the RUN
+// (see Engine) — only the newest generation ever accepts rows, and a
+// bump of run_seq invalidates the whole array in O(1) instead of
+// clearing.
 struct Generation {
   std::vector<Row> rows;
+  // True iff this generation was STARTED because a (slot, direction,
+  // create/update) key already occupied the previous generation — the
+  // flush consumer must then apply it in a separate scatter (duplicate
+  // target rows in one scatter are undefined). Size-rollover generations
+  // (rows reached max_batch) carry no such conflict and may be coalesced
+  // with their predecessor by the sharded spine's batched apply.
+  bool conflict_start = false;
 };
 
 // A parsed-but-not-yet-routed telemetry record. String views point into
@@ -258,12 +268,18 @@ struct Engine {
   uint64_t parsed = 0;
   int32_t last_time = 0;  // max telemetry timestamp seen (eviction clock)
   std::deque<Generation> gens;
-  uint32_t gen_seq = 0;  // sequence of the newest generation
-  // (slot << 1 | is_fwd) → occupancy of the NEWEST generation only:
-  // bits valid iff occ_epoch[k] == gen_seq (bit0=create, bit1=update)
+  // A RUN is a maximal sequence of coalescible generations: it ends at a
+  // key conflict (a generation with conflict_start) or when the deque
+  // drains empty (everything popped has been applied by then). Key
+  // occupancy is tracked per RUN — not per generation — so a consumer
+  // may concatenate every generation of a run into ONE device scatter:
+  // (slot << 1 | is_fwd) bits valid iff occ_epoch[k] == run_seq
+  // (bit0=create, bit1=update).
+  uint32_t run_seq = 0;
   std::vector<uint32_t> occ_epoch;
   std::vector<uint8_t> occ_bits;
   std::string tail;  // partial line carried across feed() calls
+  int last_flush_conflict = 0;  // conflict_start of the last popped gen
 
   explicit Engine(uint32_t cap, uint32_t mb)
       : capacity(cap), max_batch(mb), slot_fp(cap, 0), slot_used(cap, 0),
@@ -337,7 +353,9 @@ bool utf8_valid(const char* s, size_t len) {
 
 Generation& current_gen(Engine* e) {
   if (e->gens.empty()) {
-    ++e->gen_seq;
+    // everything previously flushed has been applied by now — the run
+    // (the coalescible-uniqueness domain) starts over
+    ++e->run_seq;
     e->gens.emplace_back();
   }
   return e->gens.back();
@@ -348,14 +366,22 @@ void push_row(Engine* e, uint32_t slot, uint8_t is_fwd, uint8_t is_create,
   size_t k = (static_cast<size_t>(slot) << 1) | is_fwd;
   uint8_t bit = is_create ? 1 : 2;
   Generation* g = &current_gen(e);
-  uint8_t occ = e->occ_epoch[k] == e->gen_seq ? e->occ_bits[k] : 0;
+  uint8_t occ = e->occ_epoch[k] == e->run_seq ? e->occ_bits[k] : 0;
   if ((occ & bit) || g->rows.size() >= e->max_batch) {
-    ++e->gen_seq;
+    bool conflict = (occ & bit) != 0;
     e->gens.emplace_back();
     g = &e->gens.back();
-    occ = 0;
+    g->conflict_start = conflict;
+    if (conflict) {
+      // new run: this key (and every other) may appear once more
+      ++e->run_seq;
+      occ = 0;
+    }
+    // size rollover: SAME run — occupancy stays valid, so a key that
+    // already appeared anywhere in the run still conflicts later,
+    // keeping whole-run concatenation scatter-safe
   }
-  e->occ_epoch[k] = e->gen_seq;
+  e->occ_epoch[k] = e->run_seq;
   e->occ_bits[k] = occ | bit;
   g->rows.push_back(Row{slot, time, pkts, bytes, is_fwd, is_create});
 }
@@ -594,6 +620,7 @@ uint32_t tc_engine_flush(void* h, int32_t* slot, int32_t* time,
   }
   if (e->gens.empty()) return 0;
   const Generation& g = e->gens.front();
+  e->last_flush_conflict = g.conflict_start ? 1 : 0;
   uint32_t n = static_cast<uint32_t>(g.rows.size());
   for (uint32_t i = 0; i < n; i++) {
     const Row& r = g.rows[i];
@@ -608,6 +635,15 @@ uint32_t tc_engine_flush(void* h, int32_t* slot, int32_t* time,
   }
   e->gens.pop_front();
   return n;
+}
+
+// 1 iff the generation most recently popped by tc_engine_flush was
+// started by a same-(slot, direction, kind) conflict with its
+// predecessor — i.e. it must NOT be coalesced into the same device
+// scatter as the batch flushed before it. 0 for size-rollover
+// generations and the first generation of a drain.
+int tc_engine_last_flush_conflict(void* h) {
+  return static_cast<Engine*>(h)->last_flush_conflict;
 }
 
 uint64_t tc_engine_dropped(void* h) { return static_cast<Engine*>(h)->dropped; }

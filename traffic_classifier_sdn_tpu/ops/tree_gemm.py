@@ -293,3 +293,149 @@ def forest_proba_gemm(
 
 def predict(g: ForestGemm | ForestGemmGroups, X: jax.Array) -> jax.Array:
     return jnp.argmax(forest_proba_gemm(g, X), axis=-1).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# v2: traffic-lean transposed formulation
+#
+# The v1 path above is HBM-bound, not FLOP-bound: per classified row it
+# materializes ~40 kB of intermediates (xf f32, pm bf16 + its transpose,
+# S f32, match f32) — ~5.3 GB for a 131k batch, which at ~1 TB/s accounts
+# for essentially all of the measured 6 ms (VERDICT r3 weak item 5). v2
+# attacks the traffic, keeping semantics bit-exact:
+#
+#   - everything runs in a transposed (..., n) layout so no large
+#     intermediate is ever physically transposed: X.T is (12, n), tiny;
+#     reshapes (T*D, n) -> (T, D, n) are free (contiguous split).
+#   - stage 1 drops the f32 one-hot matmul (HIGHEST-precision f32 on MXU
+#     is ~6 bf16 passes) for a static row-gather of X.T + compare, whose
+#     epilogue writes pm as INT8 (T*D bytes/row instead of 4*T*D + the
+#     bf16 copy). Identical decisions: same f32 X vs f32-safe thresholds.
+#   - stage 2 is an int8 x int8 -> int32 MXU matmul: path entries are
+#     -1/0/+1 and |S| <= D <= 50 < 127, so int8 operands are exact and
+#     run at 2x the bf16 MXU rate.
+#   - stage 3 selects the matched leaf's distribution either by matmul
+#     ("dot": match {0,1} x leaf_values f32, exact one-row selection) or
+#     by argmax-leaf + per-tree gather ("gather": S==depth never has two
+#     true leaves, the table is ~150 kB and VMEM-resident). The two are
+#     raced on chip; both are exact selections, differing only in HBM
+#     traffic shape (12.6 kB/row of match f32 vs 0.4 kB of leaf ids).
+#
+# Reference semantics unchanged from v1 (traffic_classifier.py:103-106's
+# per-flow sklearn predict); argmax parity is gated in tests and bench.
+# --------------------------------------------------------------------------
+
+
+class ForestGemmV2(struct.PyTreeNode):
+    feat_ids: jax.Array  # (T*D,) int32 feature id per node slot (0 if pad)
+    thresholds: jax.Array  # (T*D, 1) f32, +inf at padded node slots
+    path_t: jax.Array  # (T, L, D) int8 ±1/0 ancestor-edge matrices
+    leaf_depth: jax.Array  # (T, L, 1) int32 (127 at padded leaf slots)
+    leaf_values: jax.Array  # (T, L, C) f32 distributions / T_total
+    leaf_values_t: jax.Array  # (T, C, L) f32 (stage-3 "dot" operand)
+    n_classes: int = struct.field(pytree_node=False)
+    row_chunk: int = struct.field(pytree_node=False)
+    stage3: str = struct.field(pytree_node=False)  # "dot" | "gather"
+
+
+class ForestGemmV2Groups(struct.PyTreeNode):
+    groups: tuple  # of ForestGemmV2
+    n_classes: int = struct.field(pytree_node=False)
+
+
+def _single_group_v2(ops: dict, row_chunk: int, stage3: str) -> ForestGemmV2:
+    T, D, L = ops["path"].shape
+    # feat_onehot is (F, T*D) with at most one 1 per column; padded node
+    # slots have an all-zero column -> argmax 0, harmless under +inf thr
+    feat_ids = np.argmax(ops["feat_onehot"], axis=0).astype(np.int32)
+    lv = ops["leaf_values"]
+    return ForestGemmV2(
+        feat_ids=jnp.asarray(feat_ids),
+        thresholds=jnp.asarray(ops["thresholds"])[:, None],
+        path_t=jnp.asarray(
+            np.moveaxis(ops["path"], 1, 2).astype(np.int8)
+        ),
+        leaf_depth=jnp.asarray(
+            ops["leaf_depth"].astype(np.int32)
+        )[:, :, None],
+        leaf_values=jnp.asarray(lv),
+        leaf_values_t=jnp.asarray(np.moveaxis(lv, 1, 2)),
+        n_classes=ops["n_classes"],
+        row_chunk=row_chunk,
+        stage3=stage3,
+    )
+
+
+def compile_forest_v2(
+    d: dict, row_chunk: int = 32768, n_features: int | None = None,
+    n_buckets: int = 8, stage3: str = "dot",
+) -> ForestGemmV2 | ForestGemmV2Groups:
+    """v2 operands from importer node arrays; same size-bucketing as
+    :func:`compile_forest` (group sums share the full-ensemble divisor)."""
+    buckets = split_tree_buckets(d, n_buckets, n_features)
+    groups = [
+        _single_group_v2(
+            build_gemm_operands(sub, n_features=nf, n_trees_total=nt),
+            row_chunk, stage3,
+        )
+        for sub, nf, nt in buckets
+    ]
+    if len(groups) == 1:
+        return groups[0]
+    return ForestGemmV2Groups(
+        groups=tuple(groups), n_classes=groups[0].n_classes
+    )
+
+
+def _proba_chunk_v2(g: ForestGemmV2, Xt: jax.Array) -> jax.Array:
+    """(C, n) ensemble contribution for one transposed chunk (F, n)."""
+    T, L, D = g.path_t.shape
+    # 1. node comparisons: static row-gather of X.T (reads a 12-row
+    # table, writes int8) — no matmul, no transpose of anything large
+    xg = Xt[g.feat_ids]  # (T*D, n) f32
+    pm = jnp.where(xg <= g.thresholds, jnp.int8(1), jnp.int8(-1))
+    pm = pm.reshape(T, D, -1)  # contiguous split: free
+    # 2. ±1 path aggregation on the MXU in int8 (exact: |S| <= D <= 50)
+    S = lax.dot_general(
+        g.path_t, pm,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )  # (T, L, n)
+    match = S == g.leaf_depth  # (T, L, n) bool: exactly one true leaf/tree
+    if g.stage3 == "gather":
+        # 3a. leaf id per (tree, row) then per-tree distribution lookup —
+        # (T, n) int32 + (T, n, C) f32 of traffic, no stage-3 FLOPs
+        leaf = jnp.argmax(match, axis=1)  # (T, n)
+        vals = jax.vmap(lambda lv, li: lv[li])(g.leaf_values, leaf)
+        return jnp.sum(vals, axis=0).T  # (C, n)
+    # 3b. exact one-row selection by matmul
+    per_tree = lax.dot_general(
+        g.leaf_values_t, match.astype(jnp.float32),
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        precision=_HI,
+    )  # (T, C, n)
+    return jnp.sum(per_tree, axis=0)
+
+
+def forest_proba_gemm_v2(
+    g: ForestGemmV2 | ForestGemmV2Groups, X: jax.Array
+) -> jax.Array:
+    """(N, C) ensemble-mean class distributions via the v2 layout."""
+    from .chunking import map_row_chunks
+
+    groups = g.groups if isinstance(g, ForestGemmV2Groups) else (g,)
+
+    def chunk(xc: jax.Array) -> jax.Array:
+        Xt = xc.T  # (F, n): the only transpose, 48 B/row
+        out = _proba_chunk_v2(groups[0], Xt)
+        for sub in groups[1:]:
+            out = out + _proba_chunk_v2(sub, Xt)
+        return out.T  # (n, C)
+
+    return map_row_chunks(chunk, groups[0].row_chunk, X)
+
+
+def predict_v2(
+    g: ForestGemmV2 | ForestGemmV2Groups, X: jax.Array
+) -> jax.Array:
+    return jnp.argmax(forest_proba_gemm_v2(g, X), axis=-1).astype(jnp.int32)
