@@ -427,6 +427,72 @@ def test_wire_pack_unpack_round_trip():
         )
 
 
+def test_wire_compact_form_round_trip_and_boundary():
+    """pack_wire chooses the 16 B/row compact form when every counter is
+    < 2³¹ and must round-trip every field exactly (f32 lanes rebuilt on
+    device as float32(lo)); any counter at/above 2³¹ forces the full
+    form; widen_wire re-expands a compact matrix bit-exactly."""
+    import numpy as np
+    from traffic_classifier_sdn_tpu.core import flow_table as ft
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(11)
+    n = 193
+    pkts = rng.randint(0, 2**31 - 128, n, np.uint64)
+    byts = rng.randint(0, 2**31 - 128, n, np.uint64)
+    # unique in-capacity slots: the apply-equivalence check below must
+    # exercise REAL scattered updates (and scatter uniqueness holds)
+    b = ft.UpdateBatch(
+        slot=rng.choice(1 << 10, n, replace=False).astype(np.int32),
+        time=rng.randint(0, 2**31 - 1, n).astype(np.int32),
+        pkts_lo=pkts.astype(np.uint32),
+        pkts_f=pkts.astype(np.float32),
+        bytes_lo=byts.astype(np.uint32),
+        bytes_f=byts.astype(np.float32),
+        is_fwd=rng.rand(n) < 0.5,
+        is_create=rng.rand(n) < 0.5,
+    )
+    w = ft.pack_wire(b)
+    assert w.shape == (n, 4), "small-counter batch must pack compact"
+    got = ft.unpack_wire(jnp.asarray(w))
+    for field in (
+        "slot", "time", "pkts_lo", "pkts_f", "bytes_lo", "bytes_f",
+        "is_fwd", "is_create",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, field)), getattr(b, field), err_msg=field
+        )
+    # widen_wire must reproduce the full form bit-exactly
+    wide = ft.widen_wire(w)
+    got_w = ft.unpack_wire(jnp.asarray(wide))
+    for field in ("pkts_f", "bytes_f", "slot", "time"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got_w, field)), getattr(b, field),
+            err_msg=f"widen:{field}",
+        )
+    # one counter at the 2³¹ float boundary forces the full form (f32
+    # rounds 2³¹-1 up to 2³¹, so the packer must not claim compactness);
+    # independent copy — mutating a shallow alias would corrupt b
+    pf2 = b.pkts_f.copy()
+    pf2[0] = np.float32(np.uint64(2**31 - 1))
+    w2 = ft.pack_wire(b.replace(pkts_f=pf2))
+    assert w2.shape == (n, 6), "boundary counter must force the full form"
+    # and apply_batch semantics agree between the two forms of the SAME
+    # small-counter batch, on real in-capacity scattered updates
+    table = ft.make_table(1 << 10)
+    t_compact = ft.apply_wire(table, jnp.asarray(w))
+    t_full = ft.apply_wire(table, jnp.asarray(wide))
+    import jax
+
+    jax.tree.map(
+        lambda a, c: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(c)
+        ),
+        t_compact, t_full,
+    )
+
+
 @pytest.mark.parametrize("native", [False, True])
 def test_render_sample_matches_unfused_path(native):
     """The fused device render gather (one dispatch, O(n) fetched) must

@@ -115,11 +115,26 @@ def make_table(capacity: int) -> FlowTable:
 
 
 def pack_wire(b: UpdateBatch) -> "np.ndarray":
-    """Host-side: one contiguous (B, 6) uint32 wire matrix per batch —
-    24 B/record instead of eight separate arrays (26 B plus per-array
-    transfer overhead). Column 0 carries the slot with the two direction/
-    create flags in bits 31/30 (slot ≤ capacity < 2³⁰); float columns are
-    bit-cast, so the round trip through ``unpack_wire`` is exact."""
+    """Host-side: one contiguous uint32 wire matrix per batch. Column 0
+    carries the slot with the two direction/create flags in bits 31/30
+    (slot ≤ capacity < 2³⁰).
+
+    Two widths, chosen per batch:
+
+    - **(B, 4) compact** — slot+flags, time, pkts_lo, bytes_lo — when
+      every counter in the batch is < 2³¹: the device reconstructs the
+      f32 counter lanes exactly (``float32(lo)`` == the host's
+      ``float32(u64)`` whenever the u64 equals its low 32 bits, and
+      < 2³¹ keeps a safety margin below f32-uint rounding at the 2³²
+      boundary). 16 B/record instead of 24 — the wire is the serving
+      tick's dominant cost on a slow device link (measured 35.9 MB/s
+      tunnel: 25.2 MB → 16.8 MB per 2²⁰ tick saves ~230 ms).
+    - **(B, 6) full** — adds bit-cast pkts_f/bytes_f — whenever any
+      counter reaches 2³¹ (a >2-billion-packet flow), preserving exact
+      f32 lanes for arbitrary u64 counters.
+
+    ``unpack_wire`` dispatches on the column count; both round-trip
+    exactly (property-tested in tests/test_flow_state.py)."""
     import numpy as np
 
     if b.slot.size and int(b.slot.max()) >= (1 << 30):
@@ -127,32 +142,64 @@ def pack_wire(b: UpdateBatch) -> "np.ndarray":
             "pack_wire: slot >= 2^30 collides with the flag bits — "
             "table capacity must stay below 2^30"
         )
-    w = np.empty((b.slot.shape[0], 6), np.uint32)
-    w[:, 0] = (
+    col0 = (
         b.slot.astype(np.uint32)
         | (b.is_fwd.astype(np.uint32) << 31)
         | (b.is_create.astype(np.uint32) << 30)
     )
+    lim = np.float32(1 << 31)
+    compact = bool((b.pkts_f < lim).all() and (b.bytes_f < lim).all())
+    w = np.empty((b.slot.shape[0], 4 if compact else 6), np.uint32)
+    w[:, 0] = col0
     w[:, 1] = b.time.view(np.uint32)
     w[:, 2] = b.pkts_lo
+    if compact:
+        w[:, 3] = b.bytes_lo
+        return w
     w[:, 3] = b.pkts_f.view(np.uint32)
     w[:, 4] = b.bytes_lo
     w[:, 5] = b.bytes_f.view(np.uint32)
     return w
 
 
+def widen_wire(w: "np.ndarray") -> "np.ndarray":
+    """Host-side (B, 4) compact → (B, 6) full wire: rebuilds the f32
+    lanes as ``float32(lo)`` (exact under the compact form's < 2³¹
+    guarantee). Lets a consumer concatenate mixed-width batches — e.g.
+    the sharded spine coalescing a compact batch with a rare full one."""
+    import numpy as np
+
+    if w.shape[1] == 6:
+        return w
+    out = np.empty((w.shape[0], 6), np.uint32)
+    out[:, 0] = w[:, 0]
+    out[:, 1] = w[:, 1]
+    out[:, 2] = w[:, 2]
+    out[:, 3] = w[:, 2].astype(np.float32).view(np.uint32)
+    out[:, 4] = w[:, 3]
+    out[:, 5] = w[:, 3].astype(np.float32).view(np.uint32)
+    return out
+
+
 def unpack_wire(w: jax.Array) -> UpdateBatch:
     """Device-side inverse of ``pack_wire`` (elementwise, fuses into the
-    scatter that follows)."""
+    scatter that follows). Dispatches on the static column count: the
+    compact (B, 4) form rebuilds the f32 counter lanes as
+    ``float32(lo)`` — exact under the packer's < 2³¹ guarantee."""
     col0 = w[:, 0]
     bitcast = jax.lax.bitcast_convert_type
+    compact = w.shape[1] == 4
+    pkts_lo = w[:, 2]
+    bytes_lo = w[:, 3] if compact else w[:, 4]
     return UpdateBatch(
         slot=(col0 & jnp.uint32(0x3FFFFFFF)).astype(jnp.int32),
         time=bitcast(w[:, 1], jnp.int32),
-        pkts_lo=w[:, 2],
-        pkts_f=bitcast(w[:, 3], jnp.float32),
-        bytes_lo=w[:, 4],
-        bytes_f=bitcast(w[:, 5], jnp.float32),
+        pkts_lo=pkts_lo,
+        pkts_f=pkts_lo.astype(jnp.float32) if compact
+        else bitcast(w[:, 3], jnp.float32),
+        bytes_lo=bytes_lo,
+        bytes_f=bytes_lo.astype(jnp.float32) if compact
+        else bitcast(w[:, 5], jnp.float32),
         is_fwd=(col0 >> 31) != 0,
         is_create=((col0 >> 30) & jnp.uint32(1)) != 0,
     )

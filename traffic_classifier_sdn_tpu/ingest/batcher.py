@@ -192,8 +192,9 @@ class Batcher:
 
 
 # Donated so XLA updates the table in-place in HBM between poll ticks.
-# The batch crosses as one packed (B, 6) uint32 buffer (flow_table.pack_wire)
-# and unpacks on device — one transfer per flush instead of eight.
+# The batch crosses as one packed (B, 4) compact or (B, 6) full uint32
+# buffer (flow_table.pack_wire chooses per batch) and unpacks on device —
+# one transfer per flush instead of eight.
 _apply = jax.jit(ft.apply_wire, donate_argnums=0)
 
 

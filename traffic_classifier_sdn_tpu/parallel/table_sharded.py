@@ -59,8 +59,10 @@ def make_sharded_table(mesh, capacity_total: int) -> ft.FlowTable:
 
 def make_apply(mesh):
     """jit'd (tables, wire) → tables: per-shard ``apply_wire`` under one
-    shard_map. ``wire`` is (n_shards, B, 6) uint32 — the host router pads
-    every shard's sub-batch to one common bucket size."""
+    shard_map. ``wire`` is (n_shards, B, ncols) uint32 with ncols = 4
+    (compact) or 6 (full) — see ``flow_table.pack_wire``; the host
+    router pads every shard's sub-batch to one common bucket size (jit
+    compiles one variant per width)."""
 
     @functools.partial(jax.jit, donate_argnums=0)
     def apply(tables, wire):
@@ -177,7 +179,9 @@ class ShardedFlowEngine(HostSpine):
 
     # -- device ops --------------------------------------------------------
     def _route_chunks(self, w: np.ndarray):
-        """Yield (n_shards, B, 6) uint32 wire chunks covering every row of
+        """Yield (n_shards, B, ncols) uint32 wire chunks (ncols = 4
+        compact or 6 full, preserved from ``w`` — see
+        ``flow_table.pack_wire``) covering every row of
         the concatenated packed batch ``w``: rows split by owning shard
         (order-preserving, so a slot's create still precedes its update),
         rebased to local slots, and cut into ≤ buckets[-1]-row per-shard
@@ -204,12 +208,13 @@ class ShardedFlowEngine(HostSpine):
             for s in range(self.n_shards)
         ]
         cap = self.buckets[-1]
+        ncols = w.shape[1]  # compact (4) or full (6) wire, preserved
         widest_total = max(r.shape[0] for r in per_shard)
         for off in range(0, max(widest_total, 1), cap):
             chunks = [r[off : off + cap] for r in per_shard]
             widest = max(c.shape[0] for c in chunks)
             B = bucket_size(max(widest, 1), self.buckets)
-            out = np.empty((self.n_shards, B, 6), np.uint32)
+            out = np.empty((self.n_shards, B, ncols), np.uint32)
             # padding rows: local scratch slot, no flags
             out[:, :, 0] = np.uint32(self.local_capacity)
             out[:, :, 1:] = 0
@@ -255,6 +260,10 @@ class ShardedFlowEngine(HostSpine):
         if not groups:
             return False
         for packed in groups:
+            if len(packed) > 1 and len({p.shape[1] for p in packed}) > 1:
+                # rare mixed widths (a >2^31-counter batch among compact
+                # ones): widen so the concatenation is well-formed
+                packed = [ft.widen_wire(p) for p in packed]
             w = packed[0] if len(packed) == 1 else np.concatenate(packed)
             for chunk in self._route_chunks(w):
                 self.wire_bytes += chunk.nbytes
