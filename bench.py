@@ -405,16 +405,33 @@ def measure(batches: list[int]) -> None:
             return jnp.sum(pallas_forest.predict(gp, X)).astype(jnp.float32)
 
         sec_pallas, pf_parity, variant, gp_win = np.inf, 0.0, "none", None
-        for nb in (1, 8):
-            gp = pallas_forest.compile_forest(forest_raw, n_buckets=nb)
-            got_pf = np.asarray(jax.jit(pallas_forest.predict)(gp, Xd32))
-            pct = float((got_pf == want_forest).mean() * 100.0)
-            sec = _timed_loop(pallas_sum, gp, Xp, _loop_iters(pallas_batch))
-            line[f"pallas_forest_b{nb}_device_ms"] = round(sec * 1e3, 3)
-            line[f"pallas_forest_b{nb}_parity_pct"] = round(pct, 3)
+        # (n_buckets, fast_stages): the bf16x3/int8 fast-stage kernel is
+        # raced per-variant with its own guard — a Mosaic rejection of
+        # the int8 dot must not cost the baseline variants' data points
+        for nb, fast in ((1, False), (8, False), (8, True)):
+            tag = f"b{nb}" + ("fast" if fast else "")
+            try:
+                gp = pallas_forest.compile_forest(
+                    forest_raw, n_buckets=nb, fast_stages=fast
+                )
+                got_pf = np.asarray(
+                    jax.jit(pallas_forest.predict)(gp, Xd32)
+                )
+                pct = float((got_pf == want_forest).mean() * 100.0)
+                sec = _timed_loop(
+                    pallas_sum, gp, Xp, _loop_iters(pallas_batch)
+                )
+            except Exception as ve:  # noqa: BLE001
+                line[f"pallas_forest_{tag}_error"] = (
+                    f"{type(ve).__name__}: {ve}"[:120]
+                )
+                emit()
+                continue
+            line[f"pallas_forest_{tag}_device_ms"] = round(sec * 1e3, 3)
+            line[f"pallas_forest_{tag}_parity_pct"] = round(pct, 3)
             pf_parity = max(pf_parity, pct)  # best observed, diagnostic
             if pct == 100.0 and sec < sec_pallas:
-                sec_pallas, variant, gp_win = sec, f"b{nb}", gp
+                sec_pallas, variant, gp_win = sec, tag, gp
             emit()
         line["pallas_forest_variant"] = variant
         sec_gemm_same = _timed_loop(

@@ -118,6 +118,21 @@ def test_pallas_bucketed_interpret_matches(forest_dict, X, want):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("n_buckets", [1, 4])
+def test_pallas_fast_stages_interpret_matches(forest_dict, X, want,
+                                              n_buckets):
+    """The fast_stages variant (exact bf16x3 stage-1 split + int8
+    stage-2 with int32 accumulation) must agree with the gather
+    traversal bit-for-bit in interpreter mode — the race on chip is
+    about speed only, never semantics."""
+    g = pallas_forest.compile_forest(
+        forest_dict, row_tile=256, tree_chunk=8, n_buckets=n_buckets,
+        fast_stages=True,
+    )
+    got = np.asarray(pallas_forest.predict(g, X, interpret=True))
+    np.testing.assert_array_equal(got, want)
+
+
 def _random_forest_dict(rng, n_trees: int, depth: int, n_classes: int = 6):
     """Synthetic full binary trees of the importer's node-array shape."""
     n_nodes = 2 ** (depth + 1) - 1

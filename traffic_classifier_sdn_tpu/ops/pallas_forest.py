@@ -54,6 +54,16 @@ class ForestPallas(struct.PyTreeNode):
     # one wide (TILE, chunk_g*gL) leaf GEMM per step when that buffer fits
     # VMEM comfortably; per-group accumulation otherwise
     fuse_leaf_gemm: bool = struct.field(pytree_node=False, default=True)
+    # round-4 compute-shaping variant (chip-raced by bench.py):
+    #   - stage 1 as THREE bf16 dots over the exact bf16x3 split of X
+    #     (the one-hot operand is exactly bf16; every f32 splits exactly
+    #     into three bf16 components, and each partial product lands in a
+    #     disjoint bit range of the f32 accumulator) instead of one
+    #     full-f32 dot (~6 MXU passes);
+    #   - stage 2 as int8 x int8 with int32 accumulation (path entries
+    #     are -1/0/+1, pm is +-1: exact integer sums, 2x the bf16 MXU
+    #     rate).
+    fast_stages: bool = struct.field(pytree_node=False, default=False)
 
 
 class ForestPallasGroups(struct.PyTreeNode):
@@ -70,17 +80,19 @@ class ForestPallasGroups(struct.PyTreeNode):
 
 def compile_forest(
     d: dict, row_tile: int = 512, tree_chunk: int = 16, n_buckets: int = 1,
-    fuse: bool | None = None,
+    fuse: bool | None = None, fast_stages: bool = False,
 ) -> ForestPallas | ForestPallasGroups:
     """``fuse`` overrides the VMEM-based choice of the wide leaf GEMM
     (None = automatic): forcing False is the safe fallback if a target's
     Mosaic build rejects the in-kernel concat/reshape the fused path
-    uses."""
+    uses. ``fast_stages`` enables the bf16x3 stage-1 / int8 stage-2
+    variant (see ForestPallas) — semantically exact, raced on chip."""
     buckets = tree_gemm.split_tree_buckets(d, n_buckets)
     groups = [
         _compile_single(
             sub, row_tile, tree_chunk,
             n_features=nf, n_trees_total=nt, fuse=fuse,
+            fast_stages=fast_stages,
         )
         for sub, nf, nt in buckets
     ]
@@ -94,7 +106,7 @@ def compile_forest(
 def _compile_single(
     d: dict, row_tile: int, tree_chunk: int,
     n_features: int | None = None, n_trees_total: int | None = None,
-    fuse: bool | None = None,
+    fuse: bool | None = None, fast_stages: bool = False,
 ) -> ForestPallas:
     ops = tree_gemm.build_gemm_operands(
         d, n_features=n_features, n_trees_total=n_trees_total
@@ -189,11 +201,19 @@ def _compile_single(
                 ops["path"][g * tpd + j]
             )
     assert (chunk_g * gD) % 128 == 0 or chunk_g == G
+    depth = ops["leaf_depth"].reshape(G, gL)
     return ForestPallas(
-        feat_onehot=jnp.asarray(ops["feat_onehot"]),
+        feat_onehot=jnp.asarray(
+            ops["feat_onehot"],
+            jnp.bfloat16 if fast_stages else jnp.float32,
+        ),
         thresholds=jnp.asarray(ops["thresholds"][None, :]),
-        path=jnp.asarray(path_blk, jnp.bfloat16),
-        leaf_depth=jnp.asarray(ops["leaf_depth"].reshape(G, gL)),
+        path=jnp.asarray(
+            path_blk, jnp.int8 if fast_stages else jnp.bfloat16
+        ),
+        leaf_depth=jnp.asarray(
+            depth, jnp.int32 if fast_stages else jnp.float32
+        ),
         leaf_values=jnp.asarray(ops["leaf_values"].reshape(G, gL, C)),
         n_classes=C,
         n_internal=gD,
@@ -203,26 +223,55 @@ def _compile_single(
         fuse_leaf_gemm=(
             fuse if fuse is not None else (chunk_g * gL) <= 2048
         ),
+        fast_stages=fast_stages,
     )
 
 
 def _kernel(
     x_ref, a_ref, thr_ref, path_ref, depth_ref, vals_ref, out_ref,
     *, tree_chunk: int, n_internal: int, fuse_leaf_gemm: bool,
+    fast_stages: bool,
 ):
     t = pl.program_id(1)
-    xf = jnp.dot(
-        x_ref[:], a_ref[:], preferred_element_type=jnp.float32
-    )  # (TILE, chunk_g*gD)
-    pm = jnp.where(xf <= thr_ref[:], 1.0, -1.0).astype(jnp.bfloat16)
+    if fast_stages:
+        # exact bf16x3 column select: X splits exactly into three bf16
+        # components (8+8+8 significand bits cover f32's 24); the one-hot
+        # operand is exactly bf16, and each partial product occupies a
+        # disjoint bit range of the f32 accumulator, so the sum
+        # reconstructs X[n, f] bit-exactly — in 3 bf16 MXU passes
+        # instead of a full-f32 dot.
+        x = x_ref[:]
+        x1 = x.astype(jnp.bfloat16)
+        r1 = x - x1.astype(jnp.float32)
+        x2 = r1.astype(jnp.bfloat16)
+        x3 = (r1 - x2.astype(jnp.float32)).astype(jnp.bfloat16)
+        a = a_ref[:]
+        xf = (
+            jnp.dot(x3, a, preferred_element_type=jnp.float32)
+            + jnp.dot(x2, a, preferred_element_type=jnp.float32)
+            + jnp.dot(x1, a, preferred_element_type=jnp.float32)
+        )  # (TILE, chunk_g*gD)
+        pm = jnp.where(
+            xf <= thr_ref[:], jnp.int8(1), jnp.int8(-1)
+        )
+    else:
+        xf = jnp.dot(
+            x_ref[:], a_ref[:], preferred_element_type=jnp.float32
+        )  # (TILE, chunk_g*gD)
+        pm = jnp.where(xf <= thr_ref[:], 1.0, -1.0).astype(jnp.bfloat16)
     # per-group score dots: (TILE, gD=128) @ block-diag (gD, gL) — each
     # contracts a full MXU tile (tpd trees per pass instead of one)
     matches = []
     for k in range(tree_chunk):
         pm_k = pm[:, k * n_internal:(k + 1) * n_internal]
-        S = jnp.dot(
-            pm_k, path_ref[k], preferred_element_type=jnp.float32
-        )  # (TILE, gL)
+        if fast_stages:
+            S = jnp.dot(
+                pm_k, path_ref[k], preferred_element_type=jnp.int32
+            )  # (TILE, gL) exact integer path sums
+        else:
+            S = jnp.dot(
+                pm_k, path_ref[k], preferred_element_type=jnp.float32
+            )  # (TILE, gL)
         matches.append(S == depth_ref[k][None, :])
     if fuse_leaf_gemm:
         # ONE wide leaf-value GEMM per grid step: (TILE, chunk_g*gL) @
@@ -276,7 +325,7 @@ def forest_proba_pallas(
 
     kernel = functools.partial(
         _kernel, tree_chunk=TC, n_internal=D,
-        fuse_leaf_gemm=g.fuse_leaf_gemm,
+        fuse_leaf_gemm=g.fuse_leaf_gemm, fast_stages=g.fast_stages,
     )
     out = pl.pallas_call(
         kernel,
