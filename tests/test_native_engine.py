@@ -90,6 +90,79 @@ def test_native_parses_junk_and_partial_chunks():
     assert nat.batcher.num_flows() == 1
 
 
+def test_fuzz_mutated_lines_native_matches_python():
+    """Mutation fuzz over the line protocol: valid telemetry lines with
+    random byte corruptions (bit flips, truncations, field splices,
+    injected tabs/NULs/UTF-8 fragments) must be ACCEPTED or REJECTED
+    identically by the C++ parser and the Python oracle, and the
+    resulting device-table state must match exactly — the same
+    symmetric-bug insurance the OpenFlow codec fuzz provides for the
+    controller (tests/test_controller.py)."""
+    rng = np.random.RandomState(5)
+    base = [
+        format_line(
+            TelemetryRecord(
+                time=int(rng.randint(1, 9)), datapath="1",
+                in_port=str(rng.randint(1, 5)),
+                eth_src=f"00:00:00:00:00:{a:02x}",
+                eth_dst=f"00:00:00:00:00:{b:02x}",
+                out_port=str(rng.randint(1, 5)),
+                packets=int(rng.randint(1, 10**9)),
+                bytes=int(rng.randint(1, 10**12)),
+            )
+        )
+        for a, b in rng.randint(1, 30, (40, 2))
+        if a != b
+    ]
+
+    def mutate(line: bytes) -> bytes:
+        body = bytearray(line.rstrip(b"\n"))
+        for _ in range(rng.randint(1, 4)):
+            op = rng.randint(5)
+            if not body:
+                break
+            i = rng.randint(len(body))
+            if op == 0:  # bit flip
+                body[i] ^= 1 << rng.randint(8)
+            elif op == 1:  # truncate
+                body = body[:i]
+            elif op == 2:  # inject a structural byte
+                body[i : i] = bytes([rng.choice([9, 0, 0xC3, 0xFF, 45])])
+            elif op == 3:  # duplicate a span (field splice)
+                j = rng.randint(i, len(body) + 1)
+                body[i:i] = body[i:j]
+            else:  # delete a span
+                j = rng.randint(i, len(body) + 1)
+                del body[i:j]
+        return bytes(body) + b"\n"
+
+    stream = b"".join(
+        mutate(base[rng.randint(len(base))]) if rng.rand() < 0.7
+        else base[rng.randint(len(base))]
+        for _ in range(600)
+    )
+    py = FlowStateEngine(capacity=256, native=False)
+    nat = FlowStateEngine(capacity=256, native=True)
+    # feed in randomly-sized chunks so framing boundaries are fuzzed too
+    off = 0
+    chunk_i = 0
+    while off < len(stream):
+        step = int(rng.randint(1, 997))
+        n_py = py.ingest_bytes(stream[off : off + step])
+        n_nat = nat.ingest_bytes(stream[off : off + step])
+        # per-chunk (not aggregate) so equal-and-opposite accept/reject
+        # divergences cannot cancel, and a failure names its chunk
+        assert n_py == n_nat, (
+            f"accept/reject divergence at chunk {chunk_i} "
+            f"(bytes {off}..{off + step}): py={n_py} nat={n_nat}"
+        )
+        off += step
+        chunk_i += 1
+    s_py, s_nat = _table_state(py), _table_state(nat)
+    for k in s_py:
+        np.testing.assert_array_equal(s_py[k], s_nat[k], err_msg=k)
+
+
 def test_native_direction_folding_and_meta():
     nat = FlowStateEngine(capacity=8, native=True)
     fwd = TelemetryRecord(1, "1", "1", "aa", "bb", "2", 5, 100)

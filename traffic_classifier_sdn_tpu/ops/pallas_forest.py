@@ -59,7 +59,14 @@ class ForestPallas(struct.PyTreeNode):
     #     (the one-hot operand is exactly bf16; every f32 splits exactly
     #     into three bf16 components, and each partial product lands in a
     #     disjoint bit range of the f32 accumulator) instead of one
-    #     full-f32 dot (~6 MXU passes);
+    #     full-f32 dot (~6 MXU passes). PRECONDITION: features must be
+    #     FINITE NORMAL f32 (no ±inf — bf16(±inf) makes the residual
+    #     NaN; no finite values above bf16 max ~3.39e38; no subnormals
+    #     below ~2^-126, which split to 0). The 12 flow features satisfy
+    #     this by construction: counters are float32(u64) ≤ ~1.8e19 and
+    #     rates are ratios of ints over whole seconds, so the fast path
+    #     is exact on every input the serving spine can produce — but a
+    #     caller feeding arbitrary floats must use the baseline variant;
     #   - stage 2 as int8 x int8 with int32 accumulation (path entries
     #     are -1/0/+1, pm is +-1: exact integer sums, 2x the bf16 MXU
     #     rate).
