@@ -184,6 +184,53 @@ def test_knn_hier_topk_matches_sort_topk(reference_models_dir,
     np.testing.assert_array_equal(a, b)
 
 
+def test_knn_big_corpus_streaming_matches_full(reference_models_dir,
+                                               flow_dataset):
+    """The corpus-streaming scan (single-chip big-corpus path) must
+    predict identically to the full-matrix sort path: contiguous slices
+    + (carry, slice) merge order preserve exact lax.top_k tie semantics
+    across slice boundaries, including a slice-padding tail."""
+    import jax
+
+    d = ski.import_knn(_ref_path(reference_models_dir, "knn"))
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    Xd = jnp.asarray(flow_dataset.X[:512], jnp.float32)
+    want = np.asarray(jax.jit(knn.predict)(params, Xd))
+    for chunk in (512, 1000, 4448, 8192):  # multi-slice, pad, exact, over
+        got = np.asarray(
+            jax.jit(
+                lambda p, X, _c=chunk: knn.predict_big_corpus(
+                    p, X, corpus_chunk=_c
+                )
+            )(params, Xd)
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"{chunk=}")
+
+    # adversarial ties on a synthetic few-distinct-value corpus
+    rng = np.random.RandomState(9)
+    S = 700
+    d2 = {
+        "fit_X": rng.randint(0, 4, (S, 12)).astype(np.float64),
+        "y": rng.randint(0, 6, S),
+        "n_neighbors": 5,
+        "classes": np.arange(6),
+    }
+    p2 = knn.from_numpy(d2, dtype=jnp.float32)
+    X2 = jnp.asarray(rng.randint(0, 4, (128, 12)), jnp.float32)
+    # compare full VOTE COUNTS, not argmax: sensitive to the exact
+    # neighbor multiset, so a tie-order divergence cannot hide behind an
+    # unchanged majority
+    a = np.asarray(jax.jit(knn.neighbor_votes)(p2, X2))
+    b = np.asarray(
+        jax.jit(
+            lambda p, X: knn.neighbor_votes_big_corpus(
+                p, X, corpus_chunk=128
+            )
+        )(p2, X2)
+    )
+    np.testing.assert_array_equal(a, b)
+
+
 def _numpy_forest_predict(d, X):
     """Golden reference: sequential per-tree traversal of the extracted node
     arrays — exactly the walk sklearn's Cython Tree.predict performs."""

@@ -84,6 +84,11 @@ def neighbor_votes(params: Params, X: jax.Array, X_lo=None,
         nbr_idx = _topk_hier_idx(sim, params.n_neighbors)
     else:
         _, nbr_idx = lax.top_k(sim, params.n_neighbors)  # (N, k)
+    return _count_votes(params, nbr_idx)
+
+
+def _count_votes(params: Params, nbr_idx: jax.Array) -> jax.Array:
+    """(N, C) class counts for the given (N, k) neighbor indices."""
     nbr_y = params.fit_y[nbr_idx]  # (N, k)
     return jnp.sum(
         jax.nn.one_hot(nbr_y, params.n_classes, dtype=jnp.int32), axis=1
@@ -169,3 +174,86 @@ def predict_chunked(
         lambda xc, xlo=None: predict(params, xc, xlo, top_k_impl=top_k_impl),
         row_chunk, X, X_lo,
     )
+
+
+def neighbor_votes_big_corpus(
+    params: Params, X: jax.Array, corpus_chunk: int = 65536
+) -> jax.Array:
+    """(N, C) neighbor votes for corpora too large to materialize the
+    (N, S) similarity matrix on ONE device — the single-chip complement
+    of the state-sharded path (parallel/knn_sharded.py shards S across
+    chips; this streams S through one chip's HBM).
+
+    A ``lax.scan`` walks the corpus in ``corpus_chunk``-column slices:
+    each step computes the slice's similarities (one MXU matmul), takes
+    a local top-k, and merges it into the running top-k carry. Exactness
+    incl. tie order: slices are CONTIGUOUS ascending index ranges and
+    the merge concatenates (carry, slice) in that order, so equal values
+    sit in ascending-global-index position order at every merge — the
+    same argument as ``_topk_hier_idx``, giving bitwise-identical
+    results to one ``lax.top_k`` over the full row (asserted in
+    tests/test_model_parity.py). Peak memory is O(N·corpus_chunk)
+    instead of O(N·S).
+
+    Uses the fast dot-expansion similarity (the ``_neighbor_sim``
+    expression and its f32 caveat, inlined per slice); the corpus pads
+    to a slice multiple with +inf half-norms, which lose every
+    comparison."""
+    S = params.fit_X.shape[0]
+    k = params.n_neighbors
+    n = X.shape[0]
+    if S < k:
+        raise ValueError(f"corpus has {S} rows < n_neighbors={k}")
+    if corpus_chunk < k:
+        raise ValueError(
+            f"corpus_chunk={corpus_chunk} must be >= n_neighbors={k}"
+        )
+    n_slices = -(-S // corpus_chunk)
+    pad = n_slices * corpus_chunk - S
+    fit_X = params.fit_X
+    half = params.half_sq_norms
+    if pad:
+        fit_X = jnp.concatenate(
+            [fit_X, jnp.zeros((pad, fit_X.shape[1]), fit_X.dtype)]
+        )
+        half = jnp.concatenate(
+            [half, jnp.full((pad,), jnp.inf, half.dtype)]
+        )
+    fit_slices = fit_X.reshape(n_slices, corpus_chunk, -1)
+    half_slices = half.reshape(n_slices, corpus_chunk)
+    sim_dtype = jnp.result_type(X.dtype, fit_X.dtype)
+
+    def step(carry, sl):
+        c_val, c_idx = carry
+        fit_s, half_s, base = sl
+        # _neighbor_sim's fast dot-expansion, per slice (same precision
+        # flag; keep in sync with _neighbor_sim)
+        sim = (
+            jnp.matmul(X, fit_s.T, precision=lax.Precision.HIGHEST)
+            - half_s[None, :]
+        )
+        v, i = lax.top_k(sim, k)  # local: ties to lowest in-slice index
+        gidx = i.astype(jnp.int32) + base
+        # (carry, slice) concat order == ascending global index for ties
+        mv = jnp.concatenate([c_val, v], axis=1)
+        mi = jnp.concatenate([c_idx, gidx], axis=1)
+        nv, sel = lax.top_k(mv, k)
+        return (nv, jnp.take_along_axis(mi, sel, axis=1)), None
+
+    init = (
+        jnp.full((n, k), -jnp.inf, sim_dtype),
+        jnp.zeros((n, k), jnp.int32),
+    )
+    bases = (jnp.arange(n_slices, dtype=jnp.int32) * corpus_chunk)
+    (_, nbr_idx), _ = lax.scan(
+        step, init, (fit_slices, half_slices, bases)
+    )
+    return _count_votes(params, nbr_idx)
+
+
+def predict_big_corpus(
+    params: Params, X: jax.Array, corpus_chunk: int = 65536
+) -> jax.Array:
+    return jnp.argmax(
+        neighbor_votes_big_corpus(params, X, corpus_chunk), axis=-1
+    ).astype(jnp.int32)
