@@ -94,3 +94,50 @@ def test_serve_predict_matches_canonical(reference_models_dir, flow_dataset):
         got = np.asarray(serve_fn(serve_params, X))
         want = np.asarray(m.predict(m.params, X))
         np.testing.assert_array_equal(got, want, err_msg=sub)
+
+
+def test_serving_kernel_selection_env(reference_models_dir, flow_dataset,
+                                      monkeypatch):
+    """TCSDN_FOREST_KERNEL / TCSDN_KNN_TOPK promote a raced kernel to
+    the serving path; every CPU-compilable option must agree with the
+    canonical predict (the pallas options are Mosaic/TPU-only and are
+    gated by bench/tpu_proof on chip). Unknown values error loudly."""
+    import jax.numpy as jnp
+    import pytest
+
+    from traffic_classifier_sdn_tpu.models import load_reference_model
+
+    X = jnp.asarray(flow_dataset.X[:256], jnp.float32)
+    for kernel in ("gemm_v2_dot", "gemm_v2_gather"):
+        monkeypatch.setenv("TCSDN_FOREST_KERNEL", kernel)
+        m = load_reference_model(
+            "Randomforest",
+            f"{reference_models_dir}/RandomForestClassifier",
+        )
+        fn, p = m.serving_path()
+        np.testing.assert_array_equal(
+            np.asarray(fn(p, X)), np.asarray(m.predict(m.params, X)),
+            err_msg=kernel,
+        )
+    for impl in ("argmax", "hier"):
+        monkeypatch.setenv("TCSDN_KNN_TOPK", impl)
+        m = load_reference_model(
+            "knearest", f"{reference_models_dir}/KNeighbors"
+        )
+        fn, p = m.serving_path()
+        np.testing.assert_array_equal(
+            np.asarray(fn(p, X)), np.asarray(m.predict(m.params, X)),
+            err_msg=impl,
+        )
+    monkeypatch.setenv("TCSDN_FOREST_KERNEL", "bogus")
+    m = load_reference_model(
+        "Randomforest", f"{reference_models_dir}/RandomForestClassifier"
+    )
+    with pytest.raises(ValueError, match="TCSDN_FOREST_KERNEL"):
+        m.serving_path()
+    monkeypatch.setenv("TCSDN_KNN_TOPK", "bogus")
+    m = load_reference_model(
+        "knearest", f"{reference_models_dir}/KNeighbors"
+    )
+    with pytest.raises(ValueError, match="TCSDN_KNN_TOPK"):
+        m.serving_path()

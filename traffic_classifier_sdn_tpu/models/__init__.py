@@ -44,12 +44,33 @@ SUBCOMMAND_ALIASES = {
 
 def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
     """(predict_fn, params) for full-table serving at 2²⁰ capacity:
-    forest swaps the gather traversal for the bucketed GEMM kernel
-    (~1000× on TPU), KNN/SVC swap in the row-chunked predict (their
-    (N, S) matrices exceed HBM at 1M rows); everything else serves with
-    its canonical predict."""
+    forest swaps the gather traversal for an MXU kernel (~1000× on TPU),
+    KNN/SVC swap in the row-chunked predict (their (N, S) matrices
+    exceed HBM at 1M rows); everything else serves with its canonical
+    predict.
+
+    Raced-kernel selection (so a ``bench.py`` chip-race winner can be
+    promoted to the live serving path without code changes):
+
+    - ``TCSDN_FOREST_KERNEL`` ∈ ``gemm`` (default, size-bucketed v1) |
+      ``gemm_v2_dot`` | ``gemm_v2_gather`` (ops/tree_gemm v2 layouts) |
+      ``pallas`` | ``pallas_fast`` (the fused kernel; TPU-only —
+      Mosaic does not compile on CPU hosts).
+    - ``TCSDN_KNN_TOPK`` ∈ ``sort`` (default) | ``argmax`` | ``hier``.
+
+    Every option is argmax-parity-gated against the same oracles by
+    tests and by the bench before promotion; selection never changes
+    semantics, only speed."""
+    import functools
+    import os
+
     mod = MODEL_MODULES[name]
-    if name in ("knn", "svc"):
+    if name == "knn":
+        impl = os.environ.get("TCSDN_KNN_TOPK", "sort")
+        if impl not in ("sort", "argmax", "hier"):
+            raise ValueError(f"TCSDN_KNN_TOPK={impl!r} unknown")
+        return functools.partial(mod.predict_chunked, top_k_impl=impl), params
+    if name == "svc":
         return mod.predict_chunked, params
     if name == "forest":
         import numpy as np
@@ -64,6 +85,21 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
         # serving feature width is the framework's fixed 12-column matrix
         # (a forest whose trees never split on the last feature must still
         # compile a full-width selector)
+        kernel = os.environ.get("TCSDN_FOREST_KERNEL", "gemm")
+        if kernel in ("gemm_v2_dot", "gemm_v2_gather"):
+            return tree_gemm.predict_v2, tree_gemm.compile_forest_v2(
+                node_arrays, n_features=NUM_FEATURES,
+                stage3=kernel.rsplit("_", 1)[1],
+            )
+        if kernel in ("pallas", "pallas_fast"):
+            from ..ops import pallas_forest
+
+            return pallas_forest.predict, pallas_forest.compile_forest(
+                node_arrays, n_buckets=8, n_features=NUM_FEATURES,
+                fast_stages=kernel == "pallas_fast",
+            )
+        if kernel != "gemm":
+            raise ValueError(f"TCSDN_FOREST_KERNEL={kernel!r} unknown")
         return tree_gemm.predict, tree_gemm.compile_forest(
             node_arrays, n_features=NUM_FEATURES
         )

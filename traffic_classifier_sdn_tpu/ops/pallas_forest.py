@@ -88,13 +88,17 @@ class ForestPallasGroups(struct.PyTreeNode):
 def compile_forest(
     d: dict, row_tile: int = 512, tree_chunk: int = 16, n_buckets: int = 1,
     fuse: bool | None = None, fast_stages: bool = False,
+    n_features: int | None = None,
 ) -> ForestPallas | ForestPallasGroups:
     """``fuse`` overrides the VMEM-based choice of the wide leaf GEMM
     (None = automatic): forcing False is the safe fallback if a target's
     Mosaic build rejects the in-kernel concat/reshape the fused path
     uses. ``fast_stages`` enables the bf16x3 stage-1 / int8 stage-2
-    variant (see ForestPallas) — semantically exact, raced on chip."""
-    buckets = tree_gemm.split_tree_buckets(d, n_buckets)
+    variant (see ForestPallas) — semantically exact, raced on chip.
+    ``n_features`` pins the selector width (required when the X the
+    kernel will see is wider than the forest's max split feature, e.g.
+    the fixed 12-column serving matrix)."""
+    buckets = tree_gemm.split_tree_buckets(d, n_buckets, n_features)
     groups = [
         _compile_single(
             sub, row_tile, tree_chunk,
