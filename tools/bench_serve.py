@@ -43,7 +43,8 @@ def main() -> None:
         "--model", choices=("gnb", "forest"), default="gnb",
         help="predict stage: gnb (cheapest full-table predict; the CPU "
         "default) or forest (the flagship 100-tree checkpoint via the "
-        "bucketed GEMM kernel — the realistic TPU serving configuration)",
+        "serving-path resolution — honors TCSDN_FOREST_KERNEL, so the "
+        "raced kernels A/B directly in this bench; default gemm)",
     )
     ap.add_argument(
         "--shards", type=int, default=0,
@@ -91,18 +92,19 @@ def main() -> None:
     syn = SyntheticFlows(n_flows=n_flows, seed=0)
 
     if args.model == "forest":
-        # the flagship checkpoint through the size-bucketed GEMM kernel —
-        # what a TPU serving deployment would actually run per tick
-        from traffic_classifier_sdn_tpu.io import sklearn_import as ski
-        from traffic_classifier_sdn_tpu.ops import tree_gemm
+        # the flagship checkpoint through the serving-path resolution —
+        # honors TCSDN_FOREST_KERNEL, so the chip day can A/B the serve
+        # tick with whichever raced kernel won (models/__init__.py)
+        from traffic_classifier_sdn_tpu.models import load_reference_model
 
         models_dir = os.environ.get(
             "TCSDN_MODELS_DIR", "/root/reference/models"
         )
-        params = tree_gemm.compile_forest(
-            ski.import_forest(f"{models_dir}/RandomForestClassifier")
+        m = load_reference_model(
+            "Randomforest", f"{models_dir}/RandomForestClassifier"
         )
-        predict = jax.jit(tree_gemm.predict)
+        raw_predict, params = m.serving_path()
+        predict = jax.jit(raw_predict)
     else:
         # 6-class GNB params (synthetic moments — the model family is the
         # cheapest full-table predict; the forest/SVC cost is bench.py's job)
@@ -117,13 +119,15 @@ def main() -> None:
         predict = jax.jit(gnb.predict)
 
     if args.shards >= 1:
-        from traffic_classifier_sdn_tpu.ops import tree_gemm as _tg
         from traffic_classifier_sdn_tpu.parallel import (
             mesh as meshlib,
             table_sharded as tsh,
         )
 
-        raw_fn = _tg.predict if args.model == "forest" else gnb.predict
+        # the un-jitted fn paired with params by the serving resolution
+        # above — raw_predict/params stay a matched (kernel, operands)
+        # unit whatever TCSDN_FOREST_KERNEL selected
+        raw_fn = raw_predict if args.model == "forest" else gnb.predict
         eng = tsh.ShardedFlowEngine(
             meshlib.make_mesh(n_data=args.shards, n_state=1),
             cap, predict_fn=raw_fn, params=params,
