@@ -1,0 +1,75 @@
+#!/bin/sh
+# OPTIONAL chip-day extras — run AFTER tools/tpu_day.sh has landed the
+# official artifacts, if the worker window is still healthy:
+#   1. serve-tick A/B with the v2 GEMM kernels (TCSDN_FOREST_KERNEL)
+#      -> docs/artifacts/serve_2m_tpu_v2dot.json / _v2gather.json
+#   2. single-chip big-corpus KNN rate (2^18-row corpus streamed in
+#      16k slices) -> docs/artifacts/knn_big_corpus_tpu.json
+# Each step is independently guarded; a failure skips only that step.
+set -e
+cd "$(dirname "$0")/.."
+
+timeout 90 python -c "
+import jax, numpy as np, jax.numpy as jnp
+jax.devices()
+print(float(np.asarray(jax.jit(lambda: jnp.sum(jnp.ones((128,128))))())))
+" >/dev/null 2>&1 || { echo "TPU worker down"; exit 1; }
+echo "TPU up — extras"
+
+for K in gemm_v2_dot gemm_v2_gather; do
+  if TCSDN_FOREST_KERNEL=$K python tools/bench_serve.py \
+       --platform default --model forest --ticks 4 \
+       > /tmp/tpu_serve_$K.log 2>&1; then
+    if grep '^{' /tmp/tpu_serve_$K.log | tail -1 \
+        | grep -q '"platform": "tpu"'; then
+      grep '^{' /tmp/tpu_serve_$K.log | tail -1 \
+        > "docs/artifacts/serve_2m_tpu_${K#gemm_}.json"
+      echo "extras: serve A/B $K landed"
+    fi
+  else
+    cat /tmp/tpu_serve_$K.log; echo "extras: serve A/B $K FAILED (skipped)"
+  fi
+done
+
+if python - > /tmp/tpu_knn_big.log 2>&1 <<'EOF'
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+import sys, os
+sys.path.insert(0, os.getcwd())
+import bench
+from traffic_classifier_sdn_tpu.models import knn
+
+platform = jax.devices()[0].platform
+rng = np.random.RandomState(0)
+S, B = 1 << 18, 65536
+d = {"fit_X": np.abs(rng.gamma(1.5, 200.0, (S, 12))),
+     "y": rng.randint(0, 6, S), "n_neighbors": 5, "classes": np.arange(6)}
+p = knn.from_numpy(d, dtype=jnp.float32)
+X = jnp.asarray(np.abs(rng.gamma(1.5, 200.0, (B, 12))).astype(np.float32))
+
+def big_sum(p, X):
+    return jnp.sum(
+        knn.predict_big_corpus(p, X, corpus_chunk=16384)
+    ).astype(jnp.float32)
+
+sec = bench._timed_loop(big_sum, p, X, 4)
+print(json.dumps({
+    "metric": "knn_big_corpus_flows_per_sec", "value": round(B / sec, 1),
+    "unit": "flows/s", "platform": platform, "corpus_rows": S,
+    "corpus_chunk": 16384, "batch": B,
+    "device_batch_ms": round(sec * 1e3, 3),
+}))
+EOF
+then
+  if grep '^{' /tmp/tpu_knn_big.log | tail -1 \
+      | grep -q '"platform": "tpu"'; then
+    grep '^{' /tmp/tpu_knn_big.log | tail -1 \
+      > docs/artifacts/knn_big_corpus_tpu.json
+    echo "extras: big-corpus KNN landed"
+  fi
+else
+  cat /tmp/tpu_knn_big.log; echo "extras: big-corpus KNN FAILED (skipped)"
+fi
+
+echo "tpu_extras: done"
