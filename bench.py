@@ -25,10 +25,11 @@ stdout):
    argmax vs independent oracles (vectorized NumPy node-walk of the
    checkpoint trees; sklearn's own SVC.predict) on the full reference
    dataset — proving the MXU f32 numerics, not just their speed;
-3. flows/sec for the remaining four families (KNN with its three-way
-   top-k race, GNB, logreg, KMeans) — deliberately BEFORE the Pallas
-   races, so a watchdog kill of the late supplementary stages cannot
-   cost the six-family coverage;
+3. flows/sec for the remaining four families (KNN with its top-k race
+   across sort / argmax / three hier group widths, GNB, logreg,
+   KMeans) — deliberately BEFORE the Pallas races, so a watchdog kill
+   of the late supplementary stages cannot cost the six-family
+   coverage;
 4. a RACE of the fused Pallas kernels (ops/pallas_forest.py, three
    variants incl. fast_stages; ops/pallas_rbf.py) against the XLA
    paths, compiled (never interpret mode), parity-checked, with the
@@ -394,9 +395,9 @@ def measure(batches: list[int]) -> None:
     emit()
 
     # --- 4. remaining families: KNN, GNB, logreg, KMeans — BEFORE the
-    # supplementary Pallas races: the three-way KNN top-k race is a
-    # round-4 deliverable and must survive a watchdog kill of the
-    # later stages (tpu_proof.py re-records the Pallas data anyway)
+    # supplementary Pallas races: the KNN top-k race is a round-4
+    # deliverable and must survive a watchdog kill of the later stages
+    # (tpu_proof.py re-records the Pallas data anyway)
     from traffic_classifier_sdn_tpu.models import (
         gnb as gnb_mod,
         kmeans as kmeans_mod,
@@ -423,12 +424,13 @@ def measure(batches: list[int]) -> None:
             sec = _timed_loop(fam_sum, params, Xf, _loop_iters(fam_batch))
             line[f"{name}_flows_per_sec"] = round(fam_batch / sec, 1)
             if name == "knn":
-                # three-way top-k race (identical output incl. ties —
+                # top-k race (identical output incl. ties —
                 # parity-tested): lax.top_k sort network over all S
                 # columns, k argmax+mask passes, and hierarchical
-                # 128-column-group selection; report all, promote fastest
+                # grouped selection at three group widths; report all,
+                # promote fastest
                 best_sec, best_impl = sec, "sort"
-                for impl in ("argmax", "hier"):
+                for impl in ("argmax", "hier", "hier256", "hier512"):
                     def knn_impl_sum(p, X, _impl=impl):
                         return jnp.sum(
                             knn_mod.predict(p, X, top_k_impl=_impl)

@@ -119,7 +119,7 @@ def test_serving_kernel_selection_env(reference_models_dir, flow_dataset,
             np.asarray(fn(p, X)), np.asarray(m.predict(m.params, X)),
             err_msg=kernel,
         )
-    for impl in ("argmax", "hier"):
+    for impl in ("argmax", "hier", "hier512"):
         monkeypatch.setenv("TCSDN_KNN_TOPK", impl)
         m = load_reference_model(
             "knearest", f"{reference_models_dir}/KNeighbors"
@@ -135,9 +135,12 @@ def test_serving_kernel_selection_env(reference_models_dir, flow_dataset,
     )
     with pytest.raises(ValueError, match="TCSDN_FOREST_KERNEL"):
         m.serving_path()
-    monkeypatch.setenv("TCSDN_KNN_TOPK", "bogus")
-    m = load_reference_model(
-        "knearest", f"{reference_models_dir}/KNeighbors"
-    )
-    with pytest.raises(ValueError, match="TCSDN_KNN_TOPK"):
-        m.serving_path()
+    # bogus / too-small group / unicode-digit suffix all fail at BUILD
+    # time, never at the first serving tick
+    for bad in ("bogus", "hier4", "hier²", "hier999999999"):
+        monkeypatch.setenv("TCSDN_KNN_TOPK", bad)
+        m = load_reference_model(
+            "knearest", f"{reference_models_dir}/KNeighbors"
+        )
+        with pytest.raises(ValueError, match="TCSDN_KNN_TOPK"):
+            m.serving_path()

@@ -11,11 +11,7 @@
 set -e
 cd "$(dirname "$0")/.."
 
-timeout 90 python -c "
-import jax, numpy as np, jax.numpy as jnp
-jax.devices()
-print(float(np.asarray(jax.jit(lambda: jnp.sum(jnp.ones((128,128))))())))
-" >/dev/null 2>&1 || { echo "TPU worker down"; exit 1; }
+sh tools/tpu_probe.sh || { echo "TPU worker down"; exit 1; }
 echo "TPU up — running the measurement suite"
 
 run_step() {

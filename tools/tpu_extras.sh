@@ -2,18 +2,14 @@
 # OPTIONAL chip-day extras — run AFTER tools/tpu_day.sh has landed the
 # official artifacts, if the worker window is still healthy:
 #   1. serve-tick A/B with the v2 GEMM kernels (TCSDN_FOREST_KERNEL)
-#      -> docs/artifacts/serve_2m_tpu_v2dot.json / _v2gather.json
+#      -> docs/artifacts/serve_2m_tpu_v2_dot.json / serve_2m_tpu_v2_gather.json
 #   2. single-chip big-corpus KNN rate (2^18-row corpus streamed in
 #      16k slices) -> docs/artifacts/knn_big_corpus_tpu.json
 # Each step is independently guarded; a failure skips only that step.
 set -e
 cd "$(dirname "$0")/.."
 
-timeout 90 python -c "
-import jax, numpy as np, jax.numpy as jnp
-jax.devices()
-print(float(np.asarray(jax.jit(lambda: jnp.sum(jnp.ones((128,128))))())))
-" >/dev/null 2>&1 || { echo "TPU worker down"; exit 1; }
+sh tools/tpu_probe.sh || { echo "TPU worker down"; exit 1; }
 echo "TPU up — extras"
 
 for K in gemm_v2_dot gemm_v2_gather; do

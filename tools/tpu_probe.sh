@@ -1,0 +1,11 @@
+#!/bin/sh
+# Shared TPU liveness probe for the chip-day scripts (tpu_day.sh,
+# tpu_extras.sh): exits 0 iff jax initializes AND the default platform
+# is a real TPU (a CPU-only host must not pass) AND a tiny jit executes.
+# A wedged worker hangs in init, so the timeout converts the hang into a
+# fast failure.
+timeout 90 python -c "
+import jax, numpy as np, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu', jax.devices()
+print(float(np.asarray(jax.jit(lambda: jnp.sum(jnp.ones((128,128))))())))
+" >/dev/null 2>&1

@@ -56,7 +56,8 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
       ``gemm_v2_dot`` | ``gemm_v2_gather`` (ops/tree_gemm v2 layouts) |
       ``pallas`` | ``pallas_fast`` (the fused kernel; TPU-only —
       Mosaic does not compile on CPU hosts).
-    - ``TCSDN_KNN_TOPK`` ∈ ``sort`` (default) | ``argmax`` | ``hier``.
+    - ``TCSDN_KNN_TOPK`` ∈ ``sort`` (default) | ``argmax`` | ``hier`` or
+      ``hier<group>`` (e.g. ``hier512``; group in [n_neighbors, 65536]).
 
     Every option is argmax-parity-gated against the same oracles by
     tests and by the bench before promotion; selection never changes
@@ -67,8 +68,18 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
     mod = MODEL_MODULES[name]
     if name == "knn":
         impl = os.environ.get("TCSDN_KNN_TOPK", "sort")
-        if impl not in ("sort", "argmax", "hier"):
-            raise ValueError(f"TCSDN_KNN_TOPK={impl!r} unknown")
+        if impl not in ("sort", "argmax"):
+            suffix = impl[4:] or "128"
+            # isdecimal (not isdigit: unicode superscripts pass isdigit
+            # then crash int()); group must admit a full top-k
+            if not (impl.startswith("hier") and suffix.isdecimal()):
+                raise ValueError(f"TCSDN_KNN_TOPK={impl!r} unknown")
+            group = int(suffix)
+            if group < params.n_neighbors or group > (1 << 16):
+                raise ValueError(
+                    f"TCSDN_KNN_TOPK={impl!r}: group must be in "
+                    f"[n_neighbors={params.n_neighbors}, 65536]"
+                )
         return functools.partial(mod.predict_chunked, top_k_impl=impl), params
     if name == "svc":
         return mod.predict_chunked, params

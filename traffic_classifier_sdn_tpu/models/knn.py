@@ -80,10 +80,16 @@ def neighbor_votes(params: Params, X: jax.Array, X_lo=None,
     sim = _neighbor_sim(params, X, X_lo)
     if top_k_impl == "argmax":
         nbr_idx = _topk_argmax_idx(sim, params.n_neighbors)
-    elif top_k_impl == "hier":
-        nbr_idx = _topk_hier_idx(sim, params.n_neighbors)
-    else:
+    elif top_k_impl.startswith("hier"):
+        # "hier" (group=128) or "hier<group>" e.g. "hier512" — the group
+        # width is a hardware tuning knob the bench sweeps on chip;
+        # every width is exact (same merge argument)
+        group = int(top_k_impl[4:] or 128)
+        nbr_idx = _topk_hier_idx(sim, params.n_neighbors, group=group)
+    elif top_k_impl == "sort":
         _, nbr_idx = lax.top_k(sim, params.n_neighbors)  # (N, k)
+    else:
+        raise ValueError(f"unknown top_k_impl {top_k_impl!r}")
     return _count_votes(params, nbr_idx)
 
 
