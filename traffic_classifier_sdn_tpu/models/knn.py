@@ -50,16 +50,24 @@ def from_numpy(d: dict, dtype=jnp.float32) -> Params:
     )
 
 
+def _dot_expansion_sim(X: jax.Array, fit_X: jax.Array,
+                       half_sq_norms: jax.Array) -> jax.Array:
+    """(N, S) fast-path similarity: argmin_s ‖x−s‖² == argmax_s
+    (x·s − ½‖s‖²); ‖x‖² is row-constant. precision='highest': default
+    matmul precision on this XLA build is bf16-like (see models/svc.py
+    numerical notes). The ONE place the expression lives — the full
+    matrix, the big-corpus scan slices, and the sharded local top-k all
+    call it, so a precision change applies everywhere."""
+    return (
+        jnp.matmul(X, fit_X.T, precision=lax.Precision.HIGHEST)
+        - half_sq_norms[None, :]
+    )
+
+
 def _neighbor_sim(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
     """(N, S) similarity whose argmax order is ascending-distance order."""
     if X_lo is None:
-        # argmin_s ‖x−s‖² == argmax_s (x·s − ½‖s‖²); ‖x‖² is row-constant.
-        # precision='highest': default matmul precision on this XLA build is
-        # bf16-like (see models/svc.py numerical notes).
-        return (
-            jnp.matmul(X, params.fit_X.T, precision=lax.Precision.HIGHEST)
-            - params.half_sq_norms[None, :]
-        )
+        return _dot_expansion_sim(X, params.fit_X, params.half_sq_norms)
     # Exact two-float difference form.
     diff = (X[:, None, :] - params.fit_X[None, :, :]) + (
         X_lo[:, None, :] - params.fit_X_lo[None, :, :]
@@ -232,12 +240,7 @@ def neighbor_votes_big_corpus(
     def step(carry, sl):
         c_val, c_idx = carry
         fit_s, half_s, base = sl
-        # _neighbor_sim's fast dot-expansion, per slice (same precision
-        # flag; keep in sync with _neighbor_sim)
-        sim = (
-            jnp.matmul(X, fit_s.T, precision=lax.Precision.HIGHEST)
-            - half_s[None, :]
-        )
+        sim = _dot_expansion_sim(X, fit_s, half_s)
         v, i = lax.top_k(sim, k)  # local: ties to lowest in-slice index
         gidx = i.astype(jnp.int32) + base
         # (carry, slice) concat order == ascending global index for ties

@@ -63,10 +63,7 @@ def _local_topk(fit_X, fit_y, half_norms, X, k):
     corpus index among equal distances (the data has duplicate rows, so
     ties are real), and every merge strategy must reproduce that."""
     me = lax.axis_index(STATE_AXIS)
-    sim = (
-        jnp.matmul(X, fit_X.T, precision=lax.Precision.HIGHEST)
-        - half_norms[None, :]
-    )
+    sim = knn._dot_expansion_sim(X, fit_X, half_norms)
     val, idx = lax.top_k(sim, k)
     lab = fit_y[idx].astype(jnp.int32)
     gidx = (idx + me * fit_X.shape[0]).astype(jnp.int32)
