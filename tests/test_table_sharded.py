@@ -131,6 +131,54 @@ def test_sharded_multi_update_tick_matches_single_device(mesh, native):
     np.testing.assert_array_equal(Xs, X1)
 
 
+@pytest.mark.parametrize("native", [False, True])
+def test_sharded_mixed_width_wire_matches_single_device(mesh, native):
+    """A >2³¹-packet flow forces the full 24 B wire form while normal
+    flows pack compact; when both land in one coalesced apply group the
+    router must widen before concatenating (flow_table.widen_wire) and
+    state must still match the single-device spine exactly."""
+    if native:
+        from traffic_classifier_sdn_tpu.native import engine as ne
+
+        if not ne.available():
+            pytest.skip("native engine unavailable")
+    cap = 64
+    single = FlowStateEngine(capacity=cap, native=native)
+    sharded = ts.ShardedFlowEngine(
+        mesh, cap, predict_fn=_label_fn, params=None, table_rows=8,
+        native=native,
+    )
+    big = (1 << 33) + 7  # needs the full wire form (pkts_f >= 2^31)
+    recs1 = [
+        _rec(1, "aa", "bb", big, big * 100),
+        _rec(1, "cc", "dd", 3, 300),
+        _rec(1, "ee", "ff", 5, 500),
+        # a same-tick second update for the big flow: its create goes in
+        # one generation/batch and this update in another -> the step
+        # coalesces batches of BOTH widths into apply groups
+        _rec(1, "aa", "bb", big + 9, (big + 9) * 100),
+        _rec(1, "aa", "bb", big + 11, (big + 11) * 100),
+    ]
+    recs2 = [_rec(4, "aa", "bb", big + 20, (big + 20) * 100),
+             _rec(4, "cc", "dd", 9, 900)]
+    for recs in (recs1, recs2):
+        for eng in (single, sharded):
+            eng.mark_tick()
+            eng.ingest(recs)
+            eng.step()
+    shard_feats = np.stack(
+        [
+            np.asarray(
+                ft.features12(jax.tree.map(lambda a: a[s], sharded.tables))
+            )
+            for s in range(sharded.n_shards)
+        ]
+    )
+    Xs = shard_feats.transpose(1, 0, 2).reshape(-1, 12)
+    X1 = np.asarray(ft.features12(single.table))
+    np.testing.assert_array_equal(Xs, X1)
+
+
 def test_sharded_render_matches_single_device(mesh):
     cap = 128
     single = FlowStateEngine(capacity=cap)
