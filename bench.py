@@ -215,6 +215,24 @@ def measure(batches: list[int]) -> None:
     from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
     from traffic_classifier_sdn_tpu.ops import tree_gemm
 
+    # Graceful self-deadline: killing this child mid-kernel can WEDGE the
+    # remote TPU worker for many minutes (observed r04: the watchdog kill
+    # left every later suite step hanging in device init), so the child
+    # checks its own clock before each stage and skips the rest instead
+    # of making the parent shoot it.
+    import os as _os
+
+    t_child0 = time.monotonic()
+    try:
+        child_budget = float(
+            _os.environ.get("TCSDN_BENCH_CHILD_BUDGET", "inf")
+        )
+    except ValueError:
+        child_budget = float("inf")
+
+    def out_of_time() -> bool:
+        return time.monotonic() - t_child0 > child_budget
+
     rng = np.random.RandomState(0)
     # Feature-realistic magnitudes (deltas, pps/bps rates up to ~1e6).
     X_big = np.abs(
@@ -265,6 +283,10 @@ def measure(batches: list[int]) -> None:
     flops_per_row = _forest_flops_per_row(g)  # loop-invariant
     best = None  # (flows_per_sec, batch, device_s, e2e_s)
     for b in sorted(batches):
+        if best is not None and out_of_time():
+            print(f"# out of child budget before ladder batch {b}",
+                  flush=True)
+            break
         X = jnp.asarray(X_big[:b])
         sec = _timed_loop(forest_sum, g, X, _loop_iters(b))
 
@@ -298,6 +320,8 @@ def measure(batches: list[int]) -> None:
     Xd32 = jnp.asarray(ds.X, jnp.float32)
     want_forest = _numpy_forest_labels(forest_raw, ds.X)
     try:
+        if out_of_time():  # recorded as forest_v2_error below
+            raise TimeoutError("child budget exhausted before the v2 race")
         v2_batches = sorted(batches)[-2:]
         def _v2_flops_per_row(g2, stage3: str) -> float:
             groups = (
@@ -405,6 +429,9 @@ def measure(batches: list[int]) -> None:
         logreg as logreg_mod,
     )
 
+    if out_of_time():
+        print("# out of child budget after parity; stopping", flush=True)
+        return
     fam_batch = min(max(batches), 1 << 16)
     Xf = jnp.asarray(X_big[:fam_batch])
     for name, mod, importer, ckpt in (
@@ -417,6 +444,10 @@ def measure(batches: list[int]) -> None:
         # nothing else on stdout — the liveness markers keep the parent's
         # progress watchdog from reading a healthy race as a stall (the
         # round-4 official run lost stages 4-6 exactly this way)
+        if out_of_time():
+            print(f"# out of child budget before family {name}",
+                  flush=True)
+            return
         print(f"# family: {name}", flush=True)
         try:
             params = mod.from_numpy(
@@ -439,9 +470,14 @@ def measure(batches: list[int]) -> None:
                 line["knn_sort_topk_flows_per_sec"] = round(
                     fam_batch / sec, 1
                 )
+                line["knn_flows_per_sec"] = round(fam_batch / sec, 1)
                 line["knn_top_k_impl"] = best_impl
                 emit()
                 for impl in ("argmax", "hier", "hier256", "hier512"):
+                    if out_of_time():
+                        print("# out of child budget in knn race",
+                              flush=True)
+                        break
                     print(f"# knn top-k variant: {impl}", flush=True)
 
                     def knn_impl_sum(p, X, _impl=impl):
@@ -473,6 +509,9 @@ def measure(batches: list[int]) -> None:
     # stage's wall time inside the watchdog budget (rate per row is flat
     # once chunks amortize, unlike the forest ladder's latency question)
     svc_batch = min(max(batches), 1 << 18)
+    if out_of_time():
+        print("# out of child budget before svc; stopping", flush=True)
+        return
     print("# stage: svc rate", flush=True)
     Xs = jnp.asarray(X_big[:svc_batch])
 
@@ -515,6 +554,10 @@ def measure(batches: list[int]) -> None:
     # both layouts race: one fused call over uniformly-padded trees vs
     # size-bucketed per-group calls (smaller VMEM operands per tile)
     pallas_batch = min(max(batches), 1 << 17)
+    if out_of_time():
+        print("# out of child budget before pallas forest; stopping",
+              flush=True)
+        return
     print("# stage: pallas forest race", flush=True)
     try:
         from traffic_classifier_sdn_tpu.ops import pallas_forest
@@ -530,6 +573,10 @@ def measure(batches: list[int]) -> None:
         # the int8 dot must not cost the baseline variants' data points
         for nb, fast in ((1, False), (8, False), (8, True)):
             tag = f"b{nb}" + ("fast" if fast else "")
+            if out_of_time():
+                print("# out of child budget in pallas forest race",
+                      flush=True)
+                break
             print(f"# pallas forest variant: {tag}", flush=True)
             try:
                 gp = pallas_forest.compile_forest(
@@ -577,6 +624,10 @@ def measure(batches: list[int]) -> None:
             for b in sorted(batches):
                 if b == pallas_batch:
                     continue
+                if out_of_time():
+                    print("# out of child budget in pallas ladder",
+                          flush=True)
+                    break
                 Xb = jnp.asarray(X_big[:b])
                 sec_b = _timed_loop(pallas_sum, gp_win, Xb, _loop_iters(b))
                 pallas_ladder[str(b)] = round(sec_b * 1e3, 3)
@@ -736,13 +787,25 @@ def main() -> None:
 
     # One TPU attempt: dies in ~idle_timeout if the backend is wedged
     # (leaving the floor its reserve); streams to completion when healthy
-    # (a first result waives the floor reserve).
+    # (a first result waives the floor reserve). The child gets its own
+    # slightly-earlier budget so it stops BETWEEN stages — a parent kill
+    # mid-kernel wedges the remote worker for many minutes (observed).
+    # Deadline layering (innermost first): the child stops itself between
+    # stages at budget-45; the parent's kill once a result exists sits
+    # 240 s PAST the budget, so it only fires when the child is stuck
+    # inside one stage (e.g. a hung Mosaic compile) and a kill is the
+    # only option left. The idle timeout must exceed the longest silent
+    # gap a healthy stage produces — a single tunnel compile can run
+    # 3-4 min with no output even with per-stage markers.
+    tpu_env = dict(os.environ)
+    tpu_env["TCSDN_BENCH_CHILD_BUDGET"] = str(max(60.0, budget - 45.0))
     best = _run_child(
         ["--measure", ",".join(str(b) for b in LADDER)],
-        idle_timeout_s=170.0,
+        idle_timeout_s=300.0,
         deadline=lambda has_result: t_start + (
-            budget if has_result else budget - floor_reserve
+            budget + 240.0 if has_result else budget - floor_reserve
         ),
+        env=tpu_env,
     )
     if best is not None:
         print(json.dumps(best), flush=True)
@@ -752,6 +815,7 @@ def main() -> None:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)  # disarm the TPU sitecustomize
+        env["TCSDN_BENCH_CHILD_BUDGET"] = str(max(30.0, remaining() - 20.0))
         # the child self-marks "platform": "cpu" (it reads jax.devices()
         # under the forced-CPU env), so every streamed line is honest even
         # if this parent is killed before it returns

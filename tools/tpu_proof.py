@@ -51,7 +51,16 @@ def main() -> None:
     from traffic_classifier_sdn_tpu.ops import pallas_forest, pallas_rbf, tree_gemm
 
     t0 = time.time()
+    # stderr liveness markers: device init and Mosaic compiles over the
+    # tunnel can take minutes each, and a silent run is indistinguishable
+    # from a wedged worker (the r04 chip day lost 20+ min to exactly that
+    # ambiguity) — so mark BEFORE the first blocking call
+    def mark(msg: str) -> None:
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    mark("initializing devices")
     platform = jax.devices()[0].platform
+    mark(f"devices: {jax.devices()}")
     out: dict = {
         "metric": "pallas_compiled_proof",
         "platform": platform,
@@ -73,8 +82,11 @@ def main() -> None:
     # ---- forest: fused Pallas vs XLA GEMM form vs NumPy node-walk -------
     forest_raw = ski.import_forest(f"{args.models_dir}/RandomForestClassifier")
     g_gemm = tree_gemm.compile_forest(forest_raw)  # bucketed by default
+    mark("compiling pallas forest (1 bucket)")
     g_pal = pallas_forest.compile_forest(forest_raw)
+    mark("compiling pallas forest (8 buckets)")
     g_pal_b = pallas_forest.compile_forest(forest_raw, n_buckets=8)
+    mark("running forest parity predicts")
     Xd = jnp.asarray(ds.X, jnp.float32)
     want = bench._numpy_forest_labels(forest_raw, ds.X)
     got_pal = np.asarray(jax.jit(pallas_forest.predict)(g_pal, Xd))
@@ -100,6 +112,7 @@ def main() -> None:
     # Mosaic rejection of the int8 dot never costs the baseline proof
     g_pal_f = None
     try:
+        mark("compiling pallas forest (fast stages)")
         g_pal_f = pallas_forest.compile_forest(
             forest_raw, n_buckets=8, fast_stages=True
         )
@@ -118,6 +131,7 @@ def main() -> None:
         return jnp.sum(pallas_forest.predict(g, X)).astype(jnp.float32)
 
     for b in batches:
+        mark(f"timing forest variants at batch {b}")
         X = jnp.asarray(X_big[:b])
         it = bench._loop_iters(b)
         row = {
@@ -144,6 +158,7 @@ def main() -> None:
     import warnings
 
     warnings.filterwarnings("ignore")
+    mark("compiling pallas rbf svc")
     svc_raw = ski.import_svc(f"{args.models_dir}/SVC")
     svc_params = svc_mod.from_numpy(svc_raw, dtype=jnp.float32)
     g_rbf = pallas_rbf.compile_svc(svc_params)
@@ -173,6 +188,7 @@ def main() -> None:
 
     for b in batches:
         b = min(b, 1 << 16)  # the (N, S) kernel matrix bounds the XLA path
+        mark(f"timing svc variants at batch {b}")
         X = jnp.asarray(X_big[:b])
         it = bench._loop_iters(b)
         out["svc"]["timings_device_ms"][str(b)] = {
