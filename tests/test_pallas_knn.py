@@ -1,0 +1,113 @@
+"""Fused Pallas KNN kernel vs the XLA sort path — neighbor-index and
+argmax parity incl. adversarial ties (interpreter mode here; compiled
+parity + the race are exercised on real TPU by bench runs).
+
+The kernel claims bitwise lax.top_k tie semantics (ops/pallas_knn.py
+module docstring); these tests use few-distinct-value integer features so
+every similarity is exactly representable and a tie-order divergence
+cannot hide behind a rounding difference — the same adversarial pattern
+as the hier/big-corpus tie tests in test_model_parity.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+from traffic_classifier_sdn_tpu.models import knn
+from traffic_classifier_sdn_tpu.ops import pallas_knn
+
+
+@pytest.fixture(scope="module")
+def knn_params(reference_models_dir):
+    return knn.from_numpy(
+        ski.import_knn(os.path.join(reference_models_dir, "KNeighbors"))
+    , dtype=jnp.float32)
+
+
+def _tie_params(rng, S, n_classes=6, k=5):
+    """A few-distinct-value integer corpus: distances are exact and
+    massively tied, so index ordering is fully adversarial."""
+    d = {
+        "fit_X": rng.randint(0, 4, (S, 12)).astype(np.float64),
+        "y": rng.randint(0, n_classes, S),
+        "n_neighbors": k,
+        "classes": np.arange(n_classes),
+    }
+    return knn.from_numpy(d, dtype=jnp.float32)
+
+
+def test_neighbor_idx_matches_topk_with_ties():
+    """(N, k) indices bitwise vs lax.top_k over the full similarity row,
+    across chunk sizes that exercise multi-chunk, padding, exact fit,
+    and a single-chunk degenerate grid; non-tile-multiple N pads rows."""
+    rng = np.random.RandomState(7)
+    params = _tie_params(rng, S=333)
+    X = jnp.asarray(rng.randint(0, 4, (100, 12)).astype(np.float32))
+    sim = knn._dot_expansion_sim(X, params.fit_X, params.half_sq_norms)
+    _, want = lax.top_k(sim, 5)
+    for chunk in (64, 128, 333 + 27, 512):
+        # row_tile 64 also exercises padding of the 100-row batch
+        g = pallas_knn.compile_knn(params, row_tile=64, corpus_chunk=chunk)
+        got = pallas_knn.neighbor_idx(g, X, interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=f"{chunk=}"
+        )
+
+
+def test_predict_parity_on_reference_corpus(knn_params, flow_dataset):
+    """Label parity vs the XLA sort path on real reference rows (same
+    dot-expansion similarity, so any divergence is a kernel bug, not a
+    precision gap)."""
+    X = jnp.asarray(flow_dataset.X[:640], jnp.float32)
+    g = pallas_knn.compile_knn(knn_params)  # 4448 rows -> padded chunks
+    a = np.asarray(pallas_knn.predict(g, X, interpret=True))
+    b = np.asarray(jax.jit(knn.predict)(knn_params, X))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_vote_counts_match_on_ties():
+    """Vote COUNTS (not just argmax) vs the sort path on adversarial
+    ties — a tie-order divergence cannot hide behind a same-class
+    neighbor multiset."""
+    rng = np.random.RandomState(11)
+    params = _tie_params(rng, S=900)
+    X = jnp.asarray(rng.randint(0, 4, (64, 12)).astype(np.float32))
+    g = pallas_knn.compile_knn(params, row_tile=64, corpus_chunk=256)
+    got = np.asarray(pallas_knn.scores(g, X, interpret=True))
+    want = np.asarray(knn.neighbor_votes(params, X))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_small_corpus_single_chunk():
+    """S < corpus_chunk (the whole corpus pads into one chunk) and
+    S barely above k."""
+    rng = np.random.RandomState(3)
+    params = _tie_params(rng, S=7)
+    X = jnp.asarray(rng.randint(0, 4, (16, 12)).astype(np.float32))
+    g = pallas_knn.compile_knn(params, row_tile=16, corpus_chunk=64)
+    a = np.asarray(pallas_knn.predict(g, X, interpret=True))
+    b = np.asarray(knn.predict(params, X))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_chunked_dispatch_and_lo_rejection(knn_params, flow_dataset):
+    X = jnp.asarray(flow_dataset.X[:300], jnp.float32)
+    g = pallas_knn.compile_knn(knn_params, row_tile=128)
+    a = np.asarray(
+        pallas_knn.predict_chunked(g, X, row_chunk=128, interpret=True)
+    )
+    b = np.asarray(jax.jit(knn.predict)(knn_params, X))
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError, match="two-float"):
+        pallas_knn.predict(g, X, X_lo=X)
+
+
+def test_chunk_smaller_than_k_rejected(knn_params):
+    with pytest.raises(ValueError, match="n_neighbors"):
+        pallas_knn.compile_knn(knn_params, corpus_chunk=4)

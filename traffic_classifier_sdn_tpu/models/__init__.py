@@ -57,7 +57,9 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
       ``pallas`` | ``pallas_fast`` (the fused kernel; TPU-only —
       Mosaic does not compile on CPU hosts).
     - ``TCSDN_KNN_TOPK`` ∈ ``sort`` (default) | ``argmax`` | ``hier`` or
-      ``hier<group>`` (e.g. ``hier512``; group in [n_neighbors, 65536]).
+      ``hier<group>`` (e.g. ``hier512``; group in [n_neighbors, 65536]) |
+      ``pallas`` (ops/pallas_knn fused distance+top-k kernel; TPU-only —
+      Mosaic does not compile on CPU hosts).
 
     Every option is argmax-parity-gated against the same oracles by
     tests and by the bench before promotion; selection never changes
@@ -68,6 +70,10 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
     mod = MODEL_MODULES[name]
     if name == "knn":
         impl = os.environ.get("TCSDN_KNN_TOPK", "sort")
+        if impl == "pallas":
+            from ..ops import pallas_knn
+
+            return pallas_knn.predict_chunked, pallas_knn.compile_knn(params)
         if impl not in ("sort", "argmax"):
             suffix = impl[4:] or "128"
             # isdecimal (not isdigit: unicode superscripts pass isdigit

@@ -101,12 +101,18 @@ def neighbor_votes(params: Params, X: jax.Array, X_lo=None,
     return _count_votes(params, nbr_idx)
 
 
-def _count_votes(params: Params, nbr_idx: jax.Array) -> jax.Array:
-    """(N, C) class counts for the given (N, k) neighbor indices."""
-    nbr_y = params.fit_y[nbr_idx]  # (N, k)
+def count_votes(fit_y: jax.Array, n_classes: int,
+                nbr_idx: jax.Array) -> jax.Array:
+    """(N, C) class counts for the given (N, k) neighbor indices — the ONE
+    home of the vote semantics (ops/pallas_knn.py shares it)."""
+    nbr_y = fit_y[nbr_idx]  # (N, k)
     return jnp.sum(
-        jax.nn.one_hot(nbr_y, params.n_classes, dtype=jnp.int32), axis=1
+        jax.nn.one_hot(nbr_y, n_classes, dtype=jnp.int32), axis=1
     )
+
+
+def _count_votes(params: Params, nbr_idx: jax.Array) -> jax.Array:
+    return count_votes(params.fit_y, params.n_classes, nbr_idx)
 
 
 def _topk_argmax_idx(sim: jax.Array, k: int) -> jax.Array:
