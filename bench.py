@@ -418,10 +418,9 @@ def measure(batches: list[int]) -> None:
     line["parity_ok"] = bool(fpct == 100.0 and spct == 100.0)  # both gates ran
     emit()
 
-    # --- 4. remaining families: KNN, GNB, logreg, KMeans — BEFORE the
-    # supplementary Pallas races: the KNN top-k race is a round-4
-    # deliverable and must survive a watchdog kill of the later stages
-    # (tpu_proof.py re-records the Pallas data anyway)
+    # --- 4. remaining families: KNN, GNB, logreg, KMeans — base rates
+    # for ALL four land before any race detail: a budget stop may cost
+    # the knn variant race (stage 4b) but never whole-family coverage
     from traffic_classifier_sdn_tpu.models import (
         gnb as gnb_mod,
         kmeans as kmeans_mod,
@@ -434,6 +433,8 @@ def measure(batches: list[int]) -> None:
         return
     fam_batch = min(max(batches), 1 << 16)
     Xf = jnp.asarray(X_big[:fam_batch])
+    knn_params = None
+    knn_sort_sec = None
     for name, mod, importer, ckpt in (
         ("knn", knn_mod, ski.import_knn, "KNeighbors"),
         ("gnb", gnb_mod, ski.import_gnb, "GaussianNB"),
@@ -460,95 +461,87 @@ def measure(batches: list[int]) -> None:
             sec = _timed_loop(fam_sum, params, Xf, _loop_iters(fam_batch))
             line[f"{name}_flows_per_sec"] = round(fam_batch / sec, 1)
             if name == "knn":
-                # top-k race (identical output incl. ties —
-                # parity-tested): lax.top_k sort network over all S
-                # columns, k argmax+mask passes, and hierarchical
-                # grouped selection at three group widths; report all,
-                # promote fastest; emit per variant so a deadline kill
-                # keeps the partial race
-                best_sec, best_impl = sec, "sort"
+                knn_params, knn_sort_sec = params, sec
                 line["knn_sort_topk_flows_per_sec"] = round(
                     fam_batch / sec, 1
                 )
-                line["knn_flows_per_sec"] = round(fam_batch / sec, 1)
-                line["knn_top_k_impl"] = best_impl
-                emit()
-                for impl in ("argmax", "hier", "hier256", "hier512"):
-                    if out_of_time():
-                        print("# out of child budget in knn race",
-                              flush=True)
-                        break
-                    print(f"# knn top-k variant: {impl}", flush=True)
-
-                    def knn_impl_sum(p, X, _impl=impl):
-                        return jnp.sum(
-                            knn_mod.predict(p, X, top_k_impl=_impl)
-                        ).astype(jnp.float32)
-
-                    sec_i = _timed_loop(
-                        knn_impl_sum, params, Xf, _loop_iters(fam_batch)
-                    )
-                    line[f"knn_{impl}_topk_flows_per_sec"] = round(
-                        fam_batch / sec_i, 1
-                    )
-                    if sec_i < best_sec:
-                        best_sec, best_impl = sec_i, impl
-                    line["knn_flows_per_sec"] = round(
-                        fam_batch / best_sec, 1
-                    )
-                    line["knn_top_k_impl"] = best_impl
-                    emit()
-                # fused Pallas kernel (ops/pallas_knn): distance +
-                # running top-k in VMEM, the (N, S) similarity never
-                # touching HBM. Own guard (a Mosaic rejection must not
-                # cost the family rates) + argmax parity gate vs the
-                # sort path on the reference rows before promotion.
-                if not out_of_time():
-                    print("# knn pallas fused kernel", flush=True)
-                    try:
-                        from traffic_classifier_sdn_tpu.ops import (
-                            pallas_knn,
-                        )
-
-                        gk = pallas_knn.compile_knn(params)
-                        got_pk = np.asarray(
-                            jax.jit(pallas_knn.predict)(gk, Xd32)
-                        )
-                        want_pk = np.asarray(
-                            jax.jit(knn_mod.predict)(params, Xd32)
-                        )
-                        pk_parity = float(
-                            (got_pk == want_pk).mean() * 100.0
-                        )
-                        line["knn_pallas_parity_pct"] = round(
-                            pk_parity, 3
-                        )
-
-                        def pk_sum(g, X):
-                            return jnp.sum(
-                                pallas_knn.predict(g, X)
-                            ).astype(jnp.float32)
-
-                        sec_pk = _timed_loop(
-                            pk_sum, gk, Xf, _loop_iters(fam_batch)
-                        )
-                        line["knn_pallas_flows_per_sec"] = round(
-                            fam_batch / sec_pk, 1
-                        )
-                        if pk_parity == 100.0 and sec_pk < best_sec:
-                            best_sec = sec_pk
-                            line["knn_flows_per_sec"] = round(
-                                fam_batch / sec_pk, 1
-                            )
-                            line["knn_top_k_impl"] = "pallas"
-                    except Exception as e:  # noqa: BLE001
-                        line["knn_pallas_error"] = (
-                            f"{type(e).__name__}: {e}"[:120]
-                        )
-                    emit()
+                line["knn_top_k_impl"] = "sort"
         except Exception as e:  # noqa: BLE001
             line[f"{name}_error"] = f"{type(e).__name__}: {e}"[:120]
         emit()
+
+    # --- 4b. KNN top-k race (identical output incl. ties —
+    # parity-tested): lax.top_k sort network over all S columns, k
+    # argmax+mask passes, hierarchical grouped selection at three group
+    # widths, and the fused Pallas kernel; report all, promote fastest;
+    # emit per variant so a deadline kill keeps the partial race
+    if knn_params is not None and knn_sort_sec is not None:
+        best_sec, best_impl = knn_sort_sec, "sort"
+        for impl in ("argmax", "hier", "hier256", "hier512"):
+            if out_of_time():
+                print("# out of child budget in knn race", flush=True)
+                break
+            print(f"# knn top-k variant: {impl}", flush=True)
+
+            def knn_impl_sum(p, X, _impl=impl):
+                return jnp.sum(
+                    knn_mod.predict(p, X, top_k_impl=_impl)
+                ).astype(jnp.float32)
+
+            try:
+                sec_i = _timed_loop(
+                    knn_impl_sum, knn_params, Xf, _loop_iters(fam_batch)
+                )
+            except Exception as e:  # noqa: BLE001
+                line[f"knn_{impl}_error"] = f"{type(e).__name__}: {e}"[:120]
+                emit()
+                continue
+            line[f"knn_{impl}_topk_flows_per_sec"] = round(
+                fam_batch / sec_i, 1
+            )
+            if sec_i < best_sec:
+                best_sec, best_impl = sec_i, impl
+            line["knn_flows_per_sec"] = round(fam_batch / best_sec, 1)
+            line["knn_top_k_impl"] = best_impl
+            emit()
+        # fused Pallas kernel (ops/pallas_knn): distance + running top-k
+        # in VMEM, the (N, S) similarity never touching HBM. Own guard
+        # (a Mosaic rejection must not cost the race results) + argmax
+        # parity gate vs the sort path on the reference rows before
+        # promotion.
+        if not out_of_time():
+            print("# knn pallas fused kernel", flush=True)
+            try:
+                from traffic_classifier_sdn_tpu.ops import pallas_knn
+
+                gk = pallas_knn.compile_knn(knn_params)
+                got_pk = np.asarray(jax.jit(pallas_knn.predict)(gk, Xd32))
+                want_pk = np.asarray(
+                    jax.jit(knn_mod.predict)(knn_params, Xd32)
+                )
+                pk_parity = float((got_pk == want_pk).mean() * 100.0)
+                line["knn_pallas_parity_pct"] = round(pk_parity, 3)
+
+                def pk_sum(g, X):
+                    return jnp.sum(pallas_knn.predict(g, X)).astype(
+                        jnp.float32
+                    )
+
+                sec_pk = _timed_loop(
+                    pk_sum, gk, Xf, _loop_iters(fam_batch)
+                )
+                line["knn_pallas_flows_per_sec"] = round(
+                    fam_batch / sec_pk, 1
+                )
+                if pk_parity == 100.0 and sec_pk < best_sec:
+                    best_sec = sec_pk
+                    line["knn_flows_per_sec"] = round(
+                        fam_batch / sec_pk, 1
+                    )
+                    line["knn_top_k_impl"] = "pallas"
+            except Exception as e:  # noqa: BLE001
+                line["knn_pallas_error"] = f"{type(e).__name__}: {e}"[:120]
+            emit()
 
 
     # --- 5. SVC rate + Pallas RBF race ----------------------------------
