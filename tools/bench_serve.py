@@ -40,11 +40,13 @@ def main() -> None:
     )
     ap.add_argument("--table-rows", type=int, default=64)
     ap.add_argument(
-        "--model", choices=("gnb", "forest"), default="gnb",
+        "--model", choices=("gnb", "forest", "knn"), default="gnb",
         help="predict stage: gnb (cheapest full-table predict; the CPU "
-        "default) or forest (the flagship 100-tree checkpoint via the "
-        "serving-path resolution — honors TCSDN_FOREST_KERNEL, so the "
-        "raced kernels A/B directly in this bench; default gemm)",
+        "default), forest (the flagship 100-tree checkpoint), or knn "
+        "(the KNeighbors checkpoint) — the latter two resolve through "
+        "the serving path and honor TCSDN_FOREST_KERNEL / "
+        "TCSDN_KNN_TOPK, so the raced kernels A/B directly in this "
+        "bench",
     )
     ap.add_argument(
         "--shards", type=int, default=0,
@@ -91,18 +93,21 @@ def main() -> None:
     n_flows = cap // 2  # two directions share one slot; stay under capacity
     syn = SyntheticFlows(n_flows=n_flows, seed=0)
 
-    if args.model == "forest":
-        # the flagship checkpoint through the serving-path resolution —
-        # honors TCSDN_FOREST_KERNEL, so the chip day can A/B the serve
-        # tick with whichever raced kernel won (models/__init__.py)
+    if args.model in ("forest", "knn"):
+        # the reference checkpoint through the serving-path resolution —
+        # honors TCSDN_FOREST_KERNEL / TCSDN_KNN_TOPK, so the chip day
+        # can A/B the serve tick with whichever raced kernel won
+        # (models/__init__.py)
         from traffic_classifier_sdn_tpu.models import load_reference_model
 
         models_dir = os.environ.get(
             "TCSDN_MODELS_DIR", "/root/reference/models"
         )
-        m = load_reference_model(
-            "Randomforest", f"{models_dir}/RandomForestClassifier"
-        )
+        sub, ck = {
+            "forest": ("Randomforest", "RandomForestClassifier"),
+            "knn": ("knearest", "KNeighbors"),
+        }[args.model]
+        m = load_reference_model(sub, f"{models_dir}/{ck}")
         raw_predict, params = m.serving_path()
         predict = jax.jit(raw_predict)
     else:
@@ -127,7 +132,9 @@ def main() -> None:
         # the un-jitted fn paired with params by the serving resolution
         # above — raw_predict/params stay a matched (kernel, operands)
         # unit whatever TCSDN_FOREST_KERNEL selected
-        raw_fn = raw_predict if args.model == "forest" else gnb.predict
+        raw_fn = (
+            raw_predict if args.model in ("forest", "knn") else gnb.predict
+        )
         eng = tsh.ShardedFlowEngine(
             meshlib.make_mesh(n_data=args.shards, n_state=1),
             cap, predict_fn=raw_fn, params=params,

@@ -5,6 +5,8 @@
 #      -> docs/artifacts/serve_2m_tpu_v2_dot.json / serve_2m_tpu_v2_gather.json
 #   2. single-chip big-corpus KNN rate (2^18-row corpus streamed in
 #      16k slices) -> docs/artifacts/knn_big_corpus_tpu.json
+#   3. KNN serve-tick A/B across raced top-k kernels (TCSDN_KNN_TOPK)
+#      -> docs/artifacts/serve_2m_knn_tpu_<impl>.json
 # Each step is independently guarded; a failure skips only that step.
 set -e
 cd "$(dirname "$0")/.."
@@ -67,5 +69,21 @@ then
 else
   cat /tmp/tpu_knn_big.log; echo "extras: big-corpus KNN FAILED (skipped)"
 fi
+
+for K in sort hier512 pallas; do
+  if TCSDN_KNN_TOPK=$K python tools/bench_serve.py \
+       --platform default --model knn --ticks 3 \
+       > /tmp/tpu_serve_knn_$K.log 2>&1; then
+    if grep '^{' /tmp/tpu_serve_knn_$K.log | tail -1 \
+        | grep -q '"platform": "tpu"'; then
+      grep '^{' /tmp/tpu_serve_knn_$K.log | tail -1 \
+        > "docs/artifacts/serve_2m_knn_tpu_$K.json"
+      echo "extras: knn serve A/B $K landed"
+    fi
+  else
+    cat /tmp/tpu_serve_knn_$K.log
+    echo "extras: knn serve A/B $K FAILED (skipped)"
+  fi
+done
 
 echo "tpu_extras: done"
