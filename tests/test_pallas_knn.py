@@ -111,3 +111,28 @@ def test_chunked_dispatch_and_lo_rejection(knn_params, flow_dataset):
 def test_chunk_smaller_than_k_rejected(knn_params):
     with pytest.raises(ValueError, match="n_neighbors"):
         pallas_knn.compile_knn(knn_params, corpus_chunk=4)
+
+
+def test_sharded_fused_matches_single_device():
+    """The fused local stage composed with the all_gather merge
+    (parallel/knn_sharded.fused_predict) predicts bit-identically to
+    the single-device sort path on the 8-way CPU mesh — shards are
+    contiguous corpus ranges and the kernel's in-shard tie order is
+    lax.top_k's, so the gathered merge preserves the global tie-break.
+    Adversarial few-distinct-value corpus; 900 rows across 8 shards
+    also exercises per-shard chunk padding (113 -> 128 per shard)."""
+    from traffic_classifier_sdn_tpu.parallel import (
+        knn_sharded,
+        mesh as meshlib,
+    )
+
+    rng = np.random.RandomState(13)
+    params = _tie_params(rng, S=900)
+    X = jnp.asarray(rng.randint(0, 4, (96, 12)).astype(np.float32))
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    fn = knn_sharded.fused_predict(
+        m, params, row_tile=32, corpus_chunk=128, interpret=True
+    )
+    got = np.asarray(fn(X))
+    want = np.asarray(jax.jit(knn.predict)(params, X))
+    np.testing.assert_array_equal(got, want)

@@ -62,12 +62,13 @@ def compile_knn(
     similarity is −inf, losing every comparison; S ≥ k real rows always
     exist, so no padded index can survive the final merge)."""
     if params.n_neighbors > corpus_chunk:
+        # topk_sim_idx re-checks at call time; failing here gives the
+        # error at layout time, before any padding work
         raise ValueError(
             f"corpus_chunk={corpus_chunk} must be >= "
             f"n_neighbors={params.n_neighbors}"
         )
     if params.n_neighbors > 128:
-        # the kernel's carry scratch holds one lane per neighbor
         raise ValueError(
             f"n_neighbors={params.n_neighbors} exceeds the kernel's "
             f"128-lane top-k carry"
@@ -91,7 +92,7 @@ def compile_knn(
     )
 
 
-def _kernel(x_ref, fitt_ref, half_ref, out_ref, vs_ref, is_ref,
+def _kernel(x_ref, fitt_ref, half_ref, out_ref, outv_ref, vs_ref, is_ref,
             *, k: int, chunk: int, n_chunks: int):
     s = pl.program_id(1)
 
@@ -168,6 +169,66 @@ def _kernel(x_ref, fitt_ref, half_ref, out_ref, vs_ref, is_ref,
     @pl.when(s == n_chunks - 1)
     def _():
         out_ref[:] = jnp.concatenate(new_i, axis=1)  # (TILE, k)
+        outv_ref[:] = jnp.concatenate(new_v, axis=1)  # (TILE, k)
+
+
+def topk_sim_idx(
+    X: jax.Array, fit_t: jax.Array, half_sq: jax.Array, k: int,
+    row_tile: int = 512, corpus_chunk: int = 512, interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """((N, k) similarities, (N, k) indices) of the k most-similar corpus
+    columns — descending, ties to the lowest index, bitwise what
+    ``lax.top_k`` over the full similarity row returns. Traceable
+    building block: operands are the PRE-LAID arrays of a ``KnnPallas``
+    (or one shard of them — parallel/knn_sharded.py calls this per
+    device inside ``shard_map``, where numpy re-layout is impossible).
+    ``fit_t`` columns must be a multiple of ``corpus_chunk``."""
+    N, F = X.shape
+    Sp = fit_t.shape[1]
+    if Sp % corpus_chunk:
+        raise ValueError(
+            f"corpus columns {Sp} not a multiple of chunk {corpus_chunk}"
+        )
+    if k > corpus_chunk:
+        raise ValueError(
+            f"corpus_chunk={corpus_chunk} must be >= k={k}"
+        )
+    if k > 128:
+        # the kernel's carry scratch holds one lane per neighbor
+        raise ValueError(f"k={k} exceeds the kernel's 128-lane carry")
+
+    padded = (-N) % row_tile
+    if padded:
+        X = jnp.concatenate([X, jnp.zeros((padded, F), X.dtype)])
+    n_tiles = X.shape[0] // row_tile
+    n_chunks = Sp // corpus_chunk
+
+    kernel = functools.partial(
+        _kernel, k=k, chunk=corpus_chunk, n_chunks=n_chunks
+    )
+    idx, vals = pl.pallas_call(
+        kernel,
+        grid=(n_tiles, n_chunks),
+        in_specs=[
+            pl.BlockSpec((row_tile, F), lambda i, s: (i, 0)),
+            pl.BlockSpec((F, corpus_chunk), lambda i, s: (0, s)),
+            pl.BlockSpec((1, corpus_chunk), lambda i, s: (0, s)),
+        ],
+        out_specs=[
+            pl.BlockSpec((row_tile, k), lambda i, s: (i, 0)),
+            pl.BlockSpec((row_tile, k), lambda i, s: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((X.shape[0], k), jnp.int32),
+            jax.ShapeDtypeStruct((X.shape[0], k), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((row_tile, 128), jnp.float32),  # carry values
+            pltpu.VMEM((row_tile, 128), jnp.int32),  # carry global idx
+        ],
+        interpret=interpret,
+    )(X.astype(jnp.float32), fit_t, half_sq)
+    return vals[:N], idx[:N]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -177,37 +238,12 @@ def neighbor_idx(
     """(N, k) global indices of the k nearest corpus rows, descending
     similarity, ties to the lowest index — bitwise what ``lax.top_k``
     over the full similarity row returns."""
-    N, F = X.shape
-    TILE, CHUNK = g.row_tile, g.corpus_chunk
-    Sp = g.fit_t.shape[1]
-    k = g.n_neighbors
-
-    padded = (-N) % TILE
-    if padded:
-        X = jnp.concatenate([X, jnp.zeros((padded, F), X.dtype)])
-    n_tiles = X.shape[0] // TILE
-    n_chunks = Sp // CHUNK
-
-    kernel = functools.partial(
-        _kernel, k=k, chunk=CHUNK, n_chunks=n_chunks
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(n_tiles, n_chunks),
-        in_specs=[
-            pl.BlockSpec((TILE, F), lambda i, s: (i, 0)),
-            pl.BlockSpec((F, CHUNK), lambda i, s: (0, s)),
-            pl.BlockSpec((1, CHUNK), lambda i, s: (0, s)),
-        ],
-        out_specs=pl.BlockSpec((TILE, k), lambda i, s: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((X.shape[0], k), jnp.int32),
-        scratch_shapes=[
-            pltpu.VMEM((TILE, 128), jnp.float32),  # carry values
-            pltpu.VMEM((TILE, 128), jnp.int32),  # carry global indices
-        ],
+    _, idx = topk_sim_idx(
+        X, g.fit_t, g.half_sq, g.n_neighbors,
+        row_tile=g.row_tile, corpus_chunk=g.corpus_chunk,
         interpret=interpret,
-    )(X.astype(jnp.float32), g.fit_t, g.half_sq)
-    return out[:N]
+    )
+    return idx
 
 
 def scores(g: KnnPallas, X, X_lo=None, interpret: bool = False) -> jax.Array:

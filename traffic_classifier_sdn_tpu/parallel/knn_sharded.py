@@ -75,6 +75,22 @@ def _vote(lab, n_classes):
     return jnp.argmax(votes, axis=-1).astype(jnp.int32)
 
 
+def _gather_merge_vote(val, lab, k: int, n_classes: int):
+    """all_gather every shard's (N, k) candidates and vote the global
+    top-k. Gathered column order is (shard, rank) == global corpus
+    order — shards are contiguous ascending index ranges and each
+    shard's candidates are already (similarity desc, index asc) — so
+    plain ``top_k`` keeps the single-device tie-break."""
+    all_val = lax.all_gather(val, STATE_AXIS, axis=0)  # (D, N, k)
+    all_lab = lax.all_gather(lab, STATE_AXIS, axis=0)
+    D, N = all_val.shape[0], all_val.shape[1]
+    merged_val = jnp.moveaxis(all_val, 0, 1).reshape(N, D * k)
+    merged_lab = jnp.moveaxis(all_lab, 0, 1).reshape(N, D * k)
+    _, gsel = lax.top_k(merged_val, k)
+    glab = jnp.take_along_axis(merged_lab, gsel, axis=1)
+    return _vote(glab, n_classes)
+
+
 def _build(mesh, params: knn.Params, pad_mask, local_fn):
     """Common scaffolding: shard the corpus on the state axis, replicate
     the queries, jit the shard_mapped kernel."""
@@ -111,16 +127,7 @@ def sharded_predict(mesh, params: knn.Params, pad_mask=None):
 
     def local_topk(fit_X, fit_y, half_norms, X):
         val, lab, _ = _local_topk(fit_X, fit_y, half_norms, X, k)
-        all_val = lax.all_gather(val, STATE_AXIS, axis=0)  # (D, N, k)
-        all_lab = lax.all_gather(lab, STATE_AXIS, axis=0)
-        D, N = all_val.shape[0], all_val.shape[1]
-        # gathered column order == global corpus order, so plain top_k
-        # keeps the single-device tie-break
-        merged_val = jnp.moveaxis(all_val, 0, 1).reshape(N, D * k)
-        merged_lab = jnp.moveaxis(all_lab, 0, 1).reshape(N, D * k)
-        _, gsel = lax.top_k(merged_val, k)
-        glab = jnp.take_along_axis(merged_lab, gsel, axis=1)
-        return _vote(glab, n_classes)
+        return _gather_merge_vote(val, lab, k, n_classes)
 
     return _build(mesh, params, pad_mask, local_topk)
 
@@ -250,6 +257,80 @@ def ring_predict(mesh, params: knn.Params, pad_mask=None):
         return _vote(_held_labels(final, n_classes, packable), n_classes)
 
     return _build(mesh, params, pad_mask, local_ring)
+
+
+def fused_predict(
+    mesh, params: knn.Params, pad_mask=None, *,
+    row_tile: int = 512, corpus_chunk: int = 512, interpret: bool = False,
+):
+    """all_gather merge with the FUSED local stage: each chip runs the
+    Pallas distance+top-k kernel (ops/pallas_knn.py) over its corpus
+    shard — the per-shard (N, S/D) similarity matrix never touches HBM —
+    then the (D·k) candidates merge exactly as ``sharded_predict``.
+
+    Same candidates, same tie-break, bit-identical output to the XLA
+    merges: shards are contiguous ascending corpus ranges, the kernel's
+    in-shard order is bitwise ``lax.top_k``, and the gathered column
+    order is global corpus order. TPU-only compiled (Mosaic); CPU-mesh
+    tests pass ``interpret=True``.
+
+    Returns ``fn(X) -> (N,) int32``.
+    """
+    import numpy as np
+
+    from ..ops import pallas_knn
+
+    n_classes = params.n_classes
+    k = params.n_neighbors
+    D = mesh.shape[STATE_AXIS]
+    if k > corpus_chunk or k > 128:
+        raise ValueError(f"n_neighbors={k} exceeds kernel limits")
+
+    # per-shard chunk-aligned global layout (numpy, outside shard_map):
+    # every shard holds the same number of whole chunks, padding rows
+    # carry +inf half-norms and lose every comparison
+    fit = np.asarray(params.fit_X, np.float32)
+    half = np.asarray(_mask_half_norms(params, pad_mask), np.float32)
+    fity = np.asarray(params.fit_y, np.int32)
+    S = fit.shape[0]
+    per = max(-(-S // D), k)
+    per = -(-per // corpus_chunk) * corpus_chunk
+    pad = per * D - S
+    if pad:
+        fit = np.concatenate([fit, np.zeros((pad, fit.shape[1]), np.float32)])
+        half = np.concatenate([half, np.full((pad,), np.inf, np.float32)])
+        fity = np.concatenate([fity, np.zeros((pad,), np.int32)])
+    fit_t = jnp.asarray(fit.T)  # (F, per·D)
+    half_sq = jnp.asarray(half[None, :])  # (1, per·D)
+    fit_y = jnp.asarray(fity)
+
+    def local_fused(fit_t_l, half_l, fity_l, X):
+        val, idx = pallas_knn.topk_sim_idx(
+            X, fit_t_l, half_l, k,
+            row_tile=row_tile, corpus_chunk=corpus_chunk,
+            interpret=interpret,
+        )
+        lab = fity_l[idx].astype(jnp.int32)
+        return _gather_merge_vote(val, lab, k, n_classes)
+
+    shmapped = jax.shard_map(
+        local_fused,
+        mesh=mesh,
+        in_specs=(
+            P(None, STATE_AXIS),  # fit_t columns = corpus rows
+            P(None, STATE_AXIS),  # half norms
+            P(STATE_AXIS),  # labels
+            P(),  # X replicated
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def fn(X):
+        return shmapped(fit_t, half_sq, fit_y, X)
+
+    return fn
 
 
 def tournament_predict(mesh, params: knn.Params, pad_mask=None):
