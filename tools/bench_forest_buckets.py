@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Bucket-count sweep for the headline forest GEMM (VERDICT r3 item 5).
+
+The size-bucketed GEMM (ops/tree_gemm.py) pads every tree in a bucket to
+the bucket's max (D, L); more buckets mean tighter padding (fewer wasted
+matmul columns) but more, smaller MXU dispatches. 8 buckets was chosen in
+round 2 without a sweep — this tool races n_buckets over the reference
+checkpoint at the bench's large batch, parity-gating each point, and
+prints one JSON line for docs/artifacts/.
+
+Usage: python tools/bench_forest_buckets.py [--batch 131072]
+       [--buckets 2,4,8,16,32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=131072)
+    ap.add_argument("--buckets", default="2,4,8,16,32")
+    ap.add_argument("--models-dir", default="/root/reference/models")
+    ap.add_argument("--data-dir", default="/root/reference/datasets")
+    args = ap.parse_args()
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+    from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
+    from traffic_classifier_sdn_tpu.ops import tree_gemm
+
+    print("# initializing devices", file=sys.stderr, flush=True)
+    platform = jax.devices()[0].platform
+    print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
+
+    t0 = time.time()
+    forest_raw = ski.import_forest(
+        f"{args.models_dir}/RandomForestClassifier"
+    )
+    ds = load_reference_datasets(args.data_dir)
+    Xd = jnp.asarray(ds.X, jnp.float32)
+    want = bench._numpy_forest_labels(forest_raw, ds.X)
+
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(
+        np.abs(rng.gamma(1.5, 200.0, (args.batch, 12))).astype(np.float32)
+    )
+
+    def forest_sum(g, Xb):
+        return jnp.sum(tree_gemm.predict(g, Xb)).astype(jnp.float32)
+
+    out: dict = {
+        "metric": "forest_bucket_sweep",
+        "platform": platform,
+        "batch": args.batch,
+        "parity_rows": int(ds.X.shape[0]),
+        "points": {},
+    }
+    best = None
+    for nb in (int(b) for b in args.buckets.split(",")):
+        print(f"# n_buckets={nb}", file=sys.stderr, flush=True)
+        g = tree_gemm.compile_forest(forest_raw, n_buckets=nb)
+        got = np.asarray(jax.jit(tree_gemm.predict)(g, Xd))
+        parity = float((got == want).mean() * 100.0)
+        sec = bench._timed_loop(
+            forest_sum, g, X, bench._loop_iters(args.batch)
+        )
+        point = {
+            "device_ms": round(sec * 1e3, 3),
+            "flows_per_sec": round(args.batch / sec, 1),
+            "parity_pct": round(parity, 3),
+        }
+        out["points"][str(nb)] = point
+        print(json.dumps({f"n_buckets_{nb}": point}), flush=True)
+        if parity == 100.0 and (best is None or sec < best[1]):
+            best = (nb, sec)
+    if best is not None:
+        out["best_n_buckets"] = best[0]
+        out["best_device_ms"] = round(best[1] * 1e3, 3)
+        out["best_flows_per_sec"] = round(args.batch / best[1], 1)
+    out["elapsed_s"] = round(time.time() - t0, 1)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

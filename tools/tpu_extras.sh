@@ -7,6 +7,8 @@
 #      16k slices) -> docs/artifacts/knn_big_corpus_tpu.json
 #   3. KNN serve-tick A/B across raced top-k kernels (TCSDN_KNN_TOPK)
 #      -> docs/artifacts/serve_2m_knn_tpu_<impl>.json
+#   4. forest GEMM bucket-count sweep (VERDICT r3 item 5)
+#      -> docs/artifacts/forest_buckets_tpu.json
 # Each step is independently guarded; a failure skips only that step.
 set -e
 cd "$(dirname "$0")/.."
@@ -85,5 +87,18 @@ for K in sort hier512 pallas; do
     echo "extras: knn serve A/B $K FAILED (skipped)"
   fi
 done
+
+if python tools/bench_forest_buckets.py > /tmp/tpu_forest_buckets.log 2>&1
+then
+  if grep '^{' /tmp/tpu_forest_buckets.log | tail -1 \
+      | grep -q '"platform": "tpu"'; then
+    grep '^{' /tmp/tpu_forest_buckets.log | tail -1 \
+      > docs/artifacts/forest_buckets_tpu.json
+    echo "extras: forest bucket sweep landed"
+  fi
+else
+  cat /tmp/tpu_forest_buckets.log
+  echo "extras: forest bucket sweep FAILED (skipped)"
+fi
 
 echo "tpu_extras: done"
