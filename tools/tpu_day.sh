@@ -20,14 +20,19 @@ sh tools/tpu_probe.sh || { echo "TPU worker down"; exit 1; }
 echo "TPU up — running the measurement suite"
 
 run_step() {
-  # run_step <log> <cmd...>: fail loudly, always show the log
-  log="$1"; shift
-  if "$@" > "$log" 2>&1; then cat "$log"; else
+  # run_step <secs> <log> <cmd...>: fail loudly, always show the log.
+  # The timeout bounds a mid-step worker wedge (all JAX calls hang, not
+  # fail, on a wedged worker) so one stuck step cannot eat the window;
+  # -k escalates to KILL for a python that ignores TERM. (A true
+  # D-state hang would outlive even KILL — the observed wedges are
+  # interruptible RPC waits, which TERM/KILL do stop.)
+  secs="$1"; log="$2"; shift 2
+  if timeout -k 30 "$secs" "$@" > "$log" 2>&1; then cat "$log"; else
     cat "$log"; echo "tpu_day: FAILED: $*"; exit 1
   fi
 }
 
-run_step /tmp/tpu_day_serve.log python tools/bench_serve.py \
+run_step 1200 /tmp/tpu_day_serve.log python tools/bench_serve.py \
   --platform default --model forest --ticks 6
 if grep '^{' /tmp/tpu_day_serve.log | tail -1 \
     | grep -q '"platform": "tpu"'; then
@@ -36,7 +41,7 @@ if grep '^{' /tmp/tpu_day_serve.log | tail -1 \
 fi
 
 if [ -f tools/bench_e2e.py ]; then
-  run_step /tmp/tpu_day_e2e.log python tools/bench_e2e.py
+  run_step 1200 /tmp/tpu_day_e2e.log python tools/bench_e2e.py
   if grep '^{' /tmp/tpu_day_e2e.log | tail -1 \
       | grep -q '"platform": "tpu"'; then
     grep '^{' /tmp/tpu_day_e2e.log | tail -1 \
@@ -48,13 +53,13 @@ fi
 # (the driver's own end-of-round run keeps bench.py's 560 s default)
 TCSDN_BENCH_BUDGET=1500
 export TCSDN_BENCH_BUDGET
-run_step /tmp/tpu_day_bench.log python bench.py
+run_step 1900 /tmp/tpu_day_bench.log python bench.py
 if grep -q '"platform": "tpu"' /tmp/tpu_day_bench.log; then
   cp /tmp/tpu_day_bench.log docs/artifacts/bench_tpu_r04.log
   grep '^{' /tmp/tpu_day_bench.log | tail -1 \
     > docs/artifacts/bench_tpu_r04.json
 fi
 
-run_step /tmp/tpu_day_proof.log python tools/tpu_proof.py
+run_step 1500 /tmp/tpu_day_proof.log python tools/tpu_proof.py
 
 echo "tpu_day: all artifacts written"

@@ -11,13 +11,15 @@
 #      -> docs/artifacts/forest_buckets_tpu.json
 # Each step is independently guarded; a failure skips only that step.
 set -e
+# one home for the per-step wedge bound (see tpu_day.sh run_step)
+TMO="timeout -k 30"
 cd "$(dirname "$0")/.."
 
 sh tools/tpu_probe.sh || { echo "TPU worker down"; exit 1; }
 echo "TPU up — extras"
 
 for K in gemm_v2_dot gemm_v2_gather; do
-  if TCSDN_FOREST_KERNEL=$K python tools/bench_serve.py \
+  if TCSDN_FOREST_KERNEL=$K $TMO 900 python tools/bench_serve.py \
        --platform default --model forest --ticks 4 \
        > /tmp/tpu_serve_$K.log 2>&1; then
     if grep '^{' /tmp/tpu_serve_$K.log | tail -1 \
@@ -31,7 +33,7 @@ for K in gemm_v2_dot gemm_v2_gather; do
   fi
 done
 
-if python - > /tmp/tpu_knn_big.log 2>&1 <<'EOF'
+if $TMO 900 python - > /tmp/tpu_knn_big.log 2>&1 <<'EOF'
 import json, time
 import numpy as np
 import jax, jax.numpy as jnp
@@ -73,7 +75,7 @@ else
 fi
 
 for K in sort hier512 pallas; do
-  if TCSDN_KNN_TOPK=$K python tools/bench_serve.py \
+  if TCSDN_KNN_TOPK=$K $TMO 900 python tools/bench_serve.py \
        --platform default --model knn --ticks 3 \
        > /tmp/tpu_serve_knn_$K.log 2>&1; then
     if grep '^{' /tmp/tpu_serve_knn_$K.log | tail -1 \
@@ -88,7 +90,7 @@ for K in sort hier512 pallas; do
   fi
 done
 
-if python tools/bench_forest_buckets.py > /tmp/tpu_forest_buckets.log 2>&1
+if $TMO 1200 python tools/bench_forest_buckets.py > /tmp/tpu_forest_buckets.log 2>&1
 then
   if grep '^{' /tmp/tpu_forest_buckets.log | tail -1 \
       | grep -q '"platform": "tpu"'; then
