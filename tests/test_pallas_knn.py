@@ -71,6 +71,24 @@ def test_predict_parity_on_reference_corpus(knn_params, flow_dataset):
     np.testing.assert_array_equal(a, b)
 
 
+def test_predict_parity_float_features(knn_params):
+    """LABEL parity on the bench race's own data distribution (gamma
+    floats up to ~1e4). What this asserts: predicted labels, not raw
+    similarities. Why it should hold exactly in interpret mode: corpus
+    chunking blocks only the similarity COLUMNS — each element is still
+    one full-F dot plus one subtract, the same per-element computation
+    as the XLA path — so no label can flip on non-representable
+    floats."""
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(
+        np.abs(rng.gamma(1.5, 200.0, (512, 12))).astype(np.float32)
+    )
+    g = pallas_knn.compile_knn(knn_params)
+    a = np.asarray(pallas_knn.predict(g, X, interpret=True))
+    b = np.asarray(jax.jit(knn.predict)(knn_params, X))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_vote_counts_match_on_ties():
     """Vote COUNTS (not just argmax) vs the sort path on adversarial
     ties — a tie-order divergence cannot hide behind a same-class
