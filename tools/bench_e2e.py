@@ -70,6 +70,9 @@ def main() -> None:
     from traffic_classifier_sdn_tpu.io import sklearn_import as ski
     from traffic_classifier_sdn_tpu.ops import tree_gemm
 
+    # init-first liveness: a wedged worker hangs in jax.devices(), and a
+    # silent run is indistinguishable from a slow compile without this
+    print("# initializing devices", file=sys.stderr, flush=True)
     platform = jax.devices()[0].platform
     print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
 

@@ -93,6 +93,11 @@ def main() -> None:
     n_flows = cap // 2  # two directions share one slot; stay under capacity
     syn = SyntheticFlows(n_flows=n_flows, seed=0)
 
+    # init-first liveness: a wedged worker hangs the first device call,
+    # and a silent run is indistinguishable from a slow compile
+    print("# initializing devices", file=sys.stderr, flush=True)
+    print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
+
     if args.model in ("forest", "knn"):
         # the reference checkpoint through the serving-path resolution —
         # honors TCSDN_FOREST_KERNEL / TCSDN_KNN_TOPK, so the chip day
