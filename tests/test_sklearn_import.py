@@ -129,6 +129,21 @@ def test_serving_kernel_selection_env(reference_models_dir, flow_dataset,
             np.asarray(fn(p, X)), np.asarray(m.predict(m.params, X)),
             err_msg=impl,
         )
+    # pallas wiring (execution is Mosaic/TPU-only): the selection must
+    # resolve to the fused kernel's chunked predict with a KnnPallas
+    # whose layout matches the checkpoint corpus
+    monkeypatch.setenv("TCSDN_KNN_TOPK", "pallas")
+    m = load_reference_model(
+        "knearest", f"{reference_models_dir}/KNeighbors"
+    )
+    fn, p = m.serving_path()
+    from traffic_classifier_sdn_tpu.ops import pallas_knn
+
+    assert fn.__module__ == pallas_knn.__name__
+    assert isinstance(p, pallas_knn.KnnPallas)
+    assert p.n_rows == m.params.fit_X.shape[0]
+    assert p.fit_t.shape[0] == m.params.fit_X.shape[1]
+
     monkeypatch.setenv("TCSDN_FOREST_KERNEL", "bogus")
     m = load_reference_model(
         "Randomforest", f"{reference_models_dir}/RandomForestClassifier"
