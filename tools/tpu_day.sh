@@ -19,22 +19,36 @@ cd "$(dirname "$0")/.."
 sh tools/tpu_probe.sh || { echo "TPU worker down"; exit 1; }
 echo "TPU up — running the measurement suite"
 
+FAILED_STEPS=""
 run_step() {
-  # run_step <secs> <log> <cmd...>: fail loudly, always show the log.
-  # The timeout bounds a mid-step worker wedge (all JAX calls hang, not
-  # fail, on a wedged worker) so one stuck step cannot eat the window;
-  # -k escalates to KILL for a python that ignores TERM. (A true
-  # D-state hang would outlive even KILL — the observed wedges are
-  # interruptible RPC waits, which TERM/KILL do stop.)
+  # run_step <secs> <log> <cmd...>: run EVERY step, fail loudly at the
+  # END (one bad step must not cost the window's remaining artifacts).
+  # STEP_OK gates each landing block below: a failed/timed-out step's
+  # partial output must never overwrite a complete artifact from a
+  # prior run (a healthy-but-budget-stopped bench still exits 0, so its
+  # best-so-far line lands). The timeout bounds a mid-step worker wedge
+  # (all JAX calls hang, not fail, on a wedged worker); -k escalates to
+  # KILL for a python that ignores TERM. After a timeout, re-probe: if
+  # the worker is wedged, the remaining steps would serially burn their
+  # whole timeouts against a dead worker — bail out instead.
   secs="$1"; log="$2"; shift 2
-  if timeout -k 30 "$secs" "$@" > "$log" 2>&1; then cat "$log"; else
-    cat "$log"; echo "tpu_day: FAILED: $*"; exit 1
+  if timeout -k 30 "$secs" "$@" > "$log" 2>&1; then
+    cat "$log"; STEP_OK=1
+  else
+    rc=$?
+    cat "$log"; echo "tpu_day: FAILED (rc=$rc): $*"
+    FAILED_STEPS="$FAILED_STEPS [$*]"
+    STEP_OK=0
+    if [ "$rc" -ge 124 ] && ! sh tools/tpu_probe.sh; then
+      echo "tpu_day: worker wedged mid-suite — aborting remaining steps"
+      exit 1
+    fi
   fi
 }
 
 run_step 1200 /tmp/tpu_day_serve.log python tools/bench_serve.py \
   --platform default --model forest --ticks 6
-if grep '^{' /tmp/tpu_day_serve.log | tail -1 \
+if [ "$STEP_OK" = 1 ] && grep '^{' /tmp/tpu_day_serve.log | tail -1 \
     | grep -q '"platform": "tpu"'; then
   grep '^{' /tmp/tpu_day_serve.log | tail -1 \
     > docs/artifacts/serve_2m_tpu.json
@@ -42,7 +56,7 @@ fi
 
 if [ -f tools/bench_e2e.py ]; then
   run_step 1200 /tmp/tpu_day_e2e.log python tools/bench_e2e.py
-  if grep '^{' /tmp/tpu_day_e2e.log | tail -1 \
+  if [ "$STEP_OK" = 1 ] && grep '^{' /tmp/tpu_day_e2e.log | tail -1 \
       | grep -q '"platform": "tpu"'; then
     grep '^{' /tmp/tpu_day_e2e.log | tail -1 \
       > docs/artifacts/e2e_budget_tpu.json
@@ -54,7 +68,8 @@ fi
 TCSDN_BENCH_BUDGET=1500
 export TCSDN_BENCH_BUDGET
 run_step 1900 /tmp/tpu_day_bench.log python bench.py
-if grep -q '"platform": "tpu"' /tmp/tpu_day_bench.log; then
+if [ "$STEP_OK" = 1 ] \
+    && grep -q '"platform": "tpu"' /tmp/tpu_day_bench.log; then
   cp /tmp/tpu_day_bench.log docs/artifacts/bench_tpu_r04.log
   grep '^{' /tmp/tpu_day_bench.log | tail -1 \
     > docs/artifacts/bench_tpu_r04.json
@@ -62,4 +77,8 @@ fi
 
 run_step 1500 /tmp/tpu_day_proof.log python tools/tpu_proof.py
 
+if [ -n "$FAILED_STEPS" ]; then
+  echo "tpu_day: FAILED steps:$FAILED_STEPS"
+  exit 1
+fi
 echo "tpu_day: all artifacts written"
