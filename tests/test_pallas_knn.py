@@ -148,9 +148,13 @@ def test_sharded_fused_matches_single_device():
     params = _tie_params(rng, S=900)
     X = jnp.asarray(rng.randint(0, 4, (96, 12)).astype(np.float32))
     m = meshlib.make_mesh(n_data=1, n_state=8)
-    fn = knn_sharded.fused_predict(
-        m, params, row_tile=32, corpus_chunk=128, interpret=True
-    )
-    got = np.asarray(fn(X))
     want = np.asarray(jax.jit(knn.predict)(params, X))
-    np.testing.assert_array_equal(got, want)
+    for merge in ("all_gather", "ring", "tournament"):
+        fn = knn_sharded.fused_predict(
+            m, params, merge=merge,
+            row_tile=32, corpus_chunk=128, interpret=True,
+        )
+        got = np.asarray(fn(X))
+        np.testing.assert_array_equal(got, want, err_msg=merge)
+    with pytest.raises(ValueError, match="unknown merge"):
+        knn_sharded.fused_predict(m, params, merge="bogus")

@@ -214,6 +214,52 @@ def _held_labels(held, n_classes: int, packable: bool):
     return held[1] % jnp.int32(n_classes) if packable else held[2]
 
 
+def _ring_merge(held, k: int, packable: bool):
+    """The ring schedule over a held block: circulate with ``ppermute``,
+    software-pipelined (merge the previous hop's block while the next
+    transfer flies). One home for the loop — the XLA local stage
+    (``ring_predict``) and the fused local stage share it."""
+    n_dev = lax.axis_size(STATE_AXIS)
+    if n_dev == 1:
+        return held
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def rotate(arrs):
+        return tuple(lax.ppermute(a, STATE_AXIS, perm) for a in arrs)
+
+    # prologue: issue hop 1
+    incoming = rotate(held)
+
+    def body(_, carry):
+        acc, prev = carry
+        nxt = rotate(prev)  # forward the held block
+        # merge while the transfer flies
+        return _merge_held(acc, prev, k, packable), nxt
+
+    acc, last = lax.fori_loop(0, n_dev - 2, body, (held, incoming))
+    return _merge_held(acc, last, k, packable)  # last in-flight block
+
+
+def _require_pow2_state(n_dev: int) -> None:
+    if n_dev & (n_dev - 1):
+        raise ValueError(
+            f"tournament merge needs a power-of-two state axis, got {n_dev}"
+        )
+
+
+def _tournament_merge(held, k: int, packable: bool, n_dev: int):
+    """Recursive-doubling schedule over a held block: round r exchanges
+    with the XOR-2^r partner. Requires power-of-two ``n_dev`` (validated
+    by callers). Shared by ``tournament_predict`` and the fused path."""
+    d = 1
+    while d < n_dev:
+        perm = [(i, i ^ d) for i in range(n_dev)]
+        other = tuple(lax.ppermute(a, STATE_AXIS, perm) for a in held)
+        held = _merge_held(held, other, k, packable)
+        d <<= 1
+    return held
+
+
 def ring_predict(mesh, params: knn.Params, pad_mask=None):
     """Ring merge: the candidate block circulates around the state axis
     with ``ppermute`` — the ring-attention neighbor-passing schedule
@@ -233,27 +279,11 @@ def ring_predict(mesh, params: knn.Params, pad_mask=None):
     packable = _packable(params)
 
     def local_ring(fit_X, fit_y, half_norms, X):
-        n_dev = lax.axis_size(STATE_AXIS)
         val, lab, gidx = _local_topk(fit_X, fit_y, half_norms, X, k)
-        if n_dev == 1:
+        if lax.axis_size(STATE_AXIS) == 1:
             return _vote(lab, n_classes)
-        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-
-        def rotate(arrs):
-            return tuple(lax.ppermute(a, STATE_AXIS, perm) for a in arrs)
-
         held = _make_held(val, lab, gidx, n_classes, packable)
-        # prologue: issue hop 1
-        incoming = rotate(held)
-
-        def body(_, carry):
-            acc, prev = carry
-            nxt = rotate(prev)  # forward the held block
-            # merge while the transfer flies
-            return _merge_held(acc, prev, k, packable), nxt
-
-        acc, last = lax.fori_loop(0, n_dev - 2, body, (held, incoming))
-        final = _merge_held(acc, last, k, packable)  # last in-flight block
+        final = _ring_merge(held, k, packable)
         return _vote(_held_labels(final, n_classes, packable), n_classes)
 
     return _build(mesh, params, pad_mask, local_ring)
@@ -261,17 +291,23 @@ def ring_predict(mesh, params: knn.Params, pad_mask=None):
 
 def fused_predict(
     mesh, params: knn.Params, pad_mask=None, *,
+    merge: str = "all_gather",
     row_tile: int = 512, corpus_chunk: int = 512, interpret: bool = False,
 ):
-    """all_gather merge with the FUSED local stage: each chip runs the
-    Pallas distance+top-k kernel (ops/pallas_knn.py) over its corpus
-    shard — the per-shard (N, S/D) similarity matrix never touches HBM —
-    then the (D·k) candidates merge exactly as ``sharded_predict``.
+    """FUSED local stage × any merge schedule: each chip runs the Pallas
+    distance+top-k kernel (ops/pallas_knn.py) over its corpus shard —
+    the per-shard (N, S/D) similarity matrix never touches HBM — then
+    the (D·k) candidates merge by ``merge`` ∈ ``all_gather`` (one
+    collective, as ``sharded_predict``) | ``ring`` (ppermute circulation,
+    as ``ring_predict``) | ``tournament`` (recursive doubling, as
+    ``tournament_predict`` — power-of-two state axis only). The local
+    stage and the merge schedules are orthogonal layers; the loops are
+    the same shared helpers the XLA paths use.
 
     Same candidates, same tie-break, bit-identical output to the XLA
-    merges: shards are contiguous ascending corpus ranges, the kernel's
-    in-shard order is bitwise ``lax.top_k``, and the gathered column
-    order is global corpus order. TPU-only compiled (Mosaic); CPU-mesh
+    paths: shards are contiguous ascending corpus ranges, the kernel's
+    in-shard order is bitwise ``lax.top_k``, and every merge ranks by
+    (value desc, global index asc). TPU-only compiled (Mosaic); CPU-mesh
     tests pass ``interpret=True``.
 
     Returns ``fn(X) -> (N,) int32``.
@@ -285,6 +321,10 @@ def fused_predict(
     D = mesh.shape[STATE_AXIS]
     if k > corpus_chunk or k > 128:
         raise ValueError(f"n_neighbors={k} exceeds kernel limits")
+    if merge not in ("all_gather", "ring", "tournament"):
+        raise ValueError(f"unknown merge {merge!r}")
+    if merge == "tournament":
+        _require_pow2_state(D)
 
     # per-shard chunk-aligned global layout (numpy, outside shard_map):
     # every shard holds the same number of whole chunks, padding rows
@@ -304,6 +344,10 @@ def fused_predict(
     half_sq = jnp.asarray(half[None, :])  # (1, per·D)
     fit_y = jnp.asarray(fity)
 
+    # packability of gidx·C+lab against the PADDED corpus length: gidx
+    # runs over per-shard-padded global indices, up to per·D
+    packable = per * D * n_classes < 2**31
+
     def local_fused(fit_t_l, half_l, fity_l, X):
         val, idx = pallas_knn.topk_sim_idx(
             X, fit_t_l, half_l, k,
@@ -311,7 +355,18 @@ def fused_predict(
             interpret=interpret,
         )
         lab = fity_l[idx].astype(jnp.int32)
-        return _gather_merge_vote(val, lab, k, n_classes)
+        if merge == "all_gather":
+            return _gather_merge_vote(val, lab, k, n_classes)
+        if lax.axis_size(STATE_AXIS) == 1:
+            return _vote(lab, n_classes)
+        me = lax.axis_index(STATE_AXIS)
+        gidx = (idx + me * per).astype(jnp.int32)
+        held = _make_held(val, lab, gidx, n_classes, packable)
+        if merge == "ring":
+            held = _ring_merge(held, k, packable)
+        else:
+            held = _tournament_merge(held, k, packable, D)
+        return _vote(_held_labels(held, n_classes, packable), n_classes)
 
     shmapped = jax.shard_map(
         local_fused,
@@ -348,10 +403,7 @@ def tournament_predict(mesh, params: knn.Params, pad_mask=None):
     n_classes = params.n_classes
     k = params.n_neighbors
     n_dev = mesh.shape[STATE_AXIS]
-    if n_dev & (n_dev - 1):
-        raise ValueError(
-            f"tournament merge needs a power-of-two state axis, got {n_dev}"
-        )
+    _require_pow2_state(n_dev)
     packable = _packable(params)
 
     def local_tournament(fit_X, fit_y, half_norms, X):
@@ -359,14 +411,7 @@ def tournament_predict(mesh, params: knn.Params, pad_mask=None):
         if n_dev == 1:
             return _vote(lab, n_classes)
         held = _make_held(val, lab, gidx, n_classes, packable)
-        d = 1
-        while d < n_dev:
-            perm = [(i, i ^ d) for i in range(n_dev)]
-            other = tuple(
-                lax.ppermute(a, STATE_AXIS, perm) for a in held
-            )
-            held = _merge_held(held, other, k, packable)
-            d <<= 1
+        held = _tournament_merge(held, k, packable, n_dev)
         return _vote(_held_labels(held, n_classes, packable), n_classes)
 
     return _build(mesh, params, pad_mask, local_tournament)
