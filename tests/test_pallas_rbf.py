@@ -55,6 +55,31 @@ def test_row_padding_and_no_lo(svc_params, flow_dataset):
     np.testing.assert_array_equal(a, b)
 
 
+def test_sharded_fused_matches_single_device(svc_params, flow_dataset):
+    """The fused local stage (ops/pallas_rbf.partial_decision per shard)
+    + psum merge must predict like the single-device fused kernel on
+    reference rows — partial ovo decisions are exact sums over disjoint
+    SV subsets with zero-coefficient padding (8-way CPU mesh, interpret
+    mode)."""
+    from traffic_classifier_sdn_tpu.parallel import (
+        mesh as meshlib,
+        svc_sharded,
+    )
+
+    Xhi, Xlo = svc_model.split_hilo(flow_dataset.X[:256])
+    g = pallas_rbf.compile_svc(svc_params, row_tile=128, sv_chunk=512)
+    want = np.asarray(pallas_rbf.predict(g, Xhi, Xlo, interpret=True))
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    fn = svc_sharded.fused_predict(
+        m, svc_params, row_tile=128, sv_chunk=512, interpret=True
+    )
+    got = np.asarray(fn(Xhi, Xlo))
+    np.testing.assert_array_equal(got, want)
+    # and against the XLA path (the parity bar every SVC variant meets)
+    want_xla = np.asarray(svc_model.predict(svc_params, Xhi, Xlo))
+    np.testing.assert_array_equal(got, want_xla)
+
+
 def test_trained_svc_through_pallas(flow_dataset):
     """compile_svc composes with train/svc.fit output (SV count not a
     multiple of the chunk → zero-coefficient padding)."""

@@ -47,26 +47,36 @@ class SvcPallas(struct.PyTreeNode):
     sv_chunk: int = struct.field(pytree_node=False)
 
 
-def compile_svc(
-    params: svc.Params, row_tile: int = 512, sv_chunk: int = 1024
-) -> SvcPallas:
-    """Re-lay a models/svc.Params for the fused kernel: SVs transposed to
-    (F, S) so per-feature rows broadcast along lanes, S padded to the chunk
-    size with zero-coefficient sentinels (their K contribution is killed by
-    the zero coefficient, so no ±inf bookkeeping is needed)."""
+def sv_layout(params: svc.Params, padded_rows: int):
+    """The kernel's pre-laid operands for ``padded_rows`` total SV slots:
+    ``((F, padded) sv_t_hi, (F, padded) sv_t_lo, (padded, P) coef_t)``,
+    transposed so per-feature rows broadcast along lanes, padding slots
+    carrying ZERO dual coefficients (their K contribution is killed by
+    the zero coefficient, so no ±inf bookkeeping is needed). The ONE
+    home of that invariant — ``compile_svc`` and the SV-sharded layout
+    (parallel/svc_sharded.fused_predict) both build through it."""
     sv_hi = np.asarray(params.sv_hi, np.float32)
     sv_lo = np.asarray(params.sv_lo, np.float32)
     coef = np.asarray(params.pair_coef, np.float32)  # (P, S)
-    S = sv_hi.shape[0]
-    pad = (-S) % sv_chunk
+    pad = padded_rows - sv_hi.shape[0]
     if pad:
         sv_hi = np.concatenate([sv_hi, np.zeros((pad, sv_hi.shape[1]), np.float32)])
         sv_lo = np.concatenate([sv_lo, np.zeros((pad, sv_lo.shape[1]), np.float32)])
         coef = np.concatenate([coef, np.zeros((coef.shape[0], pad), np.float32)], axis=1)
+    return jnp.asarray(sv_hi.T), jnp.asarray(sv_lo.T), jnp.asarray(coef.T)
+
+
+def compile_svc(
+    params: svc.Params, row_tile: int = 512, sv_chunk: int = 1024
+) -> SvcPallas:
+    """Re-lay a models/svc.Params for the fused kernel: S padded to the
+    chunk size (zero-coefficient padding — see ``sv_layout``)."""
+    S = np.asarray(params.sv_hi).shape[0]
+    sv_t_hi, sv_t_lo, coef_t = sv_layout(params, S + (-S) % sv_chunk)
     return SvcPallas(
-        sv_t_hi=jnp.asarray(sv_hi.T),
-        sv_t_lo=jnp.asarray(sv_lo.T),
-        coef_t=jnp.asarray(coef.T),
+        sv_t_hi=sv_t_hi,
+        sv_t_lo=sv_t_lo,
+        coef_t=coef_t,
         intercept=params.intercept,
         vote_i=params.vote_i,
         vote_j=params.vote_j,
@@ -106,25 +116,34 @@ def _kernel(gamma_ref, x_ref, xlo_ref, svt_ref, svtlo_ref, coef_ref, out_ref,
         out_ref[:] = out_ref[:] + acc
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def decision_ovo_pallas(
-    g: SvcPallas, X: jax.Array, X_lo=None, interpret: bool = False
+def partial_decision(
+    X: jax.Array, X_lo: jax.Array, gamma: jax.Array,
+    sv_t_hi: jax.Array, sv_t_lo: jax.Array, coef_t: jax.Array,
+    row_tile: int = 512, sv_chunk: int = 1024, interpret: bool = False,
 ) -> jax.Array:
-    """Per-pair ovo decision values, (N, P) — fused kernel version of
-    models/svc.decision_ovo."""
+    """(N, P) K @ coef for the GIVEN pre-laid support-vector block —
+    NO intercept. Traceable building block: operands are the arrays of a
+    ``SvcPallas`` (or one state-axis shard of them —
+    parallel/svc_sharded.py calls this per device inside ``shard_map``
+    and psums the partials before adding the intercept once).
+    ``sv_t_*`` columns must be a multiple of ``sv_chunk``; padding
+    columns must carry zero coefficients (their contribution is exactly
+    zero — compile_svc's layout guarantees this)."""
     N, F = X.shape
-    TILE, SC = g.row_tile, g.sv_chunk
-    Sp = g.sv_t_hi.shape[1]
-    P = g.coef_t.shape[1]
-    if X_lo is None:
-        X_lo = jnp.zeros_like(X)
+    Sp = sv_t_hi.shape[1]
+    P = coef_t.shape[1]
+    if Sp % sv_chunk:
+        raise ValueError(
+            f"support columns {Sp} not a multiple of chunk {sv_chunk}"
+        )
+    gamma = jnp.reshape(gamma.astype(jnp.float32), (1, 1))
 
-    padded = (-N) % TILE
+    padded = (-N) % row_tile
     if padded:
         X = jnp.concatenate([X, jnp.zeros((padded, F), X.dtype)])
         X_lo = jnp.concatenate([X_lo, jnp.zeros((padded, F), X_lo.dtype)])
-    n_tiles = X.shape[0] // TILE
-    n_chunks = Sp // SC
+    n_tiles = X.shape[0] // row_tile
+    n_chunks = Sp // sv_chunk
 
     kernel = functools.partial(_kernel, n_features=F)
     out = pl.pallas_call(
@@ -132,17 +151,32 @@ def decision_ovo_pallas(
         grid=(n_tiles, n_chunks),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # gamma (1,1)
-            pl.BlockSpec((TILE, F), lambda i, s: (i, 0)),
-            pl.BlockSpec((TILE, F), lambda i, s: (i, 0)),
-            pl.BlockSpec((F, SC), lambda i, s: (0, s)),
-            pl.BlockSpec((F, SC), lambda i, s: (0, s)),
-            pl.BlockSpec((SC, P), lambda i, s: (s, 0)),
+            pl.BlockSpec((row_tile, F), lambda i, s: (i, 0)),
+            pl.BlockSpec((row_tile, F), lambda i, s: (i, 0)),
+            pl.BlockSpec((F, sv_chunk), lambda i, s: (0, s)),
+            pl.BlockSpec((F, sv_chunk), lambda i, s: (0, s)),
+            pl.BlockSpec((sv_chunk, P), lambda i, s: (s, 0)),
         ],
-        out_specs=pl.BlockSpec((TILE, P), lambda i, s: (i, 0)),
+        out_specs=pl.BlockSpec((row_tile, P), lambda i, s: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((X.shape[0], P), jnp.float32),
         interpret=interpret,
-    )(g.gamma, X, X_lo, g.sv_t_hi, g.sv_t_lo, g.coef_t)
-    return out[:N] + g.intercept[None, :]
+    )(gamma, X, X_lo, sv_t_hi, sv_t_lo, coef_t)
+    return out[:N]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decision_ovo_pallas(
+    g: SvcPallas, X: jax.Array, X_lo=None, interpret: bool = False
+) -> jax.Array:
+    """Per-pair ovo decision values, (N, P) — fused kernel version of
+    models/svc.decision_ovo."""
+    if X_lo is None:
+        X_lo = jnp.zeros_like(X)
+    out = partial_decision(
+        X, X_lo, g.gamma, g.sv_t_hi, g.sv_t_lo, g.coef_t,
+        row_tile=g.row_tile, sv_chunk=g.sv_chunk, interpret=interpret,
+    )
+    return out + g.intercept[None, :]
 
 
 def scores(g: SvcPallas, X, X_lo=None, interpret: bool = False) -> jax.Array:

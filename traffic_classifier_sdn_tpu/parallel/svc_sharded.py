@@ -49,6 +49,18 @@ def pad_support(d: dict, n_shards: int) -> dict:
     return out
 
 
+def _ovo_vote_argmax(D, vote_i, vote_j, n_classes: int):
+    """(N,) class labels from full ovo decisions — libsvm tie-break
+    (lowest class index among maxima; argmax does exactly that, matching
+    models/svc.predict). One home for the vote: both local stages (XLA
+    and fused Pallas) end here."""
+    pos = D > 0
+    votes_i = jax.nn.one_hot(vote_i, n_classes, dtype=D.dtype)
+    votes_j = jax.nn.one_hot(vote_j, n_classes, dtype=D.dtype)
+    votes = jnp.where(pos[:, :, None], votes_i, votes_j).sum(axis=1)
+    return jnp.argmax(votes, axis=-1).astype(jnp.int32)
+
+
 def sharded_predict(mesh, params: svc.Params, precise: bool = False):
     """Build a jit-compiled sharded predict: queries replicated on the
     state axis, SV state sharded. Returns ``fn(X[, X_lo]) -> (N,) int32``.
@@ -73,13 +85,7 @@ def sharded_predict(mesh, params: svc.Params, precise: bool = False):
         K = jnp.exp(-gamma * jnp.sum(diff * diff, axis=-1))
         part = jnp.matmul(K, pair_coef.T, precision=_HI)  # (N, P) partial
         D = lax.psum(part, STATE_AXIS) + intercept[None, :]
-        pos = D > 0
-        votes_i = jax.nn.one_hot(vote_i, n_classes, dtype=D.dtype)
-        votes_j = jax.nn.one_hot(vote_j, n_classes, dtype=D.dtype)
-        votes = jnp.where(pos[:, :, None], votes_i, votes_j).sum(axis=1)
-        # libsvm tie-break: lowest class index among maxima (argmax does
-        # exactly that, matching models/svc.predict)
-        return jnp.argmax(votes, axis=-1).astype(jnp.int32)
+        return _ovo_vote_argmax(D, vote_i, vote_j, n_classes)
 
     shmapped = jax.shard_map(
         local_decision,
@@ -100,3 +106,68 @@ def sharded_predict(mesh, params: svc.Params, precise: bool = False):
     if precise:
         return fn
     return lambda X: fn(X)
+
+
+def fused_predict(
+    mesh, params: svc.Params, *,
+    row_tile: int = 512, sv_chunk: int = 512, interpret: bool = False,
+):
+    """SV-sharded predict with the FUSED local stage: each chip runs the
+    Pallas RBF kernel (ops/pallas_rbf.py ``partial_decision``) over its
+    support-vector shard — the per-shard (N, S/D) kernel matrix never
+    touches HBM — then one ``psum`` merges the partial ovo decisions and
+    the intercept is added once, exactly as ``sharded_predict``.
+
+    Numerics match the single-device fused kernel (two-float difference
+    distances, highest-precision vote matmul); padding SVs carry zero
+    dual coefficients so their contribution is exactly zero (the
+    ``compile_svc`` trick, per shard). TPU-only compiled (Mosaic);
+    CPU-mesh tests pass ``interpret=True``.
+
+    Returns ``fn(X[, X_lo]) -> (N,) int32``.
+    """
+    from ..ops import pallas_rbf
+
+    n_classes = params.n_classes
+    vote_i, vote_j = params.vote_i, params.vote_j
+    intercept, gamma = params.intercept, params.gamma
+    D = mesh.shape[STATE_AXIS]
+
+    # per-shard chunk-aligned global layout (numpy, outside shard_map):
+    # every shard holds the same number of whole chunks of transposed
+    # SVs; padding slots carry zero coefficients (pallas_rbf.sv_layout
+    # owns that invariant)
+    S = np.asarray(params.sv_hi).shape[0]
+    per = -(-S // D)
+    per = -(-per // sv_chunk) * sv_chunk
+    sv_t_hi, sv_t_lo, coef_t = pallas_rbf.sv_layout(params, per * D)
+
+    def local_fused(svt_hi_l, svt_lo_l, coef_l, X, X_lo):
+        part = pallas_rbf.partial_decision(
+            X, X_lo, gamma, svt_hi_l, svt_lo_l, coef_l,
+            row_tile=row_tile, sv_chunk=sv_chunk, interpret=interpret,
+        )
+        Dv = lax.psum(part, STATE_AXIS) + intercept[None, :]
+        return _ovo_vote_argmax(Dv, vote_i, vote_j, n_classes)
+
+    shmapped = jax.shard_map(
+        local_fused,
+        mesh=mesh,
+        in_specs=(
+            P(None, STATE_AXIS),  # sv_t_hi columns = SV rows
+            P(None, STATE_AXIS),
+            P(STATE_AXIS),  # coef_t rows = SV rows
+            P(),  # X replicated
+            P(),  # X_lo replicated
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def fn(X, X_lo=None):
+        if X_lo is None:
+            X_lo = jnp.zeros_like(X)
+        return shmapped(sv_t_hi, sv_t_lo, coef_t, X, X_lo)
+
+    return fn
