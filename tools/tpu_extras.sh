@@ -7,8 +7,8 @@
 #      16k slices) -> docs/artifacts/knn_big_corpus_tpu.json
 #   3. KNN serve-tick A/B across raced top-k kernels (TCSDN_KNN_TOPK)
 #      -> docs/artifacts/serve_2m_knn_tpu_<impl>.json
-#   4. fused KNN kernel compiled inside shard_map, parity-asserted
-#      -> docs/artifacts/fused_knn_shmap_tpu.json
+#   4. fused KNN + SVC kernels compiled inside shard_map, parity-asserted
+#      -> docs/artifacts/fused_knn_shmap_tpu.json / fused_svc_shmap_tpu.json
 #   5. forest GEMM bucket-count sweep (VERDICT r3 item 5)
 #      -> docs/artifacts/forest_buckets_tpu.json
 # Each step is independently guarded; a failure skips only that step.
@@ -169,6 +169,50 @@ then
 else
   cat /tmp/tpu_fused_shmap.log
   echo "extras: fused shard_map KNN proof FAILED (skipped)"
+fi
+
+if $TMO 600 python - > /tmp/tpu_fused_svc_shmap.log 2>&1 <<'EOF'
+# compiled proof: the fused RBF-SVC kernel inside shard_map on the real
+# chip (1-device state mesh), parity-asserted vs the XLA path
+import json
+import numpy as np
+import jax, jax.numpy as jnp
+import sys, os
+sys.path.insert(0, os.getcwd())
+from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
+from traffic_classifier_sdn_tpu.models import svc
+from traffic_classifier_sdn_tpu.parallel import svc_sharded, mesh as meshlib
+
+platform = jax.devices()[0].platform
+ds = load_reference_datasets("/root/reference/datasets")
+params = svc.from_numpy(
+    ski.import_svc("/root/reference/models/SVC"), dtype=jnp.float32
+)
+m = meshlib.make_mesh(n_data=1, n_state=1, devices=jax.devices()[:1])
+fn = svc_sharded.fused_predict(m, params)
+Xhi, Xlo = svc.split_hilo(ds.X[:4096])
+got = np.asarray(fn(Xhi, Xlo))
+want = np.asarray(jax.jit(svc.predict)(params, Xhi, Xlo))
+parity = float((got == want).mean() * 100.0)
+print(json.dumps({
+    "metric": "fused_svc_shard_map_compiled",
+    "platform": platform, "rows": int(Xhi.shape[0]),
+    "parity_pct": round(parity, 3),
+}))
+# proof semantics: non-parity must fail the step, not land as a proof
+assert parity == 100.0, f"fused svc shard_map parity {parity}"
+EOF
+then
+  if grep '^{' /tmp/tpu_fused_svc_shmap.log | tail -1 \
+      | grep -q '"platform": "tpu"'; then
+    grep '^{' /tmp/tpu_fused_svc_shmap.log | tail -1 \
+      > docs/artifacts/fused_svc_shmap_tpu.json
+    echo "extras: fused shard_map SVC proof landed"
+  fi
+else
+  cat /tmp/tpu_fused_svc_shmap.log
+  echo "extras: fused shard_map SVC proof FAILED (skipped)"
 fi
 
 if $TMO 1200 python tools/bench_forest_buckets.py > /tmp/tpu_forest_buckets.log 2>&1
