@@ -81,6 +81,30 @@ def test_forest_tree_sharded_matches(reference_models_dir, X256):
     np.testing.assert_array_equal(got, want)
 
 
+def test_forest_tree_sharded_gemm_matches(reference_models_dir, X256):
+    """The MXU GEMM local stage (the serving path's formulation, per
+    shard) must predict like the single-device GEMM path and the gather
+    traversal on reference rows — tree-leading operand sharding with
+    psum'd distribution sums."""
+    from traffic_classifier_sdn_tpu.ops import tree_gemm
+
+    d = ski.import_forest(f"{reference_models_dir}/RandomForestClassifier")
+    want = np.asarray(
+        tree_gemm.predict(tree_gemm.compile_forest(d), X256)
+    )
+    single = forest.from_numpy(d, dtype=jnp.float32)
+    want_gather = np.asarray(forest.predict(single, X256))
+    np.testing.assert_array_equal(want, want_gather)
+
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    dpad = forest_sharded.pad_trees(d, 8)
+    fn = forest_sharded.gemm_sharded_predict(m, dpad)
+    got = np.asarray(fn(X256))
+    np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError, match="pad_trees"):
+        forest_sharded.gemm_sharded_predict(m, d)  # 100 trees, 8 shards
+
+
 def test_svc_state_sharded_matches(reference_models_dir, flow_dataset):
     """SV-sharded SVC must reproduce the single-device predict exactly,
     including the hi/lo precise mode on raw-scale features."""

@@ -170,13 +170,24 @@ class ForestGemmGroups(struct.PyTreeNode):
     n_classes: int = struct.field(pytree_node=False)
 
 
+def dtyped_operands(ops: dict) -> dict:
+    """Device arrays with the canonical GEMM dtypes — the ONE dtype
+    policy (path bf16: ±1 ancestor-edge sums of ints ≤ depth are exact;
+    everything else f32). ``_single_group`` and the tree-sharded layout
+    (parallel/forest_sharded.gemm_sharded_predict) both build through
+    it, so the exactness argument cannot drift between paths."""
+    return {
+        "feat_onehot": jnp.asarray(ops["feat_onehot"]),
+        "thresholds": jnp.asarray(ops["thresholds"]),
+        "path": jnp.asarray(ops["path"], jnp.bfloat16),
+        "leaf_depth": jnp.asarray(ops["leaf_depth"]),
+        "leaf_values": jnp.asarray(ops["leaf_values"]),
+    }
+
+
 def _single_group(ops: dict, row_chunk: int) -> ForestGemm:
     return ForestGemm(
-        feat_onehot=jnp.asarray(ops["feat_onehot"]),
-        thresholds=jnp.asarray(ops["thresholds"]),
-        path=jnp.asarray(ops["path"], jnp.bfloat16),
-        leaf_depth=jnp.asarray(ops["leaf_depth"]),
-        leaf_values=jnp.asarray(ops["leaf_values"]),
+        **dtyped_operands(ops),
         n_classes=ops["n_classes"],
         row_chunk=row_chunk,
     )

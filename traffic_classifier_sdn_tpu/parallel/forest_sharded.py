@@ -83,3 +83,81 @@ def sharded_predict(mesh, params: forest.Params, n_real_trees: int | None = None
         )
 
     return fn
+
+
+def gemm_sharded_predict(
+    mesh, d: dict, n_features: int | None = None, row_chunk: int = 65536
+):
+    """Tree-sharded predict with the MXU GEMM local stage: each chip
+    evaluates its sub-ensemble through the same three-GEMM formulation
+    the serving path promotes (ops/tree_gemm — the gather traversal of
+    ``sharded_predict`` is the TPU-slow path it replaced), then one
+    ``psum`` of the per-chip (N, C) distribution sums yields the exact
+    ensemble mean.
+
+    Layout: ONE single-group operand build over the whole (padded)
+    ensemble — uniform (D_max, L_max) padding, leaf values pre-divided
+    by the REAL tree count — then the tree-leading arrays shard on the
+    state axis, so every chip holds identically-shaped blocks of T/D
+    trees. ``d`` is the node-array dict (``pad_trees`` output; inert
+    padded trees carry zero values and contribute nothing).
+
+    Returns ``fn(X) -> (N,) int32``.
+    """
+    from ..ops import tree_gemm
+
+    D_mesh = mesh.shape[STATE_AXIS]
+    T = d["left"].shape[0]
+    if T % D_mesh:
+        raise ValueError(
+            f"{T} trees not divisible by {D_mesh} shards — pad_trees first"
+        )
+    n_real = int(d.get("n_real_trees", T))
+    ops = tree_gemm.build_gemm_operands(
+        d, n_features=n_features, n_trees_total=n_real
+    )
+    F = ops["feat_onehot"].shape[0]
+    Dm, n_classes = ops["n_internal"], ops["n_classes"]
+
+    def local_gemm(feat3_l, thr2_l, path_l, depth_l, vals_l, X):
+        T_l = path_l.shape[0]
+        g = tree_gemm.ForestGemm(
+            feat_onehot=feat3_l.reshape(F, T_l * Dm),
+            thresholds=thr2_l.reshape(T_l * Dm),
+            path=path_l,
+            leaf_depth=depth_l,
+            leaf_values=vals_l,
+            n_classes=n_classes,
+            row_chunk=row_chunk,
+        )
+        local_sum = tree_gemm.forest_proba_gemm(g, X)  # (N, C)
+        total = lax.psum(local_sum, STATE_AXIS)
+        return jnp.argmax(total, axis=-1).astype(jnp.int32)
+
+    shmapped = jax.shard_map(
+        local_gemm,
+        mesh=mesh,
+        in_specs=(
+            P(None, STATE_AXIS, None),  # feat_onehot as (F, T, D)
+            P(STATE_AXIS, None),  # thresholds as (T, D)
+            P(STATE_AXIS),  # path (T, D, L)
+            P(STATE_AXIS),  # leaf_depth (T, L)
+            P(STATE_AXIS),  # leaf_values (T, L, C)
+            P(),  # X replicated
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    # canonical dtypes come from tree_gemm's one policy; this layer only
+    # reshapes to tree-leading shard form
+    da = tree_gemm.dtyped_operands(ops)
+    feat3 = da["feat_onehot"].reshape(F, T, Dm)
+    thr2 = da["thresholds"].reshape(T, Dm)
+    path, depth, vals = da["path"], da["leaf_depth"], da["leaf_values"]
+
+    @jax.jit
+    def fn(X):
+        return shmapped(feat3, thr2, path, depth, vals, X)
+
+    return fn
