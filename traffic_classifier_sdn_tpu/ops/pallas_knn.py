@@ -53,14 +53,29 @@ class KnnPallas(struct.PyTreeNode):
     corpus_chunk: int = struct.field(pytree_node=False)
 
 
+def corpus_layout(fit_X, half_sq_norms, padded_rows: int):
+    """The kernel's pre-laid operands for ``padded_rows`` total corpus
+    slots: ``((F, padded) fit_t, (1, padded) half_sq)``, transposed so
+    the per-chunk similarity is one (TILE, F)·(F, CHUNK) MXU dot,
+    padding slots carrying +inf half-norms (their similarity is −inf,
+    losing every comparison; S ≥ k real rows always exist, so no padded
+    index can survive the final merge). The ONE home of that invariant —
+    ``compile_knn`` and the state-sharded layout
+    (parallel/knn_sharded.fused_predict) both build through it."""
+    fit = np.asarray(fit_X, np.float32)
+    half = np.asarray(half_sq_norms, np.float32)
+    pad = padded_rows - fit.shape[0]
+    if pad:
+        fit = np.concatenate([fit, np.zeros((pad, fit.shape[1]), np.float32)])
+        half = np.concatenate([half, np.full((pad,), np.inf, np.float32)])
+    return jnp.asarray(fit.T), jnp.asarray(half[None, :])
+
+
 def compile_knn(
     params: knn.Params, row_tile: int = 512, corpus_chunk: int = 512
 ) -> KnnPallas:
-    """Re-lay a models/knn.Params for the fused kernel: corpus transposed
-    to (F, S) so the per-chunk similarity is one (TILE, F)·(F, CHUNK)
-    MXU dot, S padded to a chunk multiple with +inf half-norms (their
-    similarity is −inf, losing every comparison; S ≥ k real rows always
-    exist, so no padded index can survive the final merge)."""
+    """Re-lay a models/knn.Params for the fused kernel: S padded to a
+    chunk multiple (+inf-half-norm padding — see ``corpus_layout``)."""
     if params.n_neighbors > corpus_chunk:
         # topk_sim_idx re-checks at call time; failing here gives the
         # error at layout time, before any padding work
@@ -73,16 +88,13 @@ def compile_knn(
             f"n_neighbors={params.n_neighbors} exceeds the kernel's "
             f"128-lane top-k carry"
         )
-    fit = np.asarray(params.fit_X, np.float32)
-    half = np.asarray(params.half_sq_norms, np.float32)
-    S = fit.shape[0]
-    pad = (-S) % corpus_chunk
-    if pad:
-        fit = np.concatenate([fit, np.zeros((pad, fit.shape[1]), np.float32)])
-        half = np.concatenate([half, np.full((pad,), np.inf, np.float32)])
+    S = np.asarray(params.fit_X).shape[0]
+    fit_t, half_sq = corpus_layout(
+        params.fit_X, params.half_sq_norms, S + (-S) % corpus_chunk
+    )
     return KnnPallas(
-        fit_t=jnp.asarray(fit.T),
-        half_sq=jnp.asarray(half[None, :]),
+        fit_t=fit_t,
+        half_sq=half_sq,
         fit_y=params.fit_y,
         n_rows=S,
         n_neighbors=int(params.n_neighbors),

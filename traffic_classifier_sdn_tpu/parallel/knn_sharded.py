@@ -327,21 +327,17 @@ def fused_predict(
         _require_pow2_state(D)
 
     # per-shard chunk-aligned global layout (numpy, outside shard_map):
-    # every shard holds the same number of whole chunks, padding rows
-    # carry +inf half-norms and lose every comparison
-    fit = np.asarray(params.fit_X, np.float32)
-    half = np.asarray(_mask_half_norms(params, pad_mask), np.float32)
-    fity = np.asarray(params.fit_y, np.int32)
-    S = fit.shape[0]
+    # every shard holds the same number of whole chunks; padding slots
+    # carry +inf half-norms (pallas_knn.corpus_layout owns that
+    # invariant) and zero labels (unreachable — their candidates lose)
+    S = np.asarray(params.fit_X).shape[0]
     per = max(-(-S // D), k)
     per = -(-per // corpus_chunk) * corpus_chunk
-    pad = per * D - S
-    if pad:
-        fit = np.concatenate([fit, np.zeros((pad, fit.shape[1]), np.float32)])
-        half = np.concatenate([half, np.full((pad,), np.inf, np.float32)])
-        fity = np.concatenate([fity, np.zeros((pad,), np.int32)])
-    fit_t = jnp.asarray(fit.T)  # (F, per·D)
-    half_sq = jnp.asarray(half[None, :])  # (1, per·D)
+    fit_t, half_sq = pallas_knn.corpus_layout(
+        params.fit_X, _mask_half_norms(params, pad_mask), per * D
+    )
+    fity = np.zeros((per * D,), np.int32)
+    fity[:S] = np.asarray(params.fit_y, np.int32)
     fit_y = jnp.asarray(fity)
 
     # packability of gidx·C+lab against the PADDED corpus length: gidx
