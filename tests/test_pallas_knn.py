@@ -131,6 +131,53 @@ def test_chunk_smaller_than_k_rejected(knn_params):
         pallas_knn.compile_knn(knn_params, corpus_chunk=4)
 
 
+def test_degenerate_corpus_fewer_rows_than_k_rejected():
+    """S < k violates the no-padded-index-survives invariant — the
+    layout would let +inf-half-norm slots reach the final top-k and
+    fit_y[idx] silently clamp to wrong labels, so compile_knn (and the
+    sharded fused path) must fail loudly like the XLA lax.top_k does."""
+    rng = np.random.RandomState(5)
+    params = _tie_params(rng, S=3, k=5)
+    with pytest.raises(ValueError, match="real rows|rows <|< n_neighbors"):
+        pallas_knn.compile_knn(params)
+
+    from traffic_classifier_sdn_tpu.parallel import (
+        knn_sharded,
+        mesh as meshlib,
+    )
+
+    m = meshlib.make_mesh(n_data=1, n_state=8)
+    with pytest.raises(ValueError, match="real rows"):
+        knn_sharded.fused_predict(m, params, interpret=True)
+    # the XLA sharded paths share the invariant through _build: their
+    # per-shard corpora are padded to >= k rows, so local top_k succeeds
+    # and padded label-0 candidates would silently bias the vote
+    padded = knn_sharded.pad_corpus(
+        {
+            "fit_X": np.asarray(params.fit_X, np.float64),
+            "y": np.asarray(params.fit_y),
+            "n_neighbors": 5,
+            "classes": np.arange(6),
+        },
+        n_shards=8,
+    )
+    pparams = knn.from_numpy(padded, dtype=jnp.float32)
+    for entry in (
+        knn_sharded.sharded_predict,
+        knn_sharded.ring_predict,
+        knn_sharded.tournament_predict,
+    ):
+        with pytest.raises(ValueError, match="real rows"):
+            entry(m, pparams, pad_mask=padded["pad_mask"])
+    # a pad_mask that leaves < k REAL rows is the same violation even
+    # when the raw corpus is larger
+    params9 = _tie_params(rng, S=9, k=5)
+    mask = np.zeros(9, bool)
+    mask[4:] = True  # 4 real rows < k=5
+    with pytest.raises(ValueError, match="real rows"):
+        knn_sharded.fused_predict(m, params9, pad_mask=mask, interpret=True)
+
+
 def test_sharded_fused_matches_single_device():
     """The fused local stage composed with the all_gather merge
     (parallel/knn_sharded.fused_predict) predicts bit-identically to
@@ -138,7 +185,11 @@ def test_sharded_fused_matches_single_device():
     contiguous corpus ranges and the kernel's in-shard tie order is
     lax.top_k's, so the gathered merge preserves the global tie-break.
     Adversarial few-distinct-value corpus; 900 rows across 8 shards
-    also exercises per-shard chunk padding (113 -> 128 per shard)."""
+    also exercises the TAIL-CONCENTRATED chunk padding: each shard spans
+    128 slots but corpus_layout pads only after global row 899, so
+    shards 0-6 are fully real and shard 7 holds 4 real + 124 pad rows —
+    a shard with fewer than k real rows is legal (its -inf candidates
+    lose every merge; the global S >= k invariant carries correctness)."""
     from traffic_classifier_sdn_tpu.parallel import (
         knn_sharded,
         mesh as meshlib,

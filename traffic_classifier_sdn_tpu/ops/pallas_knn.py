@@ -89,6 +89,15 @@ def compile_knn(
             f"128-lane top-k carry"
         )
     S = np.asarray(params.fit_X).shape[0]
+    if S < params.n_neighbors:
+        # The no-padded-index-survives invariant (corpus_layout) requires
+        # >= k real rows; with fewer, padded +inf-half-norm slots reach
+        # the final top-k and fit_y[idx] silently clamps to wrong labels
+        # where the XLA path's lax.top_k fails loudly. Enforce, don't
+        # assume.
+        raise ValueError(
+            f"corpus has {S} rows < n_neighbors={params.n_neighbors}"
+        )
     fit_t, half_sq = corpus_layout(
         params.fit_X, params.half_sq_norms, S + (-S) % corpus_chunk
     )

@@ -54,6 +54,25 @@ def _mask_half_norms(params: knn.Params, pad_mask):
     return half
 
 
+def _check_real_rows(params: knn.Params, pad_mask) -> None:
+    """Every sharded path's correctness rests on >= k REAL corpus rows
+    GLOBALLY (padded/masked rows carry -inf candidates that lose every
+    merge — but only if enough real candidates exist to beat them).
+    With fewer, padded candidates reach the vote carrying label 0 and
+    bias it silently, where single-device ``lax.top_k`` fails loudly —
+    so enforce the invariant at build time, in the scaffolding every
+    entry point shares."""
+    import numpy as np
+
+    S = np.asarray(params.fit_X).shape[0]
+    k = int(params.n_neighbors)
+    real = S if pad_mask is None else int(S - np.asarray(pad_mask).sum())
+    if real < k:
+        raise ValueError(
+            f"corpus has {real} real rows < n_neighbors={k}"
+        )
+
+
 def _local_topk(fit_X, fit_y, half_norms, X, k):
     """Per-chip candidates: (val, label, global corpus index), each (N, k).
 
@@ -94,6 +113,7 @@ def _gather_merge_vote(val, lab, k: int, n_classes: int):
 def _build(mesh, params: knn.Params, pad_mask, local_fn):
     """Common scaffolding: shard the corpus on the state axis, replicate
     the queries, jit the shard_mapped kernel."""
+    _check_real_rows(params, pad_mask)
     in_specs = (
         P(STATE_AXIS),  # fit_X rows
         P(STATE_AXIS),  # fit_y
@@ -326,10 +346,16 @@ def fused_predict(
     if merge == "tournament":
         _require_pow2_state(D)
 
-    # per-shard chunk-aligned global layout (numpy, outside shard_map):
-    # every shard holds the same number of whole chunks; padding slots
-    # carry +inf half-norms (pallas_knn.corpus_layout owns that
-    # invariant) and zero labels (unreachable — their candidates lose)
+    # chunk-aligned global layout (numpy, outside shard_map): every shard
+    # spans the same number of whole chunks, but the padding itself is
+    # TAIL-CONCENTRATED — corpus_layout pads only after row S, before the
+    # contiguous split, so e.g. S=900, D=8 gives shards 0-6 fully real and
+    # shard 7 with 4 real + 124 pad rows. A shard may hold fewer than k
+    # (or zero) real rows; that is legal because padded slots carry +inf
+    # half-norms (pallas_knn.corpus_layout owns that invariant) and zero
+    # labels, so their -inf candidates lose every merge — correctness
+    # rests on the GLOBAL S >= k invariant, not per-shard balance.
+    _check_real_rows(params, pad_mask)
     S = np.asarray(params.fit_X).shape[0]
     per = max(-(-S // D), k)
     per = -(-per // corpus_chunk) * corpus_chunk

@@ -118,10 +118,15 @@ def fused_predict(
     touches HBM — then one ``psum`` merges the partial ovo decisions and
     the intercept is added once, exactly as ``sharded_predict``.
 
-    Numerics match the single-device fused kernel (two-float difference
-    distances, highest-precision vote matmul); padding SVs carry zero
-    dual coefficients so their contribution is exactly zero (the
-    ``compile_svc`` trick, per shard). TPU-only compiled (Mosaic);
+    Same per-element math as the single-device fused kernel (two-float
+    difference distances, highest-precision vote matmul) — but the f32
+    ACCUMULATION ORDER differs with sharding and chunking (sv_chunk here
+    defaults to 512 vs compile_svc's 1024, and psum ordering is the
+    mesh's), so decision values can differ in the last ulp across
+    shard/chunk configurations; label parity is verified on the reference
+    data but is not guaranteed at exact vote boundaries. Padding SVs
+    carry zero dual coefficients so their contribution is exactly zero
+    (the ``compile_svc`` trick, per shard). TPU-only compiled (Mosaic);
     CPU-mesh tests pass ``interpret=True``.
 
     Returns ``fn(X[, X_lo]) -> (N,) int32``.
