@@ -3,7 +3,7 @@
 #   1. quick liveness probe (exits 1 fast if the worker is wedged)
 #   2. serve bench on TPU   -> docs/artifacts/serve_2m_tpu.json
 #   3. tools/bench_e2e.py   -> docs/artifacts/e2e_budget_tpu.json
-#   4. bench.py             -> docs/artifacts/bench_tpu_r04.{json,log}
+#   4. bench.py             -> docs/artifacts/bench_tpu_r05.{json,log}
 #   5. tools/tpu_proof.py   -> docs/artifacts/tpu_proof.json
 # Order is risk-ascending: the serve tick and e2e budget use short
 # kernels and land the scarcest artifacts first; the bench ladder's
@@ -70,9 +70,9 @@ export TCSDN_BENCH_BUDGET
 run_step 1900 /tmp/tpu_day_bench.log python bench.py
 if [ "$STEP_OK" = 1 ] \
     && grep -q '"platform": "tpu"' /tmp/tpu_day_bench.log; then
-  cp /tmp/tpu_day_bench.log docs/artifacts/bench_tpu_r04.log
+  cp /tmp/tpu_day_bench.log docs/artifacts/bench_tpu_r05.log
   grep '^{' /tmp/tpu_day_bench.log | tail -1 \
-    > docs/artifacts/bench_tpu_r04.json
+    > docs/artifacts/bench_tpu_r05.json
 fi
 
 run_step 1500 /tmp/tpu_day_proof.log python tools/tpu_proof.py
