@@ -146,6 +146,43 @@ def _timed_loop(predict_sum, params, X, iters: int,
         iters = min(iters * grow, cap)
 
 
+def _timed_host(call, min_signal: float | None = None) -> float:
+    """Median per-call seconds for a host-native callable, held to the
+    same bar as ``_timed_loop``: reps-per-timing escalate until one timed
+    group clears ``min_signal``, medians over REPEATS — a microsecond
+    call must not win a race on timer jitter."""
+    if min_signal is None:
+        min_signal = MIN_SIGNAL
+    call()  # warm
+    reps = 1
+    while True:
+        times = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                call()
+            times.append(time.perf_counter() - t0)
+        med = float(np.median(times))
+        if med >= min_signal or reps >= (1 << 17):
+            return max(med, 1e-12) / reps
+        reps = min(
+            reps * max(2, int(np.ceil(2 * min_signal / max(med, 1e-9)))),
+            1 << 17,
+        )
+
+
+def _e2e_host(call) -> float:
+    """p50 of single host-native calls (the per-batch cost a serving
+    loop pays) — mirrors ``_e2e_p50``'s median-of-9 methodology."""
+    call()
+    times = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        call()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
 def _e2e_p50(one, *args) -> float:
     """p50 of single-batch predict + scalar fetch (the per-batch host
     round trip a real serving loop pays)."""
@@ -336,12 +373,17 @@ def measure(batches: list[int]) -> None:
         except Exception:  # noqa: BLE001 — pointer is best-effort
             pass
 
-    # CPU race entrant: the gather traversal (ops/tree_eval.py) is the
-    # CPU-native formulation; the MXU-shaped GEMM pads ~50× the useful
-    # node FLOPs and loses on host (r04 official: 0.22× via GEMM-only)
+    # CPU race entrants: the gather traversal (ops/tree_eval.py) is the
+    # CPU-native XLA formulation (the MXU-shaped GEMM pads ~50× the
+    # useful node FLOPs and loses on host — r04 official: 0.22× via
+    # GEMM-only), and the native C++ walk (native/forest_eval.cpp) is
+    # the host-spine evaluator racing sklearn's Cython walk on its own
+    # terms: host memory in, labels out, one core
     gather_params = None
+    native_f = None
     ladder_gather: dict = {}
     ladder_gemm: dict = {}
+    ladder_native: dict = {}
     if not on_tpu:
         from traffic_classifier_sdn_tpu.models import forest as forest_mod
 
@@ -349,6 +391,15 @@ def measure(batches: list[int]) -> None:
 
         def gather_sum(p, X):
             return jnp.sum(forest_mod.predict(p, X)).astype(jnp.float32)
+
+        try:
+            from traffic_classifier_sdn_tpu.native import (
+                forest as native_forest,
+            )
+
+            native_f = native_forest.NativeForest(forest_raw)
+        except Exception as e:  # noqa: BLE001 — g++/build may be absent
+            line["native_forest_error"] = f"{type(e).__name__}: {e}"[:120]
 
     # --- 1. forest ladder, smallest batch first --------------------------
     ladder: dict = {}
@@ -372,9 +423,21 @@ def measure(batches: list[int]) -> None:
                 path_b, win_sum, win_params = (
                     "xla_gather_traversal", gather_sum, gather_params
                 )
+        if native_f is not None:
+            print(f"# native C++ walk at batch {b}", flush=True)
+            Xn = X_big[:b]
+            t_nat = _timed_host(lambda: native_f.predict(Xn))
+            ladder_native[str(b)] = round(t_nat * 1e3, 3)
+            if t_nat < sec:
+                sec = t_nat
+                path_b = "native_cpp_walk"
 
-        one = jax.jit(lambda p, Xb, _f=win_sum: _f(p, Xb))
-        e2e = _e2e_p50(one, win_params, X)
+        if path_b == "native_cpp_walk":
+            # host memory in, labels out: the walk IS the end-to-end path
+            e2e = _e2e_host(lambda: native_f.predict(X_big[:b]))
+        else:
+            one = jax.jit(lambda p, Xb, _f=win_sum: _f(p, Xb))
+            e2e = _e2e_p50(one, win_params, X)
         ladder[str(b)] = round(sec * 1e3, 3)
         fps = b / sec
         if best is None or fps > best[0]:
@@ -392,6 +455,8 @@ def measure(batches: list[int]) -> None:
         if ladder_gather:
             line["latency_ladder_gather_device_ms"] = ladder_gather
             line["latency_ladder_gemm_device_ms"] = ladder_gemm
+        if ladder_native:
+            line["latency_ladder_native_cpp_ms"] = ladder_native
         if best[4].startswith("xla_tree_gemm"):
             # the FLOPs diagnostic describes the GEMM operand shapes —
             # meaningless when the gather traversal holds the headline
@@ -436,6 +501,14 @@ def measure(batches: list[int]) -> None:
         gpct = float((got_ga == want_forest).mean() * 100.0)
         line["parity_forest_gather_pct"] = round(gpct, 3)
         fpct = min(fpct, gpct)
+    if native_f is not None:
+        # so can the native C++ walk — same bar (vs the independent
+        # numpy oracle, full reference rows; exactness argument in
+        # native/forest_eval.cpp: bitwise-identical float64 addends)
+        got_nat = native_f.predict(ds.X.astype(np.float32))
+        npct = float((got_nat == want_forest).mean() * 100.0)
+        line["parity_forest_native_pct"] = round(npct, 3)
+        fpct = min(fpct, npct)
     line["parity_rows"] = int(ds.X.shape[0])
     # parity_ok only appears once BOTH gates have run — a watchdog kill
     # between the two emits must not leave a half-checked ok=true line

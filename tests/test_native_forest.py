@@ -1,0 +1,220 @@
+"""Native C++ forest evaluator (native/forest_eval.cpp) vs the oracles.
+
+The evaluator claims BITWISE argmax parity with the numpy level-synchronous
+oracle (bench._numpy_forest_labels): identical float64 addends accumulated
+in identical tree order, first-max argmax. These tests assert that against
+the reference checkpoint, against freshly-fit irregular sklearn forests
+(variable leaf depths, padded node arrays — the shapes the DFS-preorder
+re-layout must survive), and on adversarial exact ties.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.native import forest as native_forest
+
+pytestmark = pytest.mark.skipif(
+    not native_forest.available(),
+    reason="g++ build unavailable",
+)
+
+
+@pytest.fixture(scope="module")
+def forest_dict(reference_models_dir):
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+
+    return ski.import_forest(
+        os.path.join(reference_models_dir, "RandomForestClassifier")
+    )
+
+
+def _oracle(d, X):
+    import bench
+
+    return bench._numpy_forest_labels(d, np.asarray(X, np.float64))
+
+
+def _dict_from_sklearn(est):
+    """Node arrays in the importer's (T, M) padded layout, straight from
+    freshly-fit sklearn trees — irregular depths, real padding."""
+    trees = [e.tree_ for e in est.estimators_]
+    T, M = len(trees), max(t.node_count for t in trees)
+    C = est.n_classes_
+    left = np.full((T, M), -1, np.int32)
+    right = np.full((T, M), -1, np.int32)
+    feature = np.zeros((T, M), np.int32)
+    threshold = np.zeros((T, M))
+    values = np.zeros((T, M, C))
+    for i, t in enumerate(trees):
+        nc = t.node_count
+        left[i, :nc] = t.children_left
+        right[i, :nc] = t.children_right
+        feature[i, :nc] = np.maximum(t.feature, 0)  # leaves: -2 -> 0
+        threshold[i, :nc] = t.threshold
+        values[i, :nc] = t.value.reshape(nc, C)
+    return {
+        "left": left, "right": right, "feature": feature,
+        "threshold": threshold, "values": values,
+        "max_depth": max(t.max_depth for t in trees),
+        "classes": np.arange(C), "n_features": est.n_features_in_,
+    }
+
+
+def test_parity_reference_rows(forest_dict, flow_dataset):
+    f = native_forest.NativeForest(forest_dict)
+    got = f.predict(flow_dataset.X.astype(np.float32))
+    want = _oracle(forest_dict, flow_dataset.X)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_parity_vs_xla_gather(forest_dict, flow_dataset):
+    """Same labels as the XLA gather traversal (the semantic reference
+    every TPU kernel is tested against) on the bench's own float
+    distribution."""
+    import jax
+    import jax.numpy as jnp
+
+    from traffic_classifier_sdn_tpu.models import forest as forest_mod
+
+    rng = np.random.RandomState(0)
+    X = np.abs(rng.gamma(1.5, 200.0, (2048, 12))).astype(np.float32)
+    f = native_forest.NativeForest(forest_dict)
+    p = forest_mod.from_numpy(forest_dict, dtype=jnp.float32)
+    got = f.predict(X)
+    want = np.asarray(jax.jit(forest_mod.predict)(p, jnp.asarray(X)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fuzz_irregular_sklearn_forests():
+    """Freshly-fit forests: variable leaf depths, (T, M) padding, tied
+    duplicate rows — walked by both the C++ evaluator and the numpy
+    oracle, including far-out-of-training-range queries."""
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    from sklearn.ensemble import RandomForestClassifier
+
+    rng = np.random.RandomState(42)
+    for trial in range(4):
+        n = 300 + 50 * trial
+        # few distinct feature values -> massively tied thresholds
+        Xt = rng.randint(0, 5, (n, 12)).astype(np.float64)
+        yt = rng.randint(0, 4, n)
+        est = RandomForestClassifier(
+            n_estimators=5 + trial * 3,
+            max_depth=None if trial % 2 else 4,
+            random_state=trial,
+        ).fit(Xt, yt)
+        d = _dict_from_sklearn(est)
+        f = native_forest.NativeForest(d)
+        Xq = np.concatenate([
+            rng.randint(0, 5, (256, 12)).astype(np.float32),
+            (rng.rand(64, 12) * 1e6).astype(np.float32),
+            np.zeros((8, 12), np.float32),
+        ])
+        np.testing.assert_array_equal(
+            f.predict(Xq), _oracle(d, Xq), err_msg=f"{trial=}"
+        )
+
+
+def test_argmax_first_max_on_exact_ties():
+    """Two single-split trees whose leaf distributions sum to exact ties:
+    np.argmax takes the first maximum, and so must the C++ walk."""
+    # both trees: root splits feature 0 at 10.0; leaves vote classes
+    # (1,2) and (2,1) with weight 1 -> summed dist ties classes 1 and 2
+    left = np.array([[1, -1, -1]] * 2, np.int32)
+    right = np.array([[2, -1, -1]] * 2, np.int32)
+    feature = np.zeros((2, 3), np.int32)
+    threshold = np.array([[10.0, 0.0, 0.0]] * 2)
+    values = np.zeros((2, 3, 4))
+    values[0, 1] = [0, 4, 0, 0]   # tree0 left leaf -> class 1
+    values[0, 2] = [0, 0, 4, 0]   # tree0 right leaf -> class 2
+    values[1, 1] = [0, 0, 4, 0]   # tree1 left leaf -> class 2
+    values[1, 2] = [0, 4, 0, 0]   # tree1 right leaf -> class 1
+    d = {
+        "left": left, "right": right, "feature": feature,
+        "threshold": threshold, "values": values, "max_depth": 1,
+        "classes": np.arange(4), "n_features": 12,
+    }
+    f = native_forest.NativeForest(d)
+    X = np.zeros((2, 12), np.float32)
+    X[1, 0] = 99.0  # row 0 goes left+left, row 1 right+right: both tie
+    got = f.predict(X)
+    np.testing.assert_array_equal(got, _oracle(d, X))
+    assert (got == 1).all()  # first maximum, never class 2
+
+
+def test_nonfinite_features_match_oracle(forest_dict):
+    """-inf / NaN / +inf feature values: numpy's `x <= thr` is True for
+    -inf and False for NaN, and the walk must terminate at a real leaf
+    either way — the leaf sentinel is a NaN threshold precisely so a
+    -inf query cannot defeat the self-loop and march off the node array."""
+    f = native_forest.NativeForest(forest_dict)
+    X = np.zeros((6, 12), np.float32)
+    X[0, :] = -np.inf
+    X[1, :] = np.inf
+    X[2, :] = np.nan
+    X[3, 0] = -np.inf
+    X[4, 5] = np.nan
+    X[5, 11] = np.inf
+    np.testing.assert_array_equal(f.predict(X), _oracle(forest_dict, X))
+
+
+def test_narrow_feature_matrix_rejected(forest_dict):
+    f = native_forest.NativeForest(forest_dict)
+    with pytest.raises(ValueError, match="too narrow"):
+        f.predict(np.zeros((4, 8), np.float32))
+    with pytest.raises(ValueError, match="too narrow"):
+        f.predict_proba(np.zeros((4, 3), np.float32))
+
+
+def test_degenerate_single_node_trees():
+    """Root-is-leaf trees (sklearn produces them on constant labels)."""
+    d = {
+        "left": np.full((3, 1), -1, np.int32),
+        "right": np.full((3, 1), -1, np.int32),
+        "feature": np.zeros((3, 1), np.int32),
+        "threshold": np.zeros((3, 1)),
+        "values": np.array([[[5.0, 1.0]], [[0.0, 3.0]], [[2.0, 2.0]]]),
+        "max_depth": 0, "classes": np.arange(2), "n_features": 12,
+    }
+    f = native_forest.NativeForest(d)
+    X = np.ones((7, 12), np.float32)
+    np.testing.assert_array_equal(f.predict(X), _oracle(d, X))
+
+
+def test_predict_proba_matches_oracle_distribution(forest_dict,
+                                                   flow_dataset):
+    """tcf_proba returns the oracle's mean normalized distribution
+    bitwise (same addends, same order, same /T)."""
+    import bench
+
+    X = flow_dataset.X[:512]
+    f = native_forest.NativeForest(forest_dict)
+    got = f.predict_proba(X.astype(np.float32))
+    d = forest_dict
+    n_trees = d["left"].shape[0]
+    probs = np.zeros((X.shape[0], d["values"].shape[2]))
+    rows = np.arange(X.shape[0])
+    for t in range(n_trees):
+        left, right = d["left"][t], d["right"][t]
+        feat, thr, vals = d["feature"][t], d["threshold"][t], d["values"][t]
+        node = np.zeros(X.shape[0], np.int64)
+        active = left[node] != -1
+        while active.any():
+            fi = feat[node]
+            go_left = X[rows, fi] <= thr[node]
+            node = np.where(
+                active, np.where(go_left, left[node], right[node]), node
+            )
+            active = left[node] != -1
+        v = vals[node]
+        probs += v / v.sum(axis=1, keepdims=True)
+    np.testing.assert_array_equal(got, probs / n_trees)
+    # and the labels the bench gate asserts are argmax of exactly this
+    np.testing.assert_array_equal(
+        f.predict(X.astype(np.float32)),
+        bench._numpy_forest_labels(d, X),
+    )

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import ctypes as ct
 import os
-import subprocess
 import threading
 from typing import Iterable
 
@@ -25,49 +24,23 @@ import numpy as np
 
 from ..core import flow_table as ft
 from ..ingest.protocol import TelemetryRecord, format_line
+from .loader import LazyLib
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "flow_engine.cpp")
 _LIB = os.path.join(_DIR, "_flow_engine.so")
+_lazy = LazyLib(_SRC, _LIB, "native flow engine",
+                flags=("-O3", "-pthread"))
 _lock = threading.Lock()
 _lib = None
-_build_error: str | None = None
-
-
-def _build() -> None:
-    # Compile to a temp path and rename into place: atomic, so concurrent
-    # processes never dlopen a half-written .so.
-    tmp = f"{_LIB}.{os.getpid()}.tmp"
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-pthread", "-fPIC", "-shared",
-             "-o", tmp, _SRC],
-            check=True,
-            capture_output=True,
-            text=True,
-        )
-        os.replace(tmp, _LIB)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
 
 
 def _load():
-    global _lib, _build_error
+    global _lib
     with _lock:
         if _lib is not None:
             return _lib
-        if _build_error is not None:
-            raise RuntimeError(_build_error)
-        try:
-            if (not os.path.exists(_LIB)
-                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
-                _build()
-            lib = ct.CDLL(_LIB)
-        except (OSError, subprocess.CalledProcessError) as e:
-            detail = getattr(e, "stderr", "") or str(e)
-            _build_error = f"native flow engine unavailable: {detail}"
-            raise RuntimeError(_build_error) from e
+        lib = _lazy.load()  # build machinery shared with forest.py
         lib.tc_engine_create.restype = ct.c_void_p
         lib.tc_engine_create.argtypes = [ct.c_uint32, ct.c_uint32]
         lib.tc_engine_destroy.argtypes = [ct.c_void_p]

@@ -1,0 +1,155 @@
+"""ctypes bindings for the native (C++) random-forest evaluator.
+
+The host-spine predict path: the reference's production compute is
+sklearn's Cython ``Tree.predict`` on CPU, one flow per call
+(``/root/reference/traffic_classifier.py:103-106``); this evaluator is the
+framework's native equivalent for accelerator-less deployments, and the
+honest CPU entrant ``bench.py`` races against that exact sklearn path on
+outage rounds. The TPU kernels (ops/tree_gemm.py, ops/pallas_forest.py)
+remain the production path on chip.
+
+Exactness: the caller hands over the checkpoint's raw (T, M) node arrays
+plus float64 normalized leaf distributions computed in numpy — the same
+addends, added in the same tree order, as the level-synchronous oracle in
+``bench._numpy_forest_labels`` — so argmax parity is bitwise, not
+approximate (asserted in tests/test_native_forest.py).
+
+Built lazily with g++ on first use, same pattern as engine.py (no
+pybind11 in this image; plain C ABI + ctypes). ``available()`` reports
+whether a build is possible so callers can gate to other paths.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import threading
+
+import numpy as np
+
+from .loader import LazyLib
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lazy = LazyLib(
+    os.path.join(_DIR, "forest_eval.cpp"),
+    os.path.join(_DIR, "_forest_eval.so"),
+    "native forest evaluator",
+)
+_lock = threading.Lock()
+_lib = None
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        lib = _lazy.load()  # build machinery shared with engine.py
+        lib.tcf_create.restype = ct.c_void_p
+        lib.tcf_create.argtypes = [
+            ct.c_uint32, ct.c_uint32, ct.c_uint32,
+            ct.c_void_p, ct.c_void_p, ct.c_void_p, ct.c_void_p, ct.c_void_p,
+        ]
+        lib.tcf_destroy.argtypes = [ct.c_void_p]
+        lib.tcf_predict.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
+        ]
+        lib.tcf_proba.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+class NativeForest:
+    """A compiled forest handle. Arrays are copied into the library at
+    construction; the handle is freed on GC or explicit ``close()``."""
+
+    def __init__(self, d: dict):
+        lib = _load()
+        feature = np.ascontiguousarray(d["feature"], np.int32)
+        threshold = np.ascontiguousarray(d["threshold"], np.float32)
+        left = np.ascontiguousarray(d["left"], np.int32)
+        right = np.ascontiguousarray(d["right"], np.int32)
+        values = np.asarray(d["values"], np.float64)  # (T, M, C)
+        T, M = left.shape
+        if M > 32767:
+            raise ValueError(f"nodes per tree {M} exceeds int16 layout")
+        # the oracle's addends, precomputed: v / v.sum() in float64;
+        # padded slots (zero rows) are unreachable — zero their dists so
+        # no NaN can exist in the library even in principle
+        sums = values.sum(axis=2, keepdims=True)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            leaf = np.where(sums > 0, values / sums, 0.0)
+        leaf = np.ascontiguousarray(leaf)
+        self._lib = lib
+        self.n_classes = int(values.shape[2])
+        # narrower X would make the walk read across row boundaries
+        # silently — record the minimum width and refuse at call time
+        interior = left != -1
+        self.min_features = (
+            int(feature[interior].max()) + 1 if interior.any() else 1
+        )
+        self.n_features = int(d.get("n_features", self.min_features))
+        self._h = lib.tcf_create(
+            T, M, self.n_classes,
+            feature.ctypes.data_as(ct.c_void_p),
+            threshold.ctypes.data_as(ct.c_void_p),
+            left.ctypes.data_as(ct.c_void_p),
+            right.ctypes.data_as(ct.c_void_p),
+            leaf.ctypes.data_as(ct.c_void_p),
+        )
+        if not self._h:
+            raise RuntimeError("tcf_create rejected the forest layout")
+
+    def _check_width(self, X: np.ndarray) -> None:
+        if X.ndim != 2 or X.shape[1] < self.min_features:
+            raise ValueError(
+                f"X shape {X.shape} too narrow: forest reads feature "
+                f"indices up to {self.min_features - 1}"
+            )
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """(N, F) float32 features -> (N,) int32 class indices."""
+        X = np.ascontiguousarray(X, np.float32)
+        self._check_width(X)
+        out = np.empty(X.shape[0], np.int32)
+        self._lib.tcf_predict(
+            self._h,
+            X.ctypes.data_as(ct.c_void_p),
+            X.shape[0], X.shape[1],
+            out.ctypes.data_as(ct.c_void_p),
+        )
+        return out
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        """(N, F) float32 -> (N, C) float64 mean class distributions."""
+        X = np.ascontiguousarray(X, np.float32)
+        self._check_width(X)
+        out = np.empty((X.shape[0], self.n_classes), np.float64)
+        self._lib.tcf_proba(
+            self._h,
+            X.ctypes.data_as(ct.c_void_p),
+            X.shape[0], X.shape[1],
+            out.ctypes.data_as(ct.c_void_p),
+        )
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tcf_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

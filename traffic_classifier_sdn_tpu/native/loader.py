@@ -1,0 +1,69 @@
+"""Shared lazy g++ build + dlopen for the native host-spine libraries.
+
+One home for the build machinery engine.py and forest.py both need (no
+pybind11 in this image; plain C ABI + ctypes): compile on first use to a
+temp path and atomically rename into place (concurrent processes never
+dlopen a half-written .so), rebuild when the source is newer, cache the
+CDLL and any build failure per process.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import subprocess
+import threading
+
+
+class LazyLib:
+    def __init__(self, src: str, lib: str, name: str,
+                 flags: tuple[str, ...] = ("-O3",)):
+        self._src = src
+        self._lib_path = lib
+        self._name = name
+        self._flags = flags
+        self._lock = threading.Lock()
+        self._lib: ct.CDLL | None = None
+        self._error: str | None = None
+
+    def _build(self) -> None:
+        tmp = f"{self._lib_path}.{os.getpid()}.tmp"
+        try:
+            subprocess.run(
+                ["g++", *self._flags, "-std=c++17", "-fPIC", "-shared",
+                 "-o", tmp, self._src],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+            os.replace(tmp, self._lib_path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def load(self) -> ct.CDLL:
+        """The CDLL, building/rebuilding first if needed. Raises
+        RuntimeError (cached) when no build is possible."""
+        with self._lock:
+            if self._lib is not None:
+                return self._lib
+            if self._error is not None:
+                raise RuntimeError(self._error)
+            try:
+                if (not os.path.exists(self._lib_path)
+                        or os.path.getmtime(self._lib_path)
+                        < os.path.getmtime(self._src)):
+                    self._build()
+                self._lib = ct.CDLL(self._lib_path)
+            except (OSError, subprocess.CalledProcessError) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                self._error = f"{self._name} unavailable: {detail}"
+                raise RuntimeError(self._error) from e
+            return self._lib
+
+    def available(self) -> bool:
+        try:
+            self.load()
+            return True
+        except RuntimeError:
+            return False
