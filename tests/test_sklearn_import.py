@@ -119,6 +119,26 @@ def test_serving_kernel_selection_env(reference_models_dir, flow_dataset,
             np.asarray(fn(p, X)), np.asarray(m.predict(m.params, X)),
             err_msg=kernel,
         )
+    from traffic_classifier_sdn_tpu.native import forest as native_forest
+
+    if native_forest.available():
+        # the C++ host walk: same labels as the canonical predict. It is
+        # host_native BY CONTRACT — callers (cli.py, bench_serve) must
+        # check the flag and skip jit: any async dispatch of the host
+        # call (even an eager pure_callback) can deadlock a pipelined
+        # single-core serving loop behind its own input's producer.
+        monkeypatch.setenv("TCSDN_FOREST_KERNEL", "native")
+        m = load_reference_model(
+            "Randomforest",
+            f"{reference_models_dir}/RandomForestClassifier",
+        )
+        fn, p = m.serving_path()
+        assert getattr(fn, "host_native", False)
+        want_n = np.asarray(m.predict(m.params, X))
+        np.testing.assert_array_equal(
+            np.asarray(fn(p, X)), want_n, err_msg="native"
+        )
+
     for impl in ("argmax", "hier", "hier512"):
         monkeypatch.setenv("TCSDN_KNN_TOPK", impl)
         m = load_reference_model(

@@ -114,7 +114,15 @@ def main() -> None:
         }[args.model]
         m = load_reference_model(sub, f"{models_dir}/{ck}")
         raw_predict, params = m.serving_path()
-        predict = jax.jit(raw_predict)
+        if getattr(raw_predict, "host_native", False):
+            # eager by contract (see models/__init__ native branch): a
+            # jitted host callback deadlocks pipelined single-core loops
+            predict = raw_predict
+            if args.shards >= 1:
+                sys.exit("TCSDN_FOREST_KERNEL=native is single-device "
+                         "host serving; use a device kernel with --shards")
+        else:
+            predict = jax.jit(raw_predict)
     else:
         # 6-class GNB params (synthetic moments — the model family is the
         # cheapest full-table predict; the forest/SVC cost is bench.py's job)

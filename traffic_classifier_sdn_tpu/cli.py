@@ -287,7 +287,13 @@ def _run_classify(args) -> None:
     # the serving-optimized (predict_fn, params) pair, resolved as one
     # unit (GEMM-form forest, chunked KNN/SVC; canonical otherwise)
     serve_fn, serve_params = model.serving_path()
-    predict = jax.jit(serve_fn)
+    # host-native serving fns (TCSDN_FOREST_KERNEL=native) run eagerly:
+    # jitting them queues the host callback on the XLA CPU pool behind
+    # its own input's producer — a deadlock on single-core hosts
+    predict = (
+        serve_fn if getattr(serve_fn, "host_native", False)
+        else jax.jit(serve_fn)
+    )
 
     from .utils.metrics import global_metrics as m
 
@@ -315,6 +321,13 @@ def _run_classify(args) -> None:
         from .parallel import mesh as meshlib
         from .parallel import table_sharded as tsh
 
+        if getattr(serve_fn, "host_native", False):
+            # the sharded engine jits + shard_maps predict_fn — the one
+            # thing the host_native contract forbids (models/__init__)
+            sys.exit(
+                "TCSDN_FOREST_KERNEL=native is single-device host "
+                "serving; use a device kernel with --shards"
+            )
         if args.table_rows <= 0:
             # the sharded render merges bounded per-shard candidates; an
             # unbounded ("0 = all") table would be an O(capacity) fetch

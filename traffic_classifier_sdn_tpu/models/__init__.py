@@ -55,7 +55,9 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
     - ``TCSDN_FOREST_KERNEL`` ∈ ``gemm`` (default, size-bucketed v1) |
       ``gemm_v2_dot`` | ``gemm_v2_gather`` (ops/tree_gemm v2 layouts) |
       ``pallas`` | ``pallas_fast`` (the fused kernel; TPU-only —
-      Mosaic does not compile on CPU hosts).
+      Mosaic does not compile on CPU hosts) | ``native`` (the C++
+      host-spine walk as a plain host call for accelerator-less hosts;
+      marked ``host_native`` — callers must NOT jit or shard_map it).
     - ``TCSDN_KNN_TOPK`` ∈ ``sort`` (default) | ``argmax`` | ``hier`` or
       ``hier<group>`` (e.g. ``hier512``; group in [n_neighbors, 65536]) |
       ``pallas`` (ops/pallas_knn fused distance+top-k kernel; TPU-only —
@@ -115,6 +117,31 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
                 node_arrays, n_buckets=8, n_features=NUM_FEATURES,
                 fast_stages=kernel == "pallas_fast",
             )
+        if kernel == "native":
+            # host-spine C++ walk (native/forest_eval.cpp) for
+            # accelerator-less serving hosts — it beats sklearn's Cython
+            # walk ~2× on one core. Marked ``host_native``: a plain host
+            # function, NEVER jitted (callers check the flag). It is
+            # deliberately NOT a jax.pure_callback: callback custom-calls
+            # — jitted OR eager — dispatch asynchronously through the XLA
+            # CPU runtime, and in a pipelined serving loop the callback
+            # can queue on the thread pool BEHIND its own input's
+            # producer, a deterministic deadlock on a single-core host at
+            # the second tick (observed; a single-shot call works, which
+            # is why a one-call test cannot catch it). np.asarray(X) here
+            # is a real synchronous wait on X's producer; the result
+            # re-enters jax so the device render path composes unchanged.
+            from ..native import forest as native_forest
+
+            nf = native_forest.NativeForest(node_arrays)
+
+            def native_predict(_params, X):
+                return jnp.asarray(
+                    nf.predict(np.asarray(X, np.float32))
+                )
+
+            native_predict.host_native = True
+            return native_predict, None
         if kernel != "gemm":
             raise ValueError(f"TCSDN_FOREST_KERNEL={kernel!r} unknown")
         return tree_gemm.predict, tree_gemm.compile_forest(
