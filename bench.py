@@ -662,6 +662,41 @@ def measure(batches: list[int]) -> None:
                 emit()
                 break
             emit()
+        # CPU fallback entrant: the native C++ brute-force evaluator
+        # (native/knn_eval.cpp, exact f64 distances) — raced under the
+        # same signal-floor timing and same-run parity gate (and the
+        # same budget guard as every sibling stage)
+        if not on_tpu and not out_of_time():
+            print("# knn native C++", flush=True)
+            try:
+                from traffic_classifier_sdn_tpu.native import (
+                    knn as native_knn,
+                )
+
+                hk = native_knn.NativeKnn(
+                    ski.import_knn(f"{MODELS_DIR}/KNeighbors")
+                )
+                Xnk = X_big[:fam_batch]
+                sec_nk = _timed_host(lambda: hk.predict(Xnk))
+                line["knn_native_topk_flows_per_sec"] = round(
+                    fam_batch / sec_nk, 1
+                )
+                if want_knn is None:
+                    want_knn = np.asarray(
+                        jax.jit(knn_mod.predict)(knn_params, Xd32)
+                    )
+                got_nk = hk.predict(ds.X.astype(np.float32))
+                pct_nk = float((got_nk == want_knn).mean() * 100.0)
+                line["knn_native_parity_pct"] = round(pct_nk, 3)
+                if pct_nk == 100.0 and sec_nk < best_sec:
+                    best_sec = sec_nk
+                    line["knn_flows_per_sec"] = round(
+                        fam_batch / best_sec, 1
+                    )
+                    line["knn_top_k_impl"] = "native"
+            except Exception as e:  # noqa: BLE001 — build may be absent
+                line["knn_native_error"] = f"{type(e).__name__}: {e}"[:120]
+            emit()
         # fused Pallas kernel (ops/pallas_knn): distance + running top-k
         # in VMEM, the (N, S) similarity never touching HBM. Own guard
         # (a Mosaic rejection must not cost the race results) + argmax

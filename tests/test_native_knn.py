@@ -1,0 +1,110 @@
+"""Native C++ KNN evaluator (native/knn_eval.cpp) vs the XLA sort path.
+
+The evaluator ranks by exact float64 squared distances with the
+lax.top_k total order ((distance asc, corpus index asc) — ties to the
+earlier index) and votes like models/knn.neighbor_votes. Adversarial
+few-distinct-integer corpora make every distance exactly representable
+in BOTH the f32 dot-expansion (XLA fast path) and the f64 diff-square
+form, so a tie-order divergence cannot hide behind rounding — the same
+pattern as tests/test_pallas_knn.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from traffic_classifier_sdn_tpu.models import knn
+from traffic_classifier_sdn_tpu.native import knn as native_knn
+
+pytestmark = pytest.mark.skipif(
+    not native_knn.available(),
+    reason="g++ build unavailable",
+)
+
+
+def _tie_dict(rng, S, n_classes=6, k=5):
+    return {
+        "fit_X": rng.randint(0, 4, (S, 12)).astype(np.float64),
+        "y": rng.randint(0, n_classes, S).astype(np.int32),
+        "n_neighbors": k,
+        "classes": np.arange(n_classes),
+    }
+
+
+def test_parity_reference_corpus(reference_models_dir, flow_dataset):
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+
+    d = ski.import_knn(os.path.join(reference_models_dir, "KNeighbors"))
+    h = native_knn.NativeKnn(d)
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    X = flow_dataset.X.astype(np.float32)
+    want = np.asarray(jax.jit(knn.predict)(params, jnp.asarray(X)))
+    np.testing.assert_array_equal(h.predict(X), want)
+
+
+@pytest.mark.parametrize("S", [7, 256, 900])
+def test_adversarial_ties_across_chunk_shapes(S):
+    """Massively tied integer corpora at sizes exercising sub-chunk,
+    exact-chunk, and multi-chunk-with-tail corpus layouts (kChunk=256),
+    plus non-multiple-of-8 query counts (the query-block tail)."""
+    rng = np.random.RandomState(S)
+    d = _tie_dict(rng, S)
+    h = native_knn.NativeKnn(d)
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    X = rng.randint(0, 4, (101, 12)).astype(np.float32)
+    want = np.asarray(jax.jit(knn.predict)(params, jnp.asarray(X)))
+    np.testing.assert_array_equal(h.predict(X), want, err_msg=f"{S=}")
+
+
+def test_duplicate_rows_vote_like_sort_path():
+    """A corpus that is ONE row duplicated with different labels: the
+    winning vote is decided purely by tie order (lowest corpus indices
+    win), so any ordering divergence flips the label."""
+    d = {
+        "fit_X": np.ones((9, 12)),
+        "y": np.array([2, 2, 5, 5, 5, 1, 1, 1, 1], np.int32),
+        "n_neighbors": 5,
+        "classes": np.arange(6),
+    }
+    h = native_knn.NativeKnn(d)
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    X = np.ones((3, 12), np.float32)
+    want = np.asarray(jax.jit(knn.predict)(params, jnp.asarray(X)))
+    got = h.predict(X)
+    np.testing.assert_array_equal(got, want)
+    # k=5 nearest are indices 0..4 -> labels [2,2,5,5,5] -> class 5
+    assert (got == 5).all()
+
+
+def test_float_feature_labels_match(reference_models_dir):
+    """Bench-distribution floats (gamma up to ~1e4): label parity vs the
+    sort path — the f64 diff-square ordering agrees with the f32
+    dot-expansion wherever rounding does not manufacture a near-tie,
+    and on divergence-free data the labels must be identical."""
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+
+    d = ski.import_knn(os.path.join(reference_models_dir, "KNeighbors"))
+    h = native_knn.NativeKnn(d)
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    rng = np.random.RandomState(7)
+    X = np.abs(rng.gamma(1.5, 200.0, (1024, 12))).astype(np.float32)
+    want = np.asarray(jax.jit(knn.predict)(params, jnp.asarray(X)))
+    np.testing.assert_array_equal(h.predict(X), want)
+
+
+def test_guards():
+    rng = np.random.RandomState(0)
+    with pytest.raises(ValueError, match="rows <"):
+        native_knn.NativeKnn(_tie_dict(rng, S=3, k=5))
+    with pytest.raises(ValueError, match="64-cand"):
+        native_knn.NativeKnn(_tie_dict(rng, S=200, k=65))
+    h = native_knn.NativeKnn(_tie_dict(rng, S=64))
+    with pytest.raises(ValueError, match="!= "):
+        h.predict(np.zeros((4, 8), np.float32))
+    h.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        h.predict(np.zeros((4, 12), np.float32))

@@ -139,6 +139,21 @@ def test_serving_kernel_selection_env(reference_models_dir, flow_dataset,
             np.asarray(fn(p, X)), want_n, err_msg="native"
         )
 
+    from traffic_classifier_sdn_tpu.native import knn as native_knn_mod
+
+    if native_knn_mod.available():
+        monkeypatch.setenv("TCSDN_KNN_TOPK", "native")
+        m = load_reference_model(
+            "knearest", f"{reference_models_dir}/KNeighbors"
+        )
+        fn, p = m.serving_path()
+        assert getattr(fn, "host_native", False)
+        np.testing.assert_array_equal(
+            np.asarray(fn(p, X)),
+            np.asarray(m.predict(m.params, X)),
+            err_msg="knn native",
+        )
+
     for impl in ("argmax", "hier", "hier512"):
         monkeypatch.setenv("TCSDN_KNN_TOPK", impl)
         m = load_reference_model(

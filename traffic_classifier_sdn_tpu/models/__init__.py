@@ -61,7 +61,9 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
     - ``TCSDN_KNN_TOPK`` ∈ ``sort`` (default) | ``argmax`` | ``hier`` or
       ``hier<group>`` (e.g. ``hier512``; group in [n_neighbors, 65536]) |
       ``pallas`` (ops/pallas_knn fused distance+top-k kernel; TPU-only —
-      Mosaic does not compile on CPU hosts).
+      Mosaic does not compile on CPU hosts) | ``native`` (the C++
+      host-spine brute force for accelerator-less hosts; ``host_native``
+      — callers must NOT jit or shard_map it).
 
     Every option is argmax-parity-gated against the same oracles by
     tests and by the bench before promotion; selection never changes
@@ -76,6 +78,27 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
             from ..ops import pallas_knn
 
             return pallas_knn.predict_chunked, pallas_knn.compile_knn(params)
+        if impl == "native":
+            # host-spine C++ brute force (native/knn_eval.cpp) for
+            # accelerator-less hosts; host_native contract as the forest
+            # branch below — a plain host call, never jitted/shard_mapped
+            import numpy as np
+
+            from ..native import knn as native_knn
+
+            hk = native_knn.NativeKnn({
+                "fit_X": np.asarray(params.fit_X),  # the f32 hi corpus,
+                # exactly the fast path's operand
+                "y": np.asarray(params.fit_y),
+                "n_neighbors": params.n_neighbors,
+                "classes": np.arange(params.n_classes),
+            })
+
+            def native_knn_predict(_params, X):
+                return jnp.asarray(hk.predict(np.asarray(X, np.float32)))
+
+            native_knn_predict.host_native = True
+            return native_knn_predict, None
         if impl not in ("sort", "argmax"):
             suffix = impl[4:] or "128"
             # isdecimal (not isdigit: unicode superscripts pass isdigit

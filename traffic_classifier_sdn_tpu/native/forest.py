@@ -111,6 +111,9 @@ class NativeForest:
             raise RuntimeError("tcf_create rejected the forest layout")
 
     def _check_width(self, X: np.ndarray) -> None:
+        if not self._h:
+            # a NULL handle would segfault in C++, not raise
+            raise RuntimeError("NativeForest handle is closed")
         if X.ndim != 2 or X.shape[1] < self.min_features:
             raise ValueError(
                 f"X shape {X.shape} too narrow: forest reads feature "

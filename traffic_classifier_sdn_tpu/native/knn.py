@@ -1,0 +1,125 @@
+"""ctypes bindings for the native (C++) brute-force KNN evaluator.
+
+The accelerator-less host path for the KNeighbors checkpoint (the
+reference walks one KDTree per query on one CPU,
+``/root/reference/traffic_classifier.py:234-236``): exact float64
+squared distances with the lax.top_k tie order, SIMD-blocked so the
+corpus streams from cache once per 8-query block (see
+native/knn_eval.cpp). The XLA/Pallas kernels in models/knn.py and
+ops/pallas_knn.py remain the device paths; ``bench.py`` races this
+entrant on the CPU fallback under the same same-run parity gate as
+every other raced kernel.
+
+Built lazily with g++ ``-march=native`` on first use (the distance
+loops need the host's widest SIMD; the .so never leaves the machine it
+was built on). ``available()`` reports whether a build is possible.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import threading
+
+import numpy as np
+
+from .loader import LazyLib
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lazy = LazyLib(
+    os.path.join(_DIR, "knn_eval.cpp"),
+    os.path.join(_DIR, "_knn_eval.so"),
+    "native knn evaluator",
+    flags=("-O3", "-march=native"),
+)
+_lock = threading.Lock()
+_lib = None
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        lib = _lazy.load()
+        lib.tck_create.restype = ct.c_void_p
+        lib.tck_create.argtypes = [
+            ct.c_uint32, ct.c_uint32, ct.c_uint32, ct.c_uint32,
+            ct.c_void_p, ct.c_void_p,
+        ]
+        lib.tck_destroy.argtypes = [ct.c_void_p]
+        lib.tck_predict.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+class NativeKnn:
+    """A compiled corpus handle (arrays copied in at construction).
+
+    ``d`` is the importer dict (``fit_X`` (S, F) float, ``y`` (S,) int,
+    ``n_neighbors``, ``classes``) — the same dict models/knn.from_numpy
+    consumes, so the two paths load identical corpora."""
+
+    def __init__(self, d: dict):
+        lib = _load()
+        fit_X = np.ascontiguousarray(d["fit_X"], np.float32)
+        classes = np.asarray(d["classes"])
+        # y is already class INDICES (knn.from_numpy casts it straight
+        # to int32 — the importer resolves raw labels)
+        fit_y = np.ascontiguousarray(d["y"], np.int32)
+        S, F = fit_X.shape
+        k = int(d["n_neighbors"])
+        self.n_classes = int(classes.shape[0])
+        self.n_features = F
+        self.n_neighbors = k
+        if S < k:
+            raise ValueError(f"corpus has {S} rows < n_neighbors={k}")
+        if k > 64:
+            raise ValueError(f"n_neighbors={k} exceeds the 64-cand cap")
+        self._lib = lib
+        self._h = lib.tck_create(
+            S, F, self.n_classes, k,
+            fit_X.ctypes.data_as(ct.c_void_p),
+            fit_y.ctypes.data_as(ct.c_void_p),
+        )
+        if not self._h:
+            raise RuntimeError("tck_create rejected the corpus layout")
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """(N, F) float32 features -> (N,) int32 class indices."""
+        if not self._h:
+            raise RuntimeError("NativeKnn handle is closed")
+        X = np.ascontiguousarray(X, np.float32)
+        if X.ndim != 2 or X.shape[1] != self.n_features:
+            raise ValueError(
+                f"X shape {X.shape} != (N, {self.n_features})"
+            )
+        out = np.empty(X.shape[0], np.int32)
+        self._lib.tck_predict(
+            self._h,
+            X.ctypes.data_as(ct.c_void_p),
+            X.shape[0], X.shape[1],
+            out.ctypes.data_as(ct.c_void_p),
+        )
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tck_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
