@@ -760,6 +760,36 @@ def measure(batches: list[int]) -> None:
     line["svc_path"] = "xla"
     emit()
 
+    # CPU race: the dot-expansion kernel (no (N, S, F) difference tensor
+    # — models/svc.rbf_kernel_dot) vs the canonical diff form, parity-
+    # gated on the reference rows vs sklearn's own labels. On TPU the
+    # fused Pallas RBF below owns this question.
+    if not on_tpu and not out_of_time():
+        print("# svc dot-expansion race", flush=True)
+        try:
+            got_dot = np.asarray(
+                jax.jit(svc_mod.predict_dot)(svc_params, Xd32)
+            )
+            dpct = float((got_dot == want_svc).mean() * 100.0)
+            line["svc_dot_parity_pct"] = round(dpct, 3)
+
+            def svc_dot_sum(p, X):
+                return jnp.sum(
+                    svc_mod.predict_dot_chunked(p, X)
+                ).astype(jnp.float32)
+
+            sec_dot = _timed_loop(
+                svc_dot_sum, svc_params, Xs, _loop_iters(svc_batch)
+            )
+            line["svc_dot_flows_per_sec"] = round(svc_batch / sec_dot, 1)
+            if dpct == 100.0 and sec_dot < sec_svc:
+                line["svc_flows_per_sec"] = round(svc_batch / sec_dot, 1)
+                line["svc_device_batch_ms"] = round(sec_dot * 1e3, 3)
+                line["svc_path"] = "xla_dot_expansion"
+        except Exception as e:  # noqa: BLE001
+            line["svc_dot_error"] = f"{type(e).__name__}: {e}"[:120]
+        emit()
+
     if not on_tpu:
         # everything past this point is TPU-only kernel work (Pallas RBF,
         # the v2 int8 GEMM race, the fused Pallas forest) — on the CPU

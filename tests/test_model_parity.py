@@ -14,6 +14,7 @@ import pickle
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from traffic_classifier_sdn_tpu.io import sklearn_import as ski
@@ -96,6 +97,38 @@ def test_svc_f32_plain_queries_close(reference_models_dir, flow_dataset):
         svc.predict(params, jnp.asarray(flow_dataset.X, jnp.float32))
     )
     assert (got == want).mean() >= 0.95
+
+
+def test_svc_dot_expansion_matches_sklearn(reference_models_dir,
+                                           flow_dataset):
+    """The dot-expansion RBF path (svc.rbf_kernel_dot — one matmul, no
+    (N, S, F) difference tensor, ~3.6× on CPU hosts).
+
+    The exact-100% assertion here is INTENTIONAL and is the promotion
+    contract, not a numerics claim: rbf_kernel_dot's cancellation
+    analysis says kernel values can be badly wrong near support vectors,
+    and the path is only promotable/servable while empirical label
+    parity on this checkpoint+corpus holds. If a backend/BLAS change
+    ever flips one reference label, this test SHOULD fail — the right
+    response is demoting the dot path, not loosening the assertion
+    (contrast test_svc_f32_plain_queries_close's deliberate ≥95% bar,
+    which documents expected f32 input-rounding loss). The chunked form
+    is bitwise the unchunked one (chunking only slices rows; per-row
+    matmul reductions are unchanged)."""
+    d = ski.import_svc(_ref_path(reference_models_dir, "svc"))
+    with open(_ref_path(reference_models_dir, "svc"), "rb") as f:
+        est = pickle.load(f)
+    want = _sk_predict_indices(est, flow_dataset.X, d["classes"])
+    params = svc.from_numpy(d, dtype=jnp.float32)
+    X = jnp.asarray(flow_dataset.X, jnp.float32)
+    got = np.asarray(jax.jit(svc.predict_dot)(params, X))
+    np.testing.assert_array_equal(got, want)
+    got_chunked = np.asarray(
+        jax.jit(
+            lambda p, X: svc.predict_dot_chunked(p, X, row_chunk=1000)
+        )(params, X)
+    )
+    np.testing.assert_array_equal(got_chunked, got)
 
 
 @pytest.mark.parametrize("hilo", [False, True])

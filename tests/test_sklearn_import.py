@@ -139,6 +139,27 @@ def test_serving_kernel_selection_env(reference_models_dir, flow_dataset,
             np.asarray(fn(p, X)), want_n, err_msg="native"
         )
 
+    # SVC kernel selection: the dot-expansion fast path must agree with
+    # the canonical chunked path; unknown values error at build time
+    import jax
+
+    monkeypatch.setenv("TCSDN_SVC_KERNEL", "dot")
+    m = load_reference_model("svm", f"{reference_models_dir}/SVC")
+    fn, p = m.serving_path()
+    from traffic_classifier_sdn_tpu.models import svc as svc_mod
+
+    assert fn is svc_mod.predict_dot_chunked
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(fn)(p, X)),
+        np.asarray(jax.jit(svc_mod.predict_chunked)(p, X)),
+        err_msg="svc dot",
+    )
+    monkeypatch.setenv("TCSDN_SVC_KERNEL", "bogus")
+    m = load_reference_model("svm", f"{reference_models_dir}/SVC")
+    with pytest.raises(ValueError, match="TCSDN_SVC_KERNEL"):
+        m.serving_path()
+    monkeypatch.delenv("TCSDN_SVC_KERNEL")
+
     from traffic_classifier_sdn_tpu.native import knn as native_knn_mod
 
     if native_knn_mod.available():

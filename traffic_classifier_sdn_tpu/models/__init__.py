@@ -58,6 +58,9 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
       Mosaic does not compile on CPU hosts) | ``native`` (the C++
       host-spine walk as a plain host call for accelerator-less hosts;
       marked ``host_native`` — callers must NOT jit or shard_map it).
+    - ``TCSDN_SVC_KERNEL`` ∈ ``chunked`` (default, two-float exact
+      difference form) | ``dot`` (dot-expansion RBF — one matmul, no
+      (N, S, F) difference tensor; ~3.6× on CPU hosts).
     - ``TCSDN_KNN_TOPK`` ∈ ``sort`` (default) | ``argmax`` | ``hier`` or
       ``hier<group>`` (e.g. ``hier512``; group in [n_neighbors, 65536]) |
       ``pallas`` (ops/pallas_knn fused distance+top-k kernel; TPU-only —
@@ -113,6 +116,14 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
                 )
         return functools.partial(mod.predict_chunked, top_k_impl=impl), params
     if name == "svc":
+        svc_kernel = os.environ.get("TCSDN_SVC_KERNEL", "chunked")
+        if svc_kernel == "dot":
+            # dot-expansion RBF (no (N, S, F) difference tensor —
+            # ~3.6× on CPU hosts, measured; numerics note on
+            # svc.rbf_kernel_dot)
+            return mod.predict_dot_chunked, params
+        if svc_kernel != "chunked":
+            raise ValueError(f"TCSDN_SVC_KERNEL={svc_kernel!r} unknown")
         return mod.predict_chunked, params
     if name == "forest":
         import numpy as np

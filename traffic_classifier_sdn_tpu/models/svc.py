@@ -112,22 +112,30 @@ def rbf_kernel(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
     return jnp.exp(-params.gamma * d2)
 
 
-def decision_ovo(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
-    """Per-pair ovo decision values, (N, P)."""
-    K = rbf_kernel(params, X, X_lo)
+def _decision_from_kernel(params: Params, K: jax.Array) -> jax.Array:
     return (
         jnp.matmul(K, params.pair_coef.T, precision=_HI)
         + params.intercept[None, :]
     )
 
 
-def scores(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
-    """Vote counts per class, (N, C)."""
-    D = decision_ovo(params, X, X_lo)
+def _votes_from_decision(params: Params, D: jax.Array) -> jax.Array:
+    """ovo vote counts, (N, C) — ONE home for the libsvm vote semantics
+    so the canonical and dot-expansion paths cannot drift."""
     pos = D > 0
     votes_i = jax.nn.one_hot(params.vote_i, params.n_classes, dtype=D.dtype)
     votes_j = jax.nn.one_hot(params.vote_j, params.n_classes, dtype=D.dtype)
     return jnp.where(pos[:, :, None], votes_i, votes_j).sum(axis=1)
+
+
+def decision_ovo(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
+    """Per-pair ovo decision values, (N, P)."""
+    return _decision_from_kernel(params, rbf_kernel(params, X, X_lo))
+
+
+def scores(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
+    """Vote counts per class, (N, C)."""
+    return _votes_from_decision(params, decision_ovo(params, X, X_lo))
 
 
 def predict(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
@@ -145,4 +153,53 @@ def predict_chunked(
 
     return chunked_predict(
         lambda xc, xlo=None: predict(params, xc, xlo), row_chunk, X, X_lo
+    )
+
+
+def rbf_kernel_dot(params: Params, X: jax.Array) -> jax.Array:
+    """(N, S) RBF kernel via the dot expansion ``d² = ‖x‖² + ‖s‖² − 2x·s``
+    (clamped at 0 — cancellation can push it negative): no (N, S, F)
+    difference tensor, so the hot loop is one matmul. On the CPU host
+    the difference form materializes ~1.8 GB per 16k batch and runs
+    3.6× slower (measured; bench races the two and parity-gates).
+
+    Numerics — read before enabling in serving: this is the form the
+    module header's cancellation analysis warns about. Features reach
+    ~8e8, so ‖x‖²/‖s‖² ~ 1e18 in f32 and the subtraction cancels to an
+    absolute d² error up to ~1e11 — γ·1e11 ≈ 5.5e2 in the exponent, i.e.
+    kernel values near a support vector can be wrong by orders of
+    magnitude for large-magnitude rows, NOT by ulps. Safety therefore
+    rests entirely on EMPIRICAL label parity: 100% on the full reference
+    corpus (the gate bench.py applies before promotion, and the contract
+    tests/test_model_parity.py pins). The difference form
+    (``rbf_kernel``) remains the canonical/exact path and the serving
+    default; ``TCSDN_SVC_KERNEL=dot`` is a deliberate opt-in for hosts
+    where the 3.6× matters more than worst-case boundary exactness."""
+    sv_sq = jnp.sum(params.sv_hi * params.sv_hi, axis=1)
+    x_sq = jnp.sum(X * X, axis=1)
+    d2 = (
+        x_sq[:, None]
+        + sv_sq[None, :]
+        - 2.0 * jnp.matmul(X, params.sv_hi.T, precision=_HI)
+    )
+    return jnp.exp(-params.gamma * jnp.maximum(d2, 0.0))
+
+
+def predict_dot(params: Params, X: jax.Array) -> jax.Array:
+    """``predict`` through ``rbf_kernel_dot`` (see its numerics note) —
+    the vote/argmax tail is the canonical path's, shared."""
+    votes = _votes_from_decision(
+        params, _decision_from_kernel(params, rbf_kernel_dot(params, X))
+    )
+    return jnp.argmax(votes, axis=-1).astype(jnp.int32)
+
+
+def predict_dot_chunked(
+    params: Params, X: jax.Array, row_chunk: int = 65536
+) -> jax.Array:
+    """``predict_dot`` with rows streamed in ``row_chunk`` slices."""
+    from ..ops.chunking import chunked_predict
+
+    return chunked_predict(
+        lambda xc, xlo=None: predict_dot(params, xc), row_chunk, X, None
     )
