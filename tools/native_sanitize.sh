@@ -1,0 +1,88 @@
+#!/bin/sh
+# Sanitizer pass over the native C++ evaluators: ASan+UBSan builds of
+# forest_eval.cpp and knn_eval.cpp driven across the reference corpus,
+# nonfinite/odd-shape inputs, chunk-boundary corpus sizes, and irregular
+# freshly-fit sklearn forests (exercising the DFS-preorder remap).
+# Exits 0 iff both report clean. Not part of the test suite (the
+# LD_PRELOAD ASan runtime is too invasive for pytest); run standalone.
+set -e
+cd "$(dirname "$0")/.."
+
+g++ -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
+    -std=c++17 -fPIC -shared -o /tmp/_fe_asan.so \
+    traffic_classifier_sdn_tpu/native/forest_eval.cpp
+g++ -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
+    -march=native -std=c++17 -fPIC -shared -o /tmp/_knn_asan.so \
+    traffic_classifier_sdn_tpu/native/knn_eval.cpp
+
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu ASAN_OPTIONS=detect_leaks=0 \
+LD_PRELOAD="$(g++ -print-file-name=libasan.so)" python - <<'EOF'
+import numpy as np
+import traffic_classifier_sdn_tpu.native.forest as nf
+import traffic_classifier_sdn_tpu.native.knn as nk
+nf._lazy = nf.LazyLib(nf._lazy._src, '/tmp/_fe_asan.so', 'asan forest')
+nk._lazy = nk.LazyLib(nk._lazy._src, '/tmp/_knn_asan.so', 'asan knn')
+from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
+
+d = ski.import_forest('/root/reference/models/RandomForestClassifier')
+f = nf.NativeForest(d)
+ds = load_reference_datasets('/root/reference/datasets')
+X = ds.X.astype(np.float32)
+f.predict(X)
+f.predict_proba(X[:256])
+bad = np.zeros((13, 12), np.float32)
+bad[0] = -np.inf; bad[1] = np.nan; bad[2] = np.inf
+for Xs in (bad, X[:1], X[:255], X[:257]):
+    f.predict(Xs)
+print('forest: asan/ubsan clean', flush=True)
+
+h = nk.NativeKnn(ski.import_knn('/root/reference/models/KNeighbors'))
+for Xs in (X, X[:1], X[:7], X[:9], bad):
+    h.predict(Xs)
+rng = np.random.RandomState(0)
+for S in (5, 255, 256, 257, 511, 513):
+    hh = nk.NativeKnn({
+        'fit_X': rng.rand(S, 12),
+        'y': rng.randint(0, 6, S).astype(np.int32),
+        'n_neighbors': 5, 'classes': np.arange(6),
+    })
+    hh.predict(np.asarray(rng.rand(33, 12), np.float32))
+    hh.close()
+print('knn: asan/ubsan clean', flush=True)
+
+import warnings
+warnings.filterwarnings('ignore')
+from sklearn.ensemble import RandomForestClassifier
+for t in range(3):
+    Xt = rng.randint(0, 5, (300, 12)).astype(np.float64)
+    yt = rng.randint(0, 4, 300)
+    est = RandomForestClassifier(
+        n_estimators=6, max_depth=None if t % 2 else 4, random_state=t,
+    ).fit(Xt, yt)
+    trees = [e.tree_ for e in est.estimators_]
+    T = len(trees)
+    M = max(tt.node_count for tt in trees)
+    C = est.n_classes_
+    left = np.full((T, M), -1, np.int32)
+    right = np.full((T, M), -1, np.int32)
+    feat = np.zeros((T, M), np.int32)
+    thr = np.zeros((T, M))
+    vals = np.zeros((T, M, C))
+    for i, tt in enumerate(trees):
+        nc = tt.node_count
+        left[i, :nc] = tt.children_left
+        right[i, :nc] = tt.children_right
+        feat[i, :nc] = np.maximum(tt.feature, 0)
+        thr[i, :nc] = tt.threshold
+        vals[i, :nc] = tt.value.reshape(nc, C)
+    ff = nf.NativeForest({
+        'left': left, 'right': right, 'feature': feat, 'threshold': thr,
+        'values': vals, 'max_depth': 10, 'classes': np.arange(C),
+        'n_features': 12,
+    })
+    ff.predict(np.asarray(rng.rand(77, 12) * 6, np.float32))
+    ff.close()
+print('irregular-forest remap: asan/ubsan clean', flush=True)
+EOF
+echo "native_sanitize: all clean"
