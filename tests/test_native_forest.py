@@ -37,29 +37,11 @@ def _oracle(d, X):
 
 
 def _dict_from_sklearn(est):
-    """Node arrays in the importer's (T, M) padded layout, straight from
-    freshly-fit sklearn trees — irregular depths, real padding."""
-    trees = [e.tree_ for e in est.estimators_]
-    T, M = len(trees), max(t.node_count for t in trees)
-    C = est.n_classes_
-    left = np.full((T, M), -1, np.int32)
-    right = np.full((T, M), -1, np.int32)
-    feature = np.zeros((T, M), np.int32)
-    threshold = np.zeros((T, M))
-    values = np.zeros((T, M, C))
-    for i, t in enumerate(trees):
-        nc = t.node_count
-        left[i, :nc] = t.children_left
-        right[i, :nc] = t.children_right
-        feature[i, :nc] = np.maximum(t.feature, 0)  # leaves: -2 -> 0
-        threshold[i, :nc] = t.threshold
-        values[i, :nc] = t.value.reshape(nc, C)
-    return {
-        "left": left, "right": right, "feature": feature,
-        "threshold": threshold, "values": values,
-        "max_depth": max(t.max_depth for t in trees),
-        "classes": np.arange(C), "n_features": est.n_features_in_,
-    }
+    """The importer's OWN packing for a live estimator — fuzz exercises
+    exactly the production (T, M) layout, not a test re-implementation."""
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+
+    return ski.forest_dict_from_estimator(est)
 
 
 def test_parity_reference_rows(forest_dict, flow_dataset):

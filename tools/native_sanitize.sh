@@ -1,27 +1,30 @@
 #!/bin/sh
 # Sanitizer pass over the native C++ evaluators: ASan+UBSan builds of
 # forest_eval.cpp and knn_eval.cpp driven across the reference corpus,
-# nonfinite/odd-shape inputs, chunk-boundary corpus sizes, and irregular
-# freshly-fit sklearn forests (exercising the DFS-preorder remap).
-# Exits 0 iff both report clean. Not part of the test suite (the
-# LD_PRELOAD ASan runtime is too invasive for pytest); run standalone.
+# nonfinite/odd-shape inputs (including the exact 8-row query block),
+# chunk-boundary corpus sizes, and irregular freshly-fit sklearn forests
+# (exercising the DFS-preorder remap). The sanitized builds go through
+# the SAME LazyLib machinery the real loaders use — with the sanitizer
+# flags on the LazyLib itself, so even a mid-run rebuild stays
+# sanitized. Exits 0 iff everything is clean. Not part of the test
+# suite (the LD_PRELOAD ASan runtime is too invasive for pytest); run
+# standalone: `sh tools/native_sanitize.sh`.
 set -e
 cd "$(dirname "$0")/.."
-
-g++ -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
-    -std=c++17 -fPIC -shared -o /tmp/_fe_asan.so \
-    traffic_classifier_sdn_tpu/native/forest_eval.cpp
-g++ -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
-    -march=native -std=c++17 -fPIC -shared -o /tmp/_knn_asan.so \
-    traffic_classifier_sdn_tpu/native/knn_eval.cpp
 
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu ASAN_OPTIONS=detect_leaks=0 \
 LD_PRELOAD="$(g++ -print-file-name=libasan.so)" python - <<'EOF'
 import numpy as np
 import traffic_classifier_sdn_tpu.native.forest as nf
 import traffic_classifier_sdn_tpu.native.knn as nk
-nf._lazy = nf.LazyLib(nf._lazy._src, '/tmp/_fe_asan.so', 'asan forest')
-nk._lazy = nk.LazyLib(nk._lazy._src, '/tmp/_knn_asan.so', 'asan knn')
+
+SAN = ("-O1", "-g", "-fsanitize=address,undefined",
+       "-fno-sanitize-recover=all")
+nf._lazy = nf.LazyLib(nf._lazy._src, "/tmp/_fe_asan.so",
+                      "asan forest", flags=SAN)
+nk._lazy = nk.LazyLib(nk._lazy._src, "/tmp/_knn_asan.so",
+                      "asan knn", flags=SAN + ("-march=native",))
+
 from traffic_classifier_sdn_tpu.io import sklearn_import as ski
 from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
 
@@ -33,12 +36,13 @@ f.predict(X)
 f.predict_proba(X[:256])
 bad = np.zeros((13, 12), np.float32)
 bad[0] = -np.inf; bad[1] = np.nan; bad[2] = np.inf
-for Xs in (bad, X[:1], X[:255], X[:257]):
+for Xs in (bad, X[:1], X[:8], X[:255], X[:257]):
     f.predict(Xs)
 print('forest: asan/ubsan clean', flush=True)
 
 h = nk.NativeKnn(ski.import_knn('/root/reference/models/KNeighbors'))
-for Xs in (X, X[:1], X[:7], X[:9], bad):
+# 8 = exactly one query block (kQueryBlock): the no-tail path
+for Xs in (X, X[:1], X[:7], X[:8], X[:9], bad):
     h.predict(Xs)
 rng = np.random.RandomState(0)
 for S in (5, 255, 256, 257, 511, 513):
@@ -48,6 +52,7 @@ for S in (5, 255, 256, 257, 511, 513):
         'n_neighbors': 5, 'classes': np.arange(6),
     })
     hh.predict(np.asarray(rng.rand(33, 12), np.float32))
+    hh.predict(np.asarray(rng.rand(16, 12), np.float32))  # N % 8 == 0
     hh.close()
 print('knn: asan/ubsan clean', flush=True)
 
@@ -60,27 +65,9 @@ for t in range(3):
     est = RandomForestClassifier(
         n_estimators=6, max_depth=None if t % 2 else 4, random_state=t,
     ).fit(Xt, yt)
-    trees = [e.tree_ for e in est.estimators_]
-    T = len(trees)
-    M = max(tt.node_count for tt in trees)
-    C = est.n_classes_
-    left = np.full((T, M), -1, np.int32)
-    right = np.full((T, M), -1, np.int32)
-    feat = np.zeros((T, M), np.int32)
-    thr = np.zeros((T, M))
-    vals = np.zeros((T, M, C))
-    for i, tt in enumerate(trees):
-        nc = tt.node_count
-        left[i, :nc] = tt.children_left
-        right[i, :nc] = tt.children_right
-        feat[i, :nc] = np.maximum(tt.feature, 0)
-        thr[i, :nc] = tt.threshold
-        vals[i, :nc] = tt.value.reshape(nc, C)
-    ff = nf.NativeForest({
-        'left': left, 'right': right, 'feature': feat, 'threshold': thr,
-        'values': vals, 'max_depth': 10, 'classes': np.arange(C),
-        'n_features': 12,
-    })
+    # the importer's OWN packing (max_depth/n_features derived, never
+    # hand-set) — the fuzz exercises exactly the production layout
+    ff = nf.NativeForest(ski.forest_dict_from_estimator(est))
     ff.predict(np.asarray(rng.rand(77, 12) * 6, np.float32))
     ff.close()
 print('irregular-forest remap: asan/ubsan clean', flush=True)

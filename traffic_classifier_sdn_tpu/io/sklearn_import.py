@@ -159,14 +159,18 @@ def import_knn(path: str) -> dict:
 
 
 def _extract_tree(tree_stub) -> dict:
-    """Pull the node arrays out of a stubbed sklearn.tree._tree.Tree.
+    """Pull the node arrays out of an sklearn.tree._tree.Tree — either a
+    stub-unpickled one or a LIVE fitted tree (forest_dict_from_estimator).
 
     Tree.__reduce__ → (Tree, (n_features, n_classes_arr, n_outputs), state)
     with state = {'max_depth', 'node_count', 'nodes', 'values'}; ``nodes`` is
     a structured array with fields left_child, right_child, feature,
-    threshold, impurity, n_node_samples, weighted_n_node_samples.
+    threshold, impurity, n_node_samples, weighted_n_node_samples. A live
+    Cython Tree exposes the same dict through ``__getstate__``.
     """
-    state = tree_stub._raw_state
+    state = getattr(tree_stub, "_raw_state", None)
+    if state is None:
+        state = tree_stub.__getstate__()
     nodes = state["nodes"]
     return {
         "left": np.asarray(nodes["left_child"], dtype=np.int32),
@@ -187,7 +191,15 @@ def import_forest(path: str) -> dict:
     Padding uses self-loop leaves (left=right=-1) with zero value rows, which
     the tensorized traversal in ops/tree_eval.py treats as inert.
     """
-    est = load_sklearn_pickle(path)
+    return forest_dict_from_estimator(load_sklearn_pickle(path))
+
+
+def forest_dict_from_estimator(est) -> dict:
+    """The ``import_forest`` packing for an in-memory fitted
+    ``RandomForestClassifier`` — ONE home for the dense-stack layout, so
+    tests and tools that fuzz with freshly-fit forests exercise exactly
+    the arrays the importer produces (max_depth and n_features derived,
+    never hand-set)."""
     trees = [_extract_tree(t.tree_) for t in est.estimators_]
     n_trees = len(trees)
     max_nodes = max(t["node_count"] for t in trees)
