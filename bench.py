@@ -241,12 +241,20 @@ def bench_sklearn_forest(X_np: np.ndarray,
     n = Xs.shape[0]
 
     def rate() -> float:
-        t0 = time.perf_counter()
+        # min of 4 timed predicts after a warm-up: the baseline is the
+        # denominator of the official vs_baseline record, and a single
+        # noisy sample on this 1-core host moved it ~30% between runs.
+        # The MIN statistic here is a deliberate divergence from the
+        # medians the numerator paths use (_timed_loop/_timed_host):
+        # min credits the baseline its best case, biasing vs_baseline
+        # DOWNWARD — the conservative direction for the record.
         clf.predict(Xs)
-        t1 = time.perf_counter()
-        clf.predict(Xs)
-        t2 = time.perf_counter()
-        return n / min(t1 - t0, t2 - t1)
+        best = float("inf")
+        for _ in range(4):
+            t0 = time.perf_counter()
+            clf.predict(Xs)
+            best = min(best, time.perf_counter() - t0)
+        return n / best
 
     single = rate()
     clf.n_jobs = -1
@@ -475,9 +483,15 @@ def measure(batches: list[int]) -> None:
     Xd32 = jnp.asarray(ds.X, jnp.float32)
     want_forest = _numpy_forest_labels(forest_raw, ds.X)
 
-    # --- 2. CPU baselines (single-thread AND all-cores, one fit) ---------
+    # --- 2. CPU baselines (single-thread AND all-cores, one fit).
+    # No out_of_time() guard: vs_baseline is load-bearing for the
+    # official record, so the stage always runs — instead its cost is
+    # bounded by trimming the timing sample on the fallback host (the
+    # per-row rate is flat at these sizes; 10 predicts at 16k ≈ 0.5 s)
     print("# stage: sklearn baselines", flush=True)
-    base1, basep = bench_sklearn_forest(X_big)
+    base1, basep = bench_sklearn_forest(
+        X_big, sample=16384 if CPU_MODE else 65536
+    )
     line["baseline_flows_per_sec"] = round(base1, 1)
     line["baseline_flows_per_sec_parallel"] = round(basep, 1)
     line["vs_baseline"] = round(line["value"] / max(base1, basep), 2)
