@@ -1,0 +1,477 @@
+"""Deterministic crash-restore-continue scenarios (the chaos suite).
+
+Every test installs a seeded ``FaultPlan`` (utils/faults.py) at one or
+more durability seams and proves the recovery guarantee end-to-end: a
+kill mid-checkpoint rolls back to the previous checkpoint and a replayed
+record stream converges bit-for-bit with a never-crashed run; torn
+chunks never produce garbage records; a dead monitor's supervisor climbs
+its backoff ladder; a native-engine outage degrades to the Python path
+with a clear story.
+
+``tools/chaos_matrix.sh`` sweeps these scenarios over the fault-site ×
+schedule matrix with distinct seeds (``TCSDN_CHAOS_SEED``); the
+probability-scheduled scenarios below must hold for ANY seed.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.core import flow_table as ft
+from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+from traffic_classifier_sdn_tpu.ingest.collector import SubprocessCollector
+from traffic_classifier_sdn_tpu.ingest.protocol import (
+    TelemetryRecord,
+    format_line,
+)
+from traffic_classifier_sdn_tpu.ingest.supervisor import SupervisedCollector
+from traffic_classifier_sdn_tpu.io import serving_checkpoint as sc
+from traffic_classifier_sdn_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+SEED = int(os.environ.get("TCSDN_CHAOS_SEED", "0"))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """A leaked plan would make unrelated tests fail with FaultInjected —
+    fail loudly here instead."""
+    assert faults.active() is None
+    yield
+    assert faults.active() is None, "test leaked an installed FaultPlan"
+    faults.clear()
+
+
+def _rec(time, src, dst, pkts, bts):
+    return TelemetryRecord(
+        time=time, datapath="1", in_port=1, eth_src=src, eth_dst=dst,
+        out_port=2, packets=pkts, bytes=bts,
+    )
+
+
+def _tick_records(t, n, prefix="f"):
+    # cumulative counters, like a real monitor's 1 Hz flow-stats poll
+    return [
+        _rec(t, f"{prefix}{i:03d}", "gw", 7 * t + i, 1000 * t + 13 * i)
+        for i in range(n)
+    ]
+
+
+def _drive(eng, t, n):
+    eng.mark_tick()
+    eng.ingest(_tick_records(t, n))
+    eng.step()
+
+
+def _features(eng):
+    return np.asarray(ft.features16(eng.table))
+
+
+# ---------------------------------------------------------------- checkpoint
+
+
+def test_kill_mid_write_rolls_back_and_replay_converges(tmp_path):
+    """The acceptance scenario: SIGKILL during the checkpoint write
+    leaves the previous checkpoint restorable, and replaying the same
+    record stream reproduces the never-crashed flow table bit-for-bit."""
+    d = str(tmp_path / "rot")
+    clean = FlowStateEngine(capacity=64)
+    crash = FlowStateEngine(capacity=64)
+    for t in (1, 2):
+        _drive(clean, t, 20)
+        _drive(crash, t, 20)
+    sc.save_rotating(crash, d, tick=2, keep=3)
+    for t in (3, 4):
+        _drive(clean, t, 24)
+        _drive(crash, t, 24)
+    # the crash: fault fires after the temp file is fully written but
+    # before the rename — exactly a kill mid-checkpoint
+    with faults.installed(
+        faults.FaultPlan([faults.FaultRule("serving_ckpt.write")], SEED)
+    ):
+        with pytest.raises(faults.FaultInjected):
+            sc.save_rotating(crash, d, tick=4, keep=3)
+    del crash  # the process is gone
+
+    # restart: the rotation still resolves to the tick-2 checkpoint and
+    # no torn temp file is visible under any checkpoint name
+    assert sc.resolve_latest(d) == sc.checkpoint_path(d, 2)
+    assert all(n.startswith("ckpt-") for n in os.listdir(d))
+    restored = sc.restore(d)
+    assert restored.num_flows() == 20
+    # replay ticks 3.. (cumulative counters: the monitor's next polls
+    # carry the same totals) and continue past the crash point
+    for t in (3, 4, 5):
+        _drive(restored, t, 24)
+        if t == 5:
+            _drive(clean, t, 24)
+    np.testing.assert_array_equal(_features(restored), _features(clean))
+    assert restored.num_flows() == clean.num_flows() == 24
+
+
+def test_rename_fault_also_preserves_previous(tmp_path):
+    d = str(tmp_path / "rot")
+    eng = FlowStateEngine(capacity=32)
+    _drive(eng, 1, 8)
+    sc.save_rotating(eng, d, tick=1, keep=3)
+    _drive(eng, 2, 8)
+    with faults.installed(
+        faults.FaultPlan([faults.FaultRule("serving_ckpt.rename")], SEED)
+    ):
+        with pytest.raises(faults.FaultInjected):
+            sc.save_rotating(eng, d, tick=2, keep=3)
+    assert sc.resolve_latest(d) == sc.checkpoint_path(d, 1)
+    assert sc.restore(d).num_flows() == 8
+
+
+def test_probabilistic_save_crashes_any_seed_converges(tmp_path):
+    """Seeded probability schedule: whatever subset of saves crash, the
+    newest surviving checkpoint + replay must converge to the clean run.
+    The chaos matrix sweeps TCSDN_CHAOS_SEED over this test."""
+    d = str(tmp_path / "rot")
+    clean = FlowStateEngine(capacity=64)
+    crash = FlowStateEngine(capacity=64)
+    saved_ticks = []
+    plan = faults.FaultPlan(
+        [faults.FaultRule("serving_ckpt.write", times=None, p=0.5)], SEED
+    )
+    with faults.installed(plan):
+        for t in range(1, 9):
+            _drive(clean, t, 16)
+            _drive(crash, t, 16)
+            try:
+                sc.save_rotating(crash, d, tick=t, keep=3)
+                saved_ticks.append(t)
+            except faults.FaultInjected:
+                pass
+    if not saved_ticks:
+        pytest.skip(f"seed {SEED} crashed every save; nothing to restore")
+    latest = sc.resolve_latest(d)
+    assert latest == sc.checkpoint_path(d, saved_ticks[-1])
+    restored = sc.restore(latest)
+    for t in range(saved_ticks[-1] + 1, 9):
+        _drive(restored, t, 16)
+    np.testing.assert_array_equal(_features(restored), _features(clean))
+
+
+def test_restore_fault_surfaces_not_hangs(tmp_path):
+    path = str(tmp_path / "s.npz")
+    eng = FlowStateEngine(capacity=8)
+    _drive(eng, 1, 3)
+    sc.save(eng, path)
+    with faults.installed(
+        faults.FaultPlan([faults.FaultRule("serving_ckpt.restore")], SEED)
+    ):
+        with pytest.raises(faults.FaultInjected):
+            sc.restore(path)
+    assert sc.restore(path).num_flows() == 3  # next attempt is clean
+
+
+def test_train_ckpt_kill_at_commit_preserves_previous(tmp_path):
+    """io/checkpoint.py model saves: a kill at the manifest commit leaves
+    the previous generation fully loadable (the staged arrays of the
+    failed save are cleaned up, the manifest still points at the old
+    ones)."""
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    path = str(tmp_path / "model")
+    p1 = gnb.from_numpy({
+        "theta": np.ones((2, 12)), "var": np.ones((2, 12)),
+        "class_prior": np.full(2, 0.5),
+    })
+    ck.save_model(path, "gnb", p1, classes=("a", "b"))
+    p2 = gnb.from_numpy({
+        "theta": np.full((2, 12), 9.0), "var": np.full((2, 12), 2.0),
+        "class_prior": np.full(2, 0.5),
+    })
+    with faults.installed(
+        faults.FaultPlan([faults.FaultRule("train_ckpt.write")], SEED)
+    ):
+        with pytest.raises(faults.FaultInjected):
+            ck.save_model(path, "gnb", p2, classes=("a", "b"))
+    m = ck.load_model(path)
+    np.testing.assert_array_equal(np.asarray(m.params.theta), 1.0)
+    # and a clean retry wins
+    ck.save_model(path, "gnb", p2, classes=("a", "b"))
+    m = ck.load_model(path)
+    np.testing.assert_array_equal(np.asarray(m.params.theta), 9.0)
+
+
+def test_train_state_kill_at_commit_preserves_previous_step(tmp_path):
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+
+    path = str(tmp_path / "ts")
+    state1 = {"w": np.arange(4.0)}
+    ck.save_train_state(path, state1, step=10)
+    with faults.installed(
+        faults.FaultPlan([faults.FaultRule("train_ckpt.write")], SEED)
+    ):
+        with pytest.raises(faults.FaultInjected):
+            ck.save_train_state(path, {"w": np.zeros(4)}, step=20)
+    restored, step = ck.restore_train_state(path, {"w": np.empty(4)})
+    assert step == 10
+    np.testing.assert_array_equal(np.asarray(restored["w"]), state1["w"])
+
+
+# ----------------------------------------------------------------- collector
+
+
+def _spawn_printer(tmp_path, n_ticks=3, n_flows=8, bursts=1):
+    """A monitor that prints ``n_ticks`` polls of cumulative counters —
+    in ``bursts`` flushed, 50 ms-spaced writes so the collector's reader
+    sees multiple pipe chunks (one read1 per burst)."""
+    lines = b"".join(
+        format_line(r)
+        for t in range(1, n_ticks + 1)
+        for r in _tick_records(t, n_flows)
+    )
+    path = str(tmp_path / "feed.tsv")
+    with open(path, "wb") as f:
+        f.write(lines)
+    if bursts <= 1:
+        return f"cat {path}", lines
+    import sys
+
+    prog = (
+        "import sys,time\n"
+        f"data = open({path!r},'rb').read()\n"
+        f"n = {bursts}\n"
+        "step = (len(data) + n - 1) // n\n"
+        "for i in range(n):\n"
+        "    sys.stdout.buffer.write(data[i*step:(i+1)*step])\n"
+        "    sys.stdout.buffer.flush()\n"
+        "    time.sleep(0.05)\n"
+    )
+    return f"{sys.executable} -c \"{prog}\"", lines
+
+
+def test_truncated_chunk_never_yields_garbage_records(tmp_path):
+    """A torn pipe read (chunk tail lost mid-record) must cost records,
+    never corrupt them: everything that parses downstream — with the
+    engine's framing, which holds the final partial line as tail — is
+    byte-identical to a record the monitor actually emitted. The poison
+    seam is what keeps the post-gap fragment from splicing."""
+    from traffic_classifier_sdn_tpu.ingest.protocol import parse_line
+
+    cmd, payload = _spawn_printer(tmp_path, n_ticks=4, n_flows=32, bursts=3)
+    emitted = {bytes(line) for line in payload.split(b"\n") if line}
+    plan = faults.FaultPlan(
+        [faults.FaultRule("collector.read", kind="truncate")], SEED
+    )
+    with faults.installed(plan):
+        coll = SubprocessCollector(cmd, raw=True)
+        coll.start()
+        chunks = []
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not coll.finished:
+            chunks.extend(coll.poll_records())
+            time.sleep(0.01)
+        chunks.extend(coll.poll_records())
+        coll.stop()
+    assert plan.fires, "the truncate rule never fired"
+    assert coll.lines_dropped > 0  # the torn tail is accounted for
+    lines = b"".join(chunks).split(b"\n")
+    lines.pop()  # engine framing: the trailing partial line stays unparsed
+    parsed = [r for r in (parse_line(l + b"\n") for l in lines) if r]
+    assert parsed, "nothing survived the torn read"
+    for r in parsed:
+        assert format_line(r).rstrip(b"\n") in emitted, (
+            f"garbage record spliced across the torn read: {r}"
+        )
+
+
+def test_monitor_killed_mid_stream_supervisor_recovers_table(tmp_path):
+    """collector.read 'raise' kills the monitor mid-stream; the
+    supervisor restarts it and the flow table converges to the clean
+    run's (cumulative counters make the replay idempotent)."""
+    cmd, payload = _spawn_printer(tmp_path, n_ticks=3, n_flows=8, bursts=2)
+    clean = FlowStateEngine(capacity=32)
+    clean.mark_tick()
+    clean.ingest_bytes(payload)
+    clean.step()
+
+    plan = faults.FaultPlan(
+        [faults.FaultRule("collector.read")], SEED  # kill on first chunk
+    )
+    eng = FlowStateEngine(capacity=32)
+    with faults.installed(plan):
+        sup = SupervisedCollector(cmd, raw=True, max_restarts=2,
+                                  backoff_base=0.01)
+        sup.start()
+        deadline = time.monotonic() + 15
+        while sup.running and time.monotonic() < deadline:
+            chunk = sup.wait_record(timeout=0.2)
+            if chunk is not None:
+                eng.mark_tick()
+                eng.ingest_bytes(chunk)
+                eng.step()
+        sup.stop()
+    assert plan.fires, "the kill rule never fired"
+    assert sup.restarts >= 1
+    np.testing.assert_array_equal(_features(eng), _features(clean))
+    assert eng.num_flows() == clean.num_flows() == 8
+
+
+# ---------------------------------------------------------------- supervisor
+
+
+class _ScriptedCollector:
+    """Fake incarnation for clock-driven supervisor tests: dies (or
+    lives) per script, no real subprocess."""
+
+    def __init__(self, returncode):
+        self.returncode = returncode
+        self.finished = returncode is not None
+        self.running = returncode is None
+        self.lines_dropped = 0
+
+    def start(self):
+        pass
+
+    def stop(self):
+        self.running = False
+
+    def drain(self):
+        return []
+
+    def wait_record(self, timeout):
+        return None
+
+    def poll_records(self, max_records=1 << 20):
+        return []
+
+
+def _scripted_supervisor(script, clock, **kw):
+    sup = SupervisedCollector("unused", clock=clock, **kw)
+    it = iter(script)
+    sup._spawn = lambda: next(it)
+    return sup
+
+
+def test_spawn_failure_consumes_budget_and_backs_off():
+    """A restart attempt that itself fails (supervisor.restart fault)
+    burns a budget slot and re-enters the backoff ladder; the next
+    attempt succeeds."""
+    now = [100.0]
+    script = [
+        _ScriptedCollector(returncode=1),  # incarnation 1: dead on arrival
+        _ScriptedCollector(returncode=None),  # incarnation 2 (post-fault)
+    ]
+    sup = _scripted_supervisor(
+        script, clock=lambda: now[0], max_restarts=3, backoff_base=0.5,
+    )
+    sup.start()
+    plan = faults.FaultPlan(
+        [faults.FaultRule("supervisor.restart")], SEED
+    )
+    with faults.installed(plan):
+        sup._check()  # death detected -> backoff 0.5 * 2**0
+        assert sup._next_restart_at == 100.5
+        now[0] = 100.6
+        sup._check()  # restart #1: spawn fails via fault
+        assert plan.fires
+        assert sup.restarts == 1
+        assert sup._collector is None
+        assert sup._next_restart_at == pytest.approx(100.6 + 1.0)
+        assert sup.running  # budget not exhausted: still recoverable
+        now[0] = 101.7
+        sup._check()  # restart #2: succeeds
+    assert sup.restarts == 2
+    assert sup._collector is script[1]
+    assert sup.running
+
+
+def test_real_spawn_failure_takes_the_same_ladder(capsys):
+    """A REAL spawn failure (Popen raising OSError — fd exhaustion, fork
+    failure) must take the same backoff/budget path as the injected one,
+    not kill the serve loop."""
+    now = [10.0]
+    incarnations = iter([_ScriptedCollector(returncode=1)])
+    sup = _scripted_supervisor(
+        [], clock=lambda: now[0], max_restarts=2, backoff_base=0.5,
+    )
+
+    calls = {"n": 0}
+
+    def spawn():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return next(incarnations)
+        if calls["n"] == 2:
+            raise OSError("too many open files")
+        return _ScriptedCollector(returncode=None)
+
+    sup._spawn = spawn
+    sup.start()
+    sup._check()  # death -> backoff
+    now[0] = sup._next_restart_at
+    sup._check()  # restart #1: real OSError
+    assert sup.restarts == 1
+    assert sup._collector is None
+    assert sup.running
+    assert "restart failed" in capsys.readouterr().err
+    now[0] = sup._next_restart_at
+    sup._check()  # restart #2: succeeds
+    assert sup.restarts == 2
+    assert sup._collector is not None and sup._collector.running
+
+
+def test_spawn_failure_exhausts_budget_terminally():
+    now = [0.0]
+    sup = _scripted_supervisor(
+        [_ScriptedCollector(returncode=1)], clock=lambda: now[0],
+        max_restarts=1, backoff_base=0.25,
+    )
+    sup.start()
+    with faults.installed(
+        faults.FaultPlan([faults.FaultRule("supervisor.restart")], SEED)
+    ):
+        sup._check()
+        now[0] = 1.0
+        sup._check()  # the only budgeted restart fails -> done
+    assert sup.restarts == 1
+    assert not sup.running
+
+
+# ------------------------------------------------------------- native engine
+
+
+def test_native_load_fault_gates_to_python_fallback():
+    from traffic_classifier_sdn_tpu.native import engine as ne
+
+    with faults.installed(
+        faults.FaultPlan(
+            [faults.FaultRule("native.load", times=None)], SEED
+        )
+    ):
+        assert ne.available() is False
+        # the CLI's auto gate lands on the Python spine, not an error
+        eng = FlowStateEngine(capacity=8, native=ne.available())
+        _drive(eng, 1, 3)
+        assert eng.num_flows() == 3
+    # the outage is not cached: the site is inert again once cleared
+    # (real availability depends on the host's g++, either value is fine)
+    ne.available()
+
+
+def test_native_checkpoint_restore_during_native_outage_is_clear(tmp_path):
+    from traffic_classifier_sdn_tpu.native import engine as ne
+
+    if not ne.available():
+        pytest.skip("native engine unavailable")
+    path = str(tmp_path / "s.npz")
+    eng = FlowStateEngine(capacity=16, native=True)
+    _drive(eng, 1, 4)
+    sc.save(eng, path)
+    with faults.installed(
+        faults.FaultPlan(
+            [faults.FaultRule("native.load", times=None)], SEED
+        )
+    ):
+        with pytest.raises(RuntimeError, match="native"):
+            sc.restore(path)
+    assert sc.restore(path).num_flows() == 4  # fine once the engine is back

@@ -61,6 +61,48 @@ def test_checkpoint_version_gate(tmp_path, reference_models_dir):
         ckpt.load_model(path)
 
 
+def test_model_checkpoint_commit_protocol(tmp_path):
+    """Saves stage arrays under a fresh versioned dir and the manifest is
+    the commit record: after a save, exactly one arrays dir remains and
+    the manifest points at it (stale generations are GC'd)."""
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    params = gnb.from_numpy({
+        "theta": np.ones((2, 12)), "var": np.ones((2, 12)),
+        "class_prior": np.full(2, 0.5),
+    })
+    path = str(tmp_path / "m")
+    ckpt.save_model(path, "gnb", params, classes=("a", "b"))
+    ckpt.save_model(path, "gnb", params, classes=("a", "b"))
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    arrays_dirs = [
+        n for n in os.listdir(path) if n.startswith("arrays")
+    ]
+    assert arrays_dirs == [manifest["arrays_dir"]]
+    assert ckpt.load_model(path).name == "gnb"
+
+
+def test_legacy_fixed_arrays_layout_still_loads(tmp_path):
+    """Pre-durability checkpoints stored arrays at the fixed name
+    ``arrays`` with no ``arrays_dir`` manifest key — they must keep
+    loading."""
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    params = gnb.from_numpy({
+        "theta": np.full((2, 12), 3.0), "var": np.ones((2, 12)),
+        "class_prior": np.full(2, 0.5),
+    })
+    path = str(tmp_path / "m")
+    ckpt.save_model(path, "gnb", params, classes=("a", "b"))
+    mpath = os.path.join(path, "manifest.json")
+    manifest = json.load(open(mpath))
+    rel = manifest.pop("arrays_dir")  # rewrite to the legacy layout
+    os.rename(os.path.join(path, rel), os.path.join(path, "arrays"))
+    json.dump(manifest, open(mpath, "w"))
+    m = ckpt.load_model(path)
+    np.testing.assert_array_equal(np.asarray(m.params.theta), 3.0)
+
+
 def test_train_state_resume(tmp_path):
     from traffic_classifier_sdn_tpu.train import logreg as logreg_train
 

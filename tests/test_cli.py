@@ -105,6 +105,155 @@ def test_classify_serve_state_roundtrip(tmp_path, capsys,
     assert "Flow ID" in out  # the restored engine serves immediately
 
 
+def _native_gnb_checkpoint(tmp_path):
+    """A self-contained model checkpoint (no reference pickles needed) so
+    the durability tests run in any environment."""
+    import numpy as np_
+
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    rng = np_.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (2, 12)),
+        "var": rng.gamma(2.0, 50.0, (2, 12)) + 1.0,
+        "class_prior": np_.full(2, 0.5),
+    })
+    path = str(tmp_path / "gnb_ckpt")
+    ck.save_model(path, "gnb", params, classes=("ping", "voice"))
+    return path
+
+
+def test_classify_periodic_snapshots_rotate_and_restore(tmp_path, capsys):
+    """--serve-checkpoint-every N snapshots the live state between ticks
+    (atomic, tick-numbered, keep-N) and a crashed serve restarts from the
+    rotation directory — with the newest member torn, restore rolls back
+    to the previous one instead of dying."""
+    import os
+
+    from traffic_classifier_sdn_tpu.utils.metrics import global_metrics
+
+    ckdir = str(tmp_path / "rot")
+    common = [
+        "gaussiannb",
+        "--native-checkpoint", _native_gnb_checkpoint(tmp_path),
+        "--source", "synthetic",
+        "--synthetic-flows", "8",
+        "--capacity", "64",
+        "--print-every", "2",
+    ]
+    cli.main(common + [
+        "--max-ticks", "6",
+        "--serve-checkpoint-every", "2",
+        "--serve-checkpoint-dir", ckdir,
+        "--serve-checkpoint-keep", "2",
+        "--serve-checkpoint-budget", "1.0",
+    ])
+    capsys.readouterr()
+    # snapshots due at ticks 2, 4, 6; keep-2 prunes the tick-2 one
+    assert sorted(os.listdir(ckdir)) == [
+        "ckpt-000000004.npz", "ckpt-000000006.npz",
+    ]
+    assert global_metrics.counters["checkpoint_saves"] == 3
+    assert global_metrics.counters["checkpoint_bytes"] > 0
+    assert global_metrics.histograms["checkpoint_save_s"].count == 3
+    # tear the newest checkpoint (simulated crash mid-write on a
+    # non-atomic filesystem) — the directory restore must roll back
+    newest = os.path.join(ckdir, "ckpt-000000006.npz")
+    blob = open(newest, "rb").read()
+    with open(newest, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    cli.main(common + [
+        "--max-ticks", "2", "--restore-serve-state", ckdir,
+        "--serve-checkpoint-every", "2",
+        "--serve-checkpoint-dir", ckdir,
+        "--serve-checkpoint-keep", "2",
+    ])
+    err = capsys.readouterr().err
+    assert "restored 8 tracked flows" in err
+    # the restarted serve numbers its snapshots ABOVE the rotation's
+    # existing members (base 6 + tick 2): lower numbers would lose to
+    # pre-crash checkpoints in pruning and resolve_latest
+    assert "ckpt-000000008.npz" in os.listdir(ckdir)
+    from traffic_classifier_sdn_tpu.io import serving_checkpoint as _sc
+
+    assert _sc.resolve_latest(ckdir) == os.path.join(
+        ckdir, "ckpt-000000008.npz"
+    )
+
+
+def test_snapshot_save_failure_does_not_kill_serve(tmp_path, capsys):
+    """A failing checkpoint volume (here: the dir path runs through a
+    regular file) is a warning + checkpoint_errors count, not a dead
+    serve process."""
+    import argparse
+    import time as time_mod
+
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    m = Metrics()
+    args = argparse.Namespace(
+        serve_checkpoint_dir=str(blocker / "rot"),
+        serve_checkpoint_keep=2,
+        serve_checkpoint_budget=1.0,
+    )
+    cli._snapshot_if_due(args, FlowStateEngine(capacity=8), m, ticks=2,
+                         loop_t0=time_mod.monotonic())
+    assert m.counters.get("checkpoint_errors") == 1
+    assert "WARNING: serving snapshot failed" in capsys.readouterr().err
+
+
+def test_serve_checkpoint_budget_guard_skips_when_over(tmp_path):
+    """The wall-clock guard defers a due snapshot when checkpointing has
+    already eaten more than the budgeted fraction of loop time."""
+    import argparse
+    import os
+    import time as time_mod
+
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    engine = FlowStateEngine(capacity=8)
+    args = argparse.Namespace(
+        serve_checkpoint_dir=str(tmp_path / "rot"),
+        serve_checkpoint_keep=2,
+        serve_checkpoint_budget=0.5,
+    )
+    # pretend a previous save consumed ~forever relative to loop elapsed
+    m.observe("checkpoint_save_s", 1e6)
+    cli._snapshot_if_due(args, engine, m, ticks=2,
+                         loop_t0=time_mod.monotonic())
+    assert m.counters.get("checkpoint_skipped") == 1
+    assert not os.path.exists(args.serve_checkpoint_dir)
+    # under budget: the snapshot happens
+    m2 = Metrics()
+    cli._snapshot_if_due(args, engine, m2, ticks=2,
+                         loop_t0=time_mod.monotonic())
+    assert m2.counters.get("checkpoint_saves") == 1
+    assert os.listdir(args.serve_checkpoint_dir) == ["ckpt-000000002.npz"]
+    # budget 0 disables the guard entirely (it must NOT read as "skip
+    # everything after the first recorded save")
+    args.serve_checkpoint_budget = 0.0
+    m3 = Metrics()
+    m3.observe("checkpoint_save_s", 1e6)
+    cli._snapshot_if_due(args, engine, m3, ticks=4,
+                         loop_t0=time_mod.monotonic())
+    assert m3.counters.get("checkpoint_saves") == 1
+    assert m3.counters.get("checkpoint_skipped") is None
+
+
+def test_serve_checkpoint_every_requires_dir():
+    with pytest.raises(SystemExit, match="serve-checkpoint-dir"):
+        cli.main([
+            "gaussiannb", "--source", "synthetic", "--max-ticks", "1",
+            "--serve-checkpoint-every", "2",
+        ])
+
+
 def test_classify_synthetic_svm(capsys, reference_models_dir):
     cli.main(
         [

@@ -259,6 +259,113 @@ def test_supervisor_no_race_with_fast_finishing_monitor():
     sup.stop()
 
 
+def test_supervisor_stop_is_terminal():
+    """Regression: stop() must set the terminal state — without it,
+    ``running`` stays True after an explicit stop and a caller polling
+    ``running`` as its loop condition never terminates; worse, the next
+    wait_record's _check would see a killed collector and restart it."""
+    cmd = f"{sys.executable} -c \"import time; time.sleep(30)\""
+    sup = SupervisedCollector(cmd, max_restarts=5, backoff_base=0.01)
+    sup.start()
+    assert sup.running
+    sup.stop()
+    assert not sup.running
+    # no resurrection: wait_record goes through _check and must not
+    # spawn a new incarnation for an explicitly stopped supervisor
+    assert sup.wait_record(timeout=0.05) is None
+    assert sup.restarts == 0
+    assert not sup.running
+
+
+def test_supervisor_stop_terminal_even_with_carryover():
+    """Preserved records don't keep a stopped supervisor 'running' (they
+    stay drainable via poll_records, but the loop condition terminates)."""
+    cmd = _line_cmd(4, tag="dp", sleep=0.0)
+    sup = SupervisedCollector(cmd, max_restarts=1, backoff_base=30.0)
+    sup.start()
+    deadline = time.time() + 10
+    while not sup._carryover and time.time() < deadline:
+        sup._check()  # death detection drains the queue into carryover
+        time.sleep(0.01)
+    assert sup._carryover
+    sup.stop()
+    assert not sup.running
+    assert len(sup.poll_records()) == 4  # still drainable after stop
+
+
+class _FakeIncarnation:
+    """Scripted collector: immediately dead with the given returncode, or
+    alive forever with returncode=None. No subprocess, no threads."""
+
+    def __init__(self, returncode):
+        self.returncode = returncode
+        self.finished = returncode is not None
+        self.running = returncode is None
+        self.lines_dropped = 0
+
+    def start(self):
+        pass
+
+    def stop(self):
+        self.running = False
+
+    def drain(self):
+        return []
+
+    def wait_record(self, timeout):
+        return None
+
+    def poll_records(self, max_records=1 << 20):
+        return []
+
+
+def test_supervisor_backoff_schedule_exact():
+    """The exponential ladder, asserted exactly against a fake monotonic
+    clock — no real sleeps: delay_k = min(cap, base·2^k) for the k-th
+    death, and a restart only happens once the clock passes the mark."""
+    now = [1000.0]
+    incarnations = [_FakeIncarnation(returncode=1) for _ in range(5)]
+    sup = SupervisedCollector(
+        "unused", max_restarts=4, backoff_base=0.5, backoff_cap=3.0,
+        clock=lambda: now[0],
+    )
+    it = iter(incarnations)
+    sup._spawn = lambda: next(it)
+    sup.start()
+    expected = [0.5, 1.0, 2.0, 3.0]  # base·2^k, capped at 3.0 for k=3
+    for k, delay in enumerate(expected):
+        sup._check()  # death k detected → backoff scheduled
+        assert sup._next_restart_at == now[0] + delay
+        assert sup.restarts == k
+        # one instant before the mark: nothing happens
+        now[0] = sup._next_restart_at - 1e-9
+        sup._check()
+        assert sup.restarts == k
+        # at the mark: restart k+1 spawns
+        now[0] = sup._next_restart_at
+        sup._check()
+        assert sup.restarts == k + 1
+        assert sup._collector is incarnations[k + 1]
+    # the 5th incarnation dies with the budget spent: terminal
+    sup._check()
+    assert sup.restarts == 4
+    assert not sup.running
+    assert sup._next_restart_at == 0.0  # no further restart scheduled
+
+
+def test_supervisor_budget_exhaustion_is_terminal_without_sleeps():
+    now = [0.0]
+    sup = SupervisedCollector(
+        "unused", max_restarts=0, backoff_base=0.5,
+        clock=lambda: now[0],
+    )
+    sup._spawn = lambda: _FakeIncarnation(returncode=1)
+    sup.start()
+    sup._check()  # first death, zero budget → done immediately
+    assert not sup.running
+    assert sup.restarts == 0
+
+
 def test_collector_raw_overflow_poisons_seam():
     """Raw-mode queue overflow prefixes the next queued chunk with a
     b"\\x00\\n" poison seam (not a bare newline): the pre-gap partial line

@@ -3,6 +3,8 @@ engine must CONTINUE bit-identically — same features, same slot
 resolution for existing flows, same delta math against the stored
 counters, same eviction clock — versus an engine that never stopped."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -161,3 +163,125 @@ def test_restore_rejects_wrong_format(tmp_path):
     np_.savez_compressed(path, **z)
     with pytest.raises(ValueError, match="format"):
         sc.restore(path)
+
+
+# durability layer: atomic writes, checksums, rotation, rollback
+
+
+def test_save_is_atomic_and_leaves_no_temp(tmp_path):
+    path = str(tmp_path / "s.npz")
+    eng = FlowStateEngine(capacity=8)
+    _tick(eng, 1, 3)
+    nbytes = sc.save(eng, path)
+    assert nbytes == os.path.getsize(path)
+    assert os.listdir(tmp_path) == ["s.npz"]  # temp cleaned up
+    sc.validate(path)  # embedded checksum verifies
+
+
+def test_restore_rejects_bit_flip_with_clear_error(tmp_path):
+    path = str(tmp_path / "s.npz")
+    eng = FlowStateEngine(capacity=8)
+    _tick(eng, 1, 3)
+    sc.save(eng, path)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x40  # one flipped bit mid-archive
+    with open(path, "wb") as f:
+        f.write(blob)
+    with pytest.raises(sc.CorruptCheckpointError, match="s.npz"):
+        sc.restore(path)
+
+
+def test_content_crc_catches_tampered_member_with_stale_checksum(tmp_path):
+    """Even an archive the zip layer accepts (re-compressed cleanly) is
+    rejected when its content no longer matches the embedded CRC32."""
+    path = str(tmp_path / "s.npz")
+    eng = FlowStateEngine(capacity=8)
+    _tick(eng, 1, 3)
+    sc.save(eng, path)
+    z = dict(np.load(path))
+    z["last_time"] = np.int64(int(z["last_time"]) + 1)  # stale crc32 kept
+    np.savez_compressed(path, **z)
+    with pytest.raises(sc.CorruptCheckpointError, match="CRC32"):
+        sc.restore(path)
+
+
+def test_restore_names_file_on_truncated_archive(tmp_path):
+    path = str(tmp_path / "s.npz")
+    eng = FlowStateEngine(capacity=8)
+    _tick(eng, 1, 3)
+    sc.save(eng, path)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # torn write
+    with pytest.raises(sc.CorruptCheckpointError, match="s.npz"):
+        sc.restore(path)
+
+
+def test_rotation_keep_n_and_resolve_latest(tmp_path):
+    d = str(tmp_path / "rot")
+    eng = FlowStateEngine(capacity=16)
+    paths = []
+    for t in (1, 2, 3, 4, 5):
+        _tick(eng, t, 4)
+        paths.append(sc.save_rotating(eng, d, tick=t, keep=2)[0])
+    names = sorted(os.listdir(d))
+    assert names == ["ckpt-000000004.npz", "ckpt-000000005.npz"]
+    assert sc.resolve_latest(d) == sc.checkpoint_path(d, 5)
+
+
+def test_resolve_latest_rolls_back_past_corrupt_newest(tmp_path):
+    d = str(tmp_path / "rot")
+    eng = FlowStateEngine(capacity=16)
+    _tick(eng, 1, 4)
+    sc.save_rotating(eng, d, tick=1, keep=3)
+    _tick(eng, 2, 4)
+    newest, _ = sc.save_rotating(eng, d, tick=2, keep=3)
+    blob = open(newest, "rb").read()
+    with open(newest, "wb") as f:
+        f.write(blob[: len(blob) // 3])  # torn newest
+    assert sc.resolve_latest(d) == sc.checkpoint_path(d, 1)
+    r = sc.restore(d)  # directory restore resolves + rolls back
+    assert r.num_flows() == 4
+
+
+def test_restore_missing_entries_clear_error(tmp_path):
+    """A structurally valid npz that isn't a complete serving checkpoint
+    must name the file and what's missing, not die on a bare KeyError."""
+    path = str(tmp_path / "s.npz")
+    data = {"format_version": np.int64(sc.FORMAT_VERSION)}
+    data["crc32"] = np.uint32(sc._content_crc(data))
+    np.savez_compressed(path, **data)
+    with pytest.raises(sc.CorruptCheckpointError, match="missing"):
+        sc.restore(path)
+
+
+def test_save_rotating_sweeps_orphaned_temps(tmp_path):
+    """A SIGKILL mid-write can't run the temp cleanup; the next rotation
+    save collects the orphan (pruning only matches ckpt-*.npz)."""
+    d = tmp_path / "rot"
+    d.mkdir()
+    orphan = d / ".ckpt-000000001.npz.tmp.12345"
+    orphan.write_bytes(b"torn by a kill")
+    eng = FlowStateEngine(capacity=8)
+    _tick(eng, 1, 3)
+    sc.save_rotating(eng, str(d), tick=2, keep=2)
+    assert sorted(os.listdir(d)) == ["ckpt-000000002.npz"]
+
+
+def test_v1_checkpoint_reports_old_format_not_corruption(tmp_path):
+    """A genuine pre-checksum (v1) file has no crc32 entry; it must be
+    diagnosed as old-format, not accused of corruption."""
+    path = str(tmp_path / "v1.npz")
+    np.savez_compressed(path, format_version=np.int64(1),
+                        capacity=np.int64(8))
+    with pytest.raises(ValueError, match="format 1"):
+        sc.validate(path)
+    with pytest.raises(ValueError, match="format 1"):
+        sc.restore(path)
+
+
+def test_resolve_latest_empty_or_missing_dir(tmp_path):
+    assert sc.resolve_latest(str(tmp_path)) is None
+    assert sc.resolve_latest(str(tmp_path / "nope")) is None
+    with pytest.raises(sc.CorruptCheckpointError, match="no valid"):
+        sc.restore(str(tmp_path))

@@ -133,9 +133,35 @@ def _build_parser() -> argparse.ArgumentParser:
         "index) for a warm restart (io/serving_checkpoint.py)",
     )
     p.add_argument(
-        "--restore-serve-state", default=None, metavar="FILE",
+        "--restore-serve-state", default=None, metavar="FILE_OR_DIR",
         help="start from a serving-state checkpoint: every tracked flow "
-        "resumes with its counters, rates, and slot intact",
+        "resumes with its counters, rates, and slot intact. A directory "
+        "resolves to its newest checkpoint that passes validation "
+        "(torn/corrupt newest files roll back to the previous one)",
+    )
+    p.add_argument(
+        "--serve-checkpoint-every", type=int, default=0, metavar="N",
+        help="snapshot the live serving state between ticks every N poll "
+        "ticks (0 disables) into --serve-checkpoint-dir — bounded-loss "
+        "recovery for long-running serves, not just clean exits",
+    )
+    p.add_argument(
+        "--serve-checkpoint-dir", default=None, metavar="DIR",
+        help="rotation directory for periodic serving snapshots "
+        "(ckpt-<tick>.npz, atomic writes, keep-N); restart with "
+        "--restore-serve-state DIR to resume from the newest valid one",
+    )
+    p.add_argument(
+        "--serve-checkpoint-keep", type=int, default=3,
+        help="keep the newest N periodic snapshots (default 3)",
+    )
+    p.add_argument(
+        "--serve-checkpoint-budget", type=float, default=0.2,
+        metavar="FRAC",
+        help="wall-clock budget guard: skip a due snapshot when "
+        "checkpointing has already consumed more than FRAC of the serve "
+        "loop's elapsed time (default 0.2; 0 disables the guard; skips "
+        "are counted in the checkpoint_skipped metric)",
     )
     p.add_argument(
         "--idle-timeout",
@@ -276,6 +302,15 @@ def _run_classify(args) -> None:
     from .models import SUBCOMMAND_ALIASES, load_reference_model
     from .io.sklearn_import import REFERENCE_CHECKPOINTS
 
+    # serve-durability flag validation runs before any model/device work
+    # so misuse fails fast (and identically with or without checkpoints)
+    sharded = args.shards > 1
+    if sharded and (args.restore_serve_state or args.save_serve_state
+                    or args.serve_checkpoint_every):
+        sys.exit("serving-state checkpoints are single-device (no --shards)")
+    if args.serve_checkpoint_every and not args.serve_checkpoint_dir:
+        sys.exit("--serve-checkpoint-every needs --serve-checkpoint-dir")
+
     name = SUBCOMMAND_ALIASES[args.subcommand]
     if args.native_checkpoint:
         from .io.checkpoint import load_model
@@ -298,9 +333,6 @@ def _run_classify(args) -> None:
     from .utils.metrics import global_metrics as m
 
     use_native = _use_native(args)
-    sharded = args.shards > 1
-    if sharded and (args.restore_serve_state or args.save_serve_state):
-        sys.exit("serving-state checkpoints are single-device (no --shards)")
     if args.restore_serve_state:
         from .io import serving_checkpoint as _sc
 
@@ -361,11 +393,70 @@ def _run_classify(args) -> None:
             )
 
 
+def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float) -> None:
+    """Periodic in-loop serving snapshot (between ticks, state flushed).
+
+    The wall-clock budget guard keeps checkpointing from starving the
+    serve loop: when cumulative save time exceeds
+    ``--serve-checkpoint-budget`` of the loop's elapsed time, the due
+    snapshot is skipped (counted, so operators see the deferral) and
+    retried at the next due tick. Bounded loss either way: the rotation's
+    newest valid member is at most a few due-intervals old.
+
+    A failed save (disk full, permission, unreachable dir) must not kill
+    a serve whose live state is healthy — it's warned, counted in
+    ``checkpoint_errors``, and retried at the next due tick. Injected
+    faults (chaos runs) DO propagate: they simulate process death."""
+    from .io import serving_checkpoint as _sc
+    from .utils.faults import FaultInjected
+
+    h = m.histograms.get("checkpoint_save_s")
+    elapsed = time.monotonic() - loop_t0
+    # budget <= 0 disables the guard (like --serve-checkpoint-every 0
+    # disables snapshots) — otherwise any recorded save makes
+    # total/elapsed > 0 true forever and the rotation silently freezes
+    if (args.serve_checkpoint_budget > 0 and h is not None
+            and elapsed > 0
+            and h.total / elapsed > args.serve_checkpoint_budget):
+        m.inc("checkpoint_skipped")
+        return
+    try:
+        with m.time("checkpoint_save_s"):
+            _, nbytes = _sc.save_rotating(
+                engine, args.serve_checkpoint_dir, tick=ticks,
+                keep=args.serve_checkpoint_keep,
+            )
+    except FaultInjected:
+        raise
+    except OSError as e:
+        m.inc("checkpoint_errors")
+        print(
+            f"WARNING: serving snapshot failed (tick {ticks}): {e} — "
+            f"will retry at the next due tick",
+            file=sys.stderr,
+        )
+        return
+    m.inc("checkpoint_saves")
+    m.inc("checkpoint_bytes", nbytes)
+
+
 def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 use_native, dropped_seen) -> None:
     from .utils.profiling import trace
 
     ticks = 0
+    # A restarted serve must keep numbering ABOVE the rotation's existing
+    # members: ticks restart at 0 here, and lower-numbered snapshots
+    # would be treated as oldest by keep-N pruning and resolve_latest —
+    # post-restart progress silently losing to pre-crash checkpoints.
+    tick_base = 0
+    if args.serve_checkpoint_every and args.serve_checkpoint_dir:
+        from .io import serving_checkpoint as _sc
+
+        existing = _sc.list_checkpoints(args.serve_checkpoint_dir)
+        if existing:
+            tick_base = existing[0][0]
+    loop_t0 = time.monotonic()
     with trace(args.profile_dir):
         for batch in _tick_source(
             args, raw=use_native and args.source in ("ryu", "controller")
@@ -414,6 +505,9 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                         _print_table(
                             engine, model, predict, serve_params, args
                         )
+            if (args.serve_checkpoint_every
+                    and ticks % args.serve_checkpoint_every == 0):
+                _snapshot_if_due(args, engine, m, tick_base + ticks, loop_t0)
             if args.metrics_every and ticks % args.metrics_every == 0:
                 print(m.report(), file=sys.stderr, flush=True)
             if args.max_ticks and ticks >= args.max_ticks:

@@ -22,6 +22,7 @@ import subprocess
 import threading
 from typing import Iterable
 
+from ..utils.faults import FaultInjected, fault_bytes
 from .protocol import TelemetryRecord, parse_line
 
 # The reference's monitor launch command (traffic_classifier.py:22).
@@ -63,20 +64,38 @@ class SubprocessCollector:
                 chunk = stream.read1(1 << 16)
                 if not chunk:
                     break
+                try:
+                    # chaos seam (utils/faults "collector.read"):
+                    # "truncate" loses the chunk's tail mid-record — the
+                    # same framing hazard as a queue drop, so it poisons
+                    # the seam to the NEXT chunk; "raise" kills the
+                    # monitor mid-stream (the pipe dies with it),
+                    # exercising the supervisor's death→drain→restart path
+                    short = fault_bytes("collector.read", chunk)
+                except FaultInjected:
+                    self.stop()
+                    return
+                truncated = len(short) != len(chunk)
+                if truncated:
+                    self.lines_dropped += chunk.count(b"\n") - short.count(
+                        b"\n"
+                    )
+                    chunk = short
                 if drop_seam:
-                    # a dropped chunk broke line framing: poison the seam so
-                    # the fragments on either side of the gap can't splice
-                    # into one corrupted-but-parseable record. A bare "\n"
-                    # is not enough — it would *terminate* the pre-gap
-                    # partial line, letting a truncated counter parse as a
-                    # smaller valid value (garbage negative delta). The NUL
-                    # makes the pre-gap fragment unparseable (fails the
-                    # data-prefix match / int parse), mirroring the
-                    # supervisor's restart poison seam.
+                    # a dropped/truncated chunk broke line framing: poison
+                    # the seam so the fragments on either side of the gap
+                    # can't splice into one corrupted-but-parseable
+                    # record. A bare "\n" is not enough — it would
+                    # *terminate* the pre-gap partial line, letting a
+                    # truncated counter parse as a smaller valid value
+                    # (garbage negative delta). The NUL makes the pre-gap
+                    # fragment unparseable (fails the data-prefix match /
+                    # int parse), mirroring the supervisor's restart
+                    # poison seam.
                     chunk = b"\x00\n" + chunk
                 try:
                     self._queue.put_nowait(chunk)
-                    drop_seam = False
+                    drop_seam = truncated
                 except queue.Full:
                     self.lines_dropped += chunk.count(b"\n")
                     drop_seam = True

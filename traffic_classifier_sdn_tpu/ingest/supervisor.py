@@ -29,6 +29,7 @@ from __future__ import annotations
 import time
 from collections import deque
 
+from ..utils.faults import FaultInjected, fault_point
 from .collector import SubprocessCollector
 
 
@@ -37,11 +38,15 @@ class SupervisedCollector:
 
     Same surface the CLI uses (start/stop/wait_record/poll_records/
     running/lines_dropped) so it drops into _tick_source unchanged.
+
+    ``clock`` injects a monotonic time source so tests can assert the
+    exact backoff schedule (base·2^restarts, capped) and the budget
+    exhaustion path without real sleeps.
     """
 
     def __init__(self, cmd: str, raw: bool = False, max_restarts: int = 5,
                  backoff_base: float = 0.5, backoff_cap: float = 30.0,
-                 metrics=None):
+                 metrics=None, clock=time.monotonic):
         self.cmd = cmd
         self.raw = raw
         self.max_restarts = max_restarts
@@ -49,18 +54,30 @@ class SupervisedCollector:
         self.backoff_cap = backoff_cap
         self.restarts = 0
         self._metrics = metrics
+        self._clock = clock
         self._collector: SubprocessCollector | None = None
         self._next_restart_at = 0.0
         self._done = False  # clean exit or budget exhausted
+        self._stopped = False  # explicit stop(): terminal, overrides all
         self._carryover: deque = deque()  # preserved across restarts
         self._dropped_prior = 0  # lines_dropped from dead incarnations
 
     # -- lifecycle ---------------------------------------------------------
+    def _spawn(self) -> SubprocessCollector:
+        """Collector factory — the seam chaos tests override to script
+        incarnation lifecycles without real subprocesses."""
+        return SubprocessCollector(self.cmd, raw=self.raw)
+
     def start(self) -> None:
-        self._collector = SubprocessCollector(self.cmd, raw=self.raw)
+        self._collector = self._spawn()
         self._collector.start()
 
     def stop(self) -> None:
+        """Terminal: ``running`` is False from here on, and ``_check``
+        will never resurrect the monitor (without ``_done`` a subsequent
+        ``wait_record`` would see a killed collector and restart it)."""
+        self._done = True
+        self._stopped = True
         if self._collector is not None:
             self._collector.stop()
 
@@ -72,7 +89,12 @@ class SupervisedCollector:
     @property
     def running(self) -> bool:
         """True while the monitor runs OR a restart is still possible OR
-        preserved records remain — the caller's loop condition."""
+        preserved records remain — the caller's loop condition. An
+        explicit ``stop()`` is terminal regardless (preserved records
+        stay drainable via ``poll_records``, but a caller polling
+        ``running`` as its loop condition must terminate)."""
+        if self._stopped:
+            return False
         if self._carryover:
             return True
         if self._collector is not None and self._collector.running:
@@ -92,7 +114,7 @@ class SupervisedCollector:
         if self._done:
             return
         c = self._collector
-        now = time.monotonic()
+        now = self._clock()
         if c is not None:
             if not c.finished:
                 return  # alive, or reader still draining the pipe
@@ -125,7 +147,26 @@ class SupervisedCollector:
         self.restarts += 1
         if self._metrics is not None:
             self._metrics.inc("monitor_restarts")
-        self.start()
+        try:
+            fault_point("supervisor.restart")
+            self.start()
+        except (FaultInjected, OSError, RuntimeError) as e:
+            # spawn failure — injected (chaos) or real (Popen EMFILE/
+            # ENOMEM, Thread.start): the attempt consumed a budget slot;
+            # either give up (budget spent) or back off and try again —
+            # the same ladder a crashing incarnation climbs
+            if not isinstance(e, FaultInjected):
+                import sys
+
+                print(f"WARNING: monitor restart failed: {e}",
+                      file=sys.stderr)
+            self._collector = None
+            if self.restarts >= self.max_restarts:
+                self._done = True
+                return
+            self._next_restart_at = now + min(
+                self.backoff_cap, self.backoff_base * (2 ** self.restarts)
+            )
 
     # -- collector surface -------------------------------------------------
     def wait_record(self, timeout: float):

@@ -16,22 +16,86 @@ SURVEY.md §2.2). This module replaces that with:
   trainers — the resume-in-training the reference lacks (SURVEY.md §5);
 - importers compose: ``load_reference_model`` (sklearn pickle) → ``fit`` →
   ``save_model`` gives a pickle-free, forward-compatible artifact.
+
+Crash safety: the manifest is the checkpoint's COMMIT RECORD. Arrays are
+staged first (orbax writes them under a temp name and renames), then the
+manifest is written atomically (temp file + fsync + ``os.replace``) —
+a crash at any point leaves either the previous complete checkpoint or
+the new one, never a directory whose manifest describes arrays that were
+only half written. The ``train_ckpt.write`` fault site
+(utils/faults.py) sits at the manifest commit so the chaos suite can
+kill a save there and prove the previous state still restores.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import os
+import shutil
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.atomicio import atomic_write_bytes, sweep_stale_tmp
+
 FORMAT_VERSION = 1
 _MANIFEST = "manifest.json"
 _ARRAYS = "arrays"
+_stage_counter = itertools.count()
+
+
+def _commit_manifest(path: str, manifest: dict) -> None:
+    """Atomically publish the manifest — the save's commit point."""
+    atomic_write_bytes(
+        os.path.join(path, _MANIFEST),
+        json.dumps(manifest, indent=1).encode(),
+        pre_rename_site="train_ckpt.write",
+    )
+
+
+def _stage_arrays(path: str, arrays: dict) -> str:
+    """Write ``arrays`` under a fresh versioned dir name and return that
+    name (manifest-relative). Staging to a new dir — never overwriting
+    the dir the current manifest references — is what makes the manifest
+    a real commit record: a crash mid-save leaves the old manifest
+    pointing at old, complete arrays."""
+    rel = f"{_ARRAYS}-{os.getpid()}-{next(_stage_counter)}"
+    _checkpointer().save(
+        os.path.join(os.path.abspath(path), rel), arrays, force=True
+    )
+    return rel
+
+
+def _publish(path: str, manifest: dict, arrays_rel: str) -> None:
+    """Commit the manifest, then GC every arrays dir it doesn't
+    reference (stale staged dirs from crashed saves, and prior
+    generations). On commit failure the staged dir is removed so crashed
+    saves don't accumulate garbage."""
+    manifest["arrays_dir"] = arrays_rel
+    try:
+        _commit_manifest(path, manifest)
+    except BaseException:
+        shutil.rmtree(os.path.join(path, arrays_rel), ignore_errors=True)
+        raise
+    for name in os.listdir(path):
+        if name == arrays_rel:
+            continue
+        if name == _ARRAYS or name.startswith(f"{_ARRAYS}-"):
+            shutil.rmtree(os.path.join(path, name), ignore_errors=True)
+    # manifest temps a SIGKILLed predecessor left behind (a real kill
+    # skips atomic_write_bytes's cleanup)
+    sweep_stale_tmp(path)
+
+
+def _arrays_dir(path: str, manifest: dict) -> str:
+    # pre-durability checkpoints stored arrays at the fixed name
+    return os.path.join(
+        os.path.abspath(path), manifest.get("arrays_dir", _ARRAYS)
+    )
 
 
 def _checkpointer():
@@ -72,9 +136,7 @@ def save_model(path: str, name: str, params, classes=None) -> None:
         raise ValueError(f"unknown model family {name!r}")
     arrays, static = _split_fields(params)
     os.makedirs(path, exist_ok=True)
-    _checkpointer().save(
-        os.path.join(os.path.abspath(path), _ARRAYS), arrays, force=True
-    )
+    rel = _stage_arrays(path, arrays)
     manifest = {
         "format_version": FORMAT_VERSION,
         "model": name,
@@ -82,8 +144,7 @@ def save_model(path: str, name: str, params, classes=None) -> None:
         "classes": list(classes) if classes is not None else None,
         "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
     }
-    with open(os.path.join(path, _MANIFEST), "w") as f:
-        json.dump(manifest, f, indent=1)
+    _publish(path, manifest, rel)
 
 
 def load_model(path: str):
@@ -100,9 +161,7 @@ def load_model(path: str):
         )
     name = manifest["model"]
     mod = MODEL_MODULES[name]
-    raw = _checkpointer().restore(
-        os.path.join(os.path.abspath(path), _ARRAYS)
-    )
+    raw = _checkpointer().restore(_arrays_dir(path, manifest))
     arrays = {
         k: jnp.asarray(v, dtype=manifest["dtypes"][k])
         for k, v in raw.items()
@@ -122,20 +181,18 @@ def save_train_state(path: str, state: Any, step: int) -> None:
     leaves, treedef = jax.tree_util.tree_flatten(state)
     arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     os.makedirs(path, exist_ok=True)
-    _checkpointer().save(
-        os.path.join(os.path.abspath(path), _ARRAYS), arrays, force=True
+    rel = _stage_arrays(path, arrays)
+    _publish(
+        path,
+        {
+            "format_version": FORMAT_VERSION,
+            "kind": "train_state",
+            "step": int(step),
+            "n_leaves": len(leaves),
+            "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+        },
+        rel,
     )
-    with open(os.path.join(path, _MANIFEST), "w") as f:
-        json.dump(
-            {
-                "format_version": FORMAT_VERSION,
-                "kind": "train_state",
-                "step": int(step),
-                "n_leaves": len(leaves),
-                "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
-            },
-            f,
-        )
 
 
 def restore_train_state(path: str, template: Any) -> tuple[Any, int]:
@@ -146,9 +203,7 @@ def restore_train_state(path: str, template: Any) -> tuple[Any, int]:
     """
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)
-    raw = _checkpointer().restore(
-        os.path.join(os.path.abspath(path), _ARRAYS)
-    )
+    raw = _checkpointer().restore(_arrays_dir(path, manifest))
     leaves_t, treedef = jax.tree_util.tree_flatten(template)
     if len(leaves_t) != manifest["n_leaves"]:
         raise ValueError(

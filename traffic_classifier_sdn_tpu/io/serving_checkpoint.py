@@ -23,16 +23,50 @@ Key-space note: the Python index keys with BLAKE2b-64
 (ingest/protocol.stable_flow_key) while the C++ engine fingerprints with
 its wyhash-style mix — a checkpoint therefore records which index wrote
 it and restores only onto the same kind (a clear error otherwise).
+
+Durability (the crash-safety layer):
+
+- ``save`` is **atomic**: the ``.npz`` is serialized to bytes, written to
+  a temp file *in the target directory*, fsynced, and ``os.replace``d
+  into place — a crash mid-save leaves the previous checkpoint intact,
+  never a torn file under the final name.
+- Every checkpoint embeds a **CRC32 of its own content** (over each
+  array's name/dtype/shape/bytes). ``restore`` recomputes and rejects a
+  mismatch with ``CorruptCheckpointError`` — on top of the zip
+  per-member CRCs, so both torn files and bit flips are caught.
+- ``save_rotating`` writes **tick-numbered** checkpoints
+  (``ckpt-000000123.npz``) with keep-N pruning, and ``resolve_latest``
+  returns the newest file that *passes validation* — a corrupt newest
+  checkpoint means rollback to the previous one, not a crash.
+- Fault sites (utils/faults.py): ``serving_ckpt.write`` between temp
+  write and rename, ``serving_ckpt.rename`` at the rename, and
+  ``serving_ckpt.restore`` at restore entry. tests/test_chaos.py kills
+  saves mid-write and proves the rollback + replay-convergence story.
 """
 
 from __future__ import annotations
+
+import io
+import os
+import re
+import zipfile
+import zlib
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..core import flow_table as ft
+from ..utils.atomicio import atomic_write_bytes, sweep_stale_tmp
+from ..utils.faults import fault_point
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+
+_CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+
+
+class CorruptCheckpointError(ValueError):
+    """A checkpoint file that cannot be trusted: torn write, bit flip,
+    truncated archive, or missing keys. Names the offending file."""
 
 _TABLE_LEAVES = (
     "time_start", "in_use",
@@ -48,9 +82,26 @@ def _get_leaf(table: ft.FlowTable, name: str):
     return getattr(table, name)
 
 
-def save(engine, path: str) -> None:
-    """One ``.npz`` with the full serving state. Call between ticks (all
-    pending records stepped) — pending host-side rows are not captured."""
+def _content_crc(data: dict) -> int:
+    """CRC32 over every entry's name, dtype, shape, and raw bytes (sorted
+    key order). Computed over the *uncompressed* content, so it survives
+    recompression and catches in-memory corruption the zip layer never
+    sees."""
+    crc = 0
+    for key in sorted(data):
+        if key == "crc32":
+            continue
+        arr = np.ascontiguousarray(np.asarray(data[key]))
+        meta = f"{key}|{arr.dtype.str}|{arr.shape}|".encode()
+        crc = zlib.crc32(arr.tobytes(), zlib.crc32(meta, crc))
+    return crc & 0xFFFFFFFF
+
+
+def save(engine, path: str) -> int:
+    """One ``.npz`` with the full serving state, written atomically with
+    an embedded content checksum. Call between ticks (all pending records
+    stepped) — pending host-side rows are not captured. Returns the byte
+    size of the written checkpoint (the metrics feed)."""
     engine.step()  # flush: the device table is the only counter state
     data: dict = {
         "format_version": FORMAT_VERSION,
@@ -92,18 +143,155 @@ def save(engine, path: str) -> None:
     # order is what makes post-restore slot assignment identical to a
     # never-stopped engine
     data["index/free"] = free
-    np.savez_compressed(path, **data)
+    data["crc32"] = np.uint32(_content_crc(data))
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **data)
+    payload = buf.getvalue()
+    # "write" fires mid-temp-write (torn temp, the SIGKILL state);
+    # "rename" with a complete temp but no commit — either way the final
+    # name still points at the previous checkpoint
+    atomic_write_bytes(
+        path, payload,
+        mid_write_site="serving_ckpt.write",
+        pre_rename_site="serving_ckpt.rename",
+    )
+    return len(payload)
+
+
+def checkpoint_path(directory: str, tick: int) -> str:
+    return os.path.join(directory, f"ckpt-{tick:09d}.npz")
+
+
+def list_checkpoints(directory: str) -> list[tuple[int, str]]:
+    """``(tick, path)`` for every rotation member, newest tick first."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def save_rotating(engine, directory: str, tick: int, keep: int = 3) -> tuple[str, int]:
+    """Atomic tick-numbered checkpoint + keep-N pruning.
+
+    Pruning runs *after* the new checkpoint commits and never trims below
+    ``keep`` survivors, so a corrupt newest file always leaves a valid
+    predecessor for ``resolve_latest`` to roll back to. Returns
+    ``(path, bytes_written)``."""
+    os.makedirs(directory, exist_ok=True)
+    # collect orphaned temps from SIGKILLed predecessors — a real kill
+    # can't run atomic_write_bytes's cleanup, and the rotation's pruning
+    # only matches committed ckpt-*.npz names
+    sweep_stale_tmp(directory)
+    path = checkpoint_path(directory, tick)
+    n = save(engine, path)
+    for _, old in list_checkpoints(directory)[max(keep, 1):]:
+        try:
+            os.unlink(old)
+        except OSError:
+            pass  # pruning is advisory; never fail a save over it
+    return path, n
+
+
+def _load_validated(path: str) -> dict:
+    """One decompression pass: load the archive and verify format +
+    content CRC. Raises ``CorruptCheckpointError`` (or ValueError for a
+    genuine old-format file) — every read path shares this gate."""
+    try:
+        with np.load(path) as z:
+            keys = set(z.files)
+            if "format_version" not in keys:
+                raise CorruptCheckpointError(
+                    f"corrupt/incomplete serving checkpoint {path}: "
+                    f"missing format_version"
+                )
+            # format first: a genuine pre-checksum (v1) file is an
+            # old-format error, not a corruption claim
+            if int(z["format_version"]) != FORMAT_VERSION:
+                raise ValueError(
+                    f"serving checkpoint format {int(z['format_version'])}"
+                    f" != {FORMAT_VERSION} ({path})"
+                )
+            if "crc32" not in keys:
+                raise CorruptCheckpointError(
+                    f"corrupt/incomplete serving checkpoint {path}: "
+                    f"missing crc32"
+                )
+            data = {k: z[k] for k in keys}
+    except (CorruptCheckpointError, ValueError):
+        raise
+    except (OSError, zipfile.BadZipFile, zlib.error, KeyError, EOFError) as e:
+        # torn/truncated archives surface as any of these from the zip
+        # layer (including its per-member CRC check) — name the file
+        raise CorruptCheckpointError(
+            f"corrupt/incomplete serving checkpoint {path}: {e}"
+        ) from e
+    stored = int(np.uint32(data["crc32"]))
+    actual = _content_crc(data)
+    if stored != actual:
+        raise CorruptCheckpointError(
+            f"corrupt serving checkpoint {path}: content CRC32 "
+            f"{actual:#010x} != stored {stored:#010x} (bit flip or torn "
+            f"write)"
+        )
+    return data
+
+
+def validate(path: str) -> None:
+    """Raise ``CorruptCheckpointError`` unless ``path`` is a complete,
+    checksum-clean checkpoint of a supported format."""
+    _load_validated(path)
+
+
+def _resolve_and_load(directory: str) -> tuple[str | None, dict | None]:
+    """Newest member that validates, WITH its loaded content — so a
+    directory restore decompresses the winner exactly once."""
+    for _, path in list_checkpoints(directory):
+        try:
+            return path, _load_validated(path)
+        except (CorruptCheckpointError, ValueError):
+            continue
+    return None, None
+
+
+def resolve_latest(directory: str) -> str | None:
+    """The newest checkpoint in the rotation that passes ``validate`` —
+    a torn or bit-flipped newest file means rollback to its predecessor,
+    not a crash. None when no valid checkpoint exists."""
+    return _resolve_and_load(directory)[0]
 
 
 def restore(path: str, buckets=None):
-    """Rebuild a ``FlowStateEngine`` from ``save`` output."""
+    """Rebuild a ``FlowStateEngine`` from ``save`` output. ``path`` may
+    be a rotation directory, resolved through ``resolve_latest``."""
     from ..ingest.batcher import DEFAULT_BUCKETS, FlowStateEngine
 
-    z = np.load(path)
-    if int(z["format_version"]) != FORMAT_VERSION:
-        raise ValueError(
-            f"serving checkpoint format {int(z['format_version'])} != "
-            f"{FORMAT_VERSION}"
+    fault_point("serving_ckpt.restore")
+    if os.path.isdir(path):
+        resolved, z = _resolve_and_load(path)
+        if resolved is None:
+            raise CorruptCheckpointError(
+                f"no valid serving checkpoint in directory {path}"
+            )
+        path = resolved
+    else:
+        z = _load_validated(path)
+    required = {
+        "capacity", "native", "last_time", "tick_floor", "index/slots",
+        "index/keys", "index/src", "index/dst", "index/next_slot",
+        "index/free", *(f"table/{n}" for n in _TABLE_LEAVES),
+    }
+    missing = required - z.keys()
+    if missing:
+        raise CorruptCheckpointError(
+            f"corrupt/incomplete serving checkpoint {path}: missing "
+            f"entries {sorted(missing)}"
         )
     native = bool(int(z["native"]))
     if native:

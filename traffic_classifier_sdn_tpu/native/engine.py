@@ -89,11 +89,20 @@ def _load():
 
 
 def available() -> bool:
-    """True when the native engine can be built/loaded on this host."""
+    """True when the native engine can be built/loaded on this host.
+
+    The ``native.load`` fault site (utils/faults.py) simulates a
+    build/dlopen failure here — uncached, unlike LazyLib's real-error
+    cache, so one injected outage doesn't poison later calls — letting
+    the chaos suite prove both the Python-fallback gate (cli._use_native)
+    and serving_checkpoint.restore's native-unavailable error."""
+    from ..utils.faults import FaultInjected, fault_point
+
     try:
+        fault_point("native.load")
         _load()
         return True
-    except RuntimeError:
+    except (RuntimeError, FaultInjected):
         return False
 
 

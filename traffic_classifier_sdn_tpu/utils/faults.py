@@ -1,0 +1,163 @@
+"""Deterministic fault injection for the durability seams.
+
+The recovery story (atomic serving checkpoints, supervisor restarts,
+native-engine fallback) is only as good as the failures it has actually
+survived. This module threads named *fault sites* through those seams —
+checkpoint write/rename/restore, collector reads, supervisor restart,
+native engine load — and lets a test install a seeded ``FaultPlan`` that
+fires scripted failures at exact hit counts (or seeded probabilities).
+``tests/test_chaos.py`` and ``tools/chaos_matrix.sh`` drive the matrix.
+
+Design constraints, in order:
+
+1. **Inert by default.** With no plan installed every site is one module
+   attribute load and an ``is None`` branch — no allocation, no locking,
+   no string work. The serve loop's sites are per-tick / per-chunk (never
+   per-record), so the uninstalled cost is unmeasurable in
+   ``tools/bench_serve.py`` (acceptance-gated).
+2. **Deterministic.** A plan is seeded; probability schedules draw from a
+   private ``random.Random`` so a (plan, seed, call sequence) triple
+   always yields the same fires. Count schedules (``after``/``times``)
+   don't touch the RNG at all.
+3. **Scripted, not ambient.** Plans install explicitly (``install`` /
+   ``installed``) and tests always clear them; a leaked plan would make
+   unrelated tests fail loudly with ``FaultInjected`` rather than
+   silently corrupt state.
+
+Sites currently threaded (grep for ``fault_point(``/``fault_bytes(``):
+
+===========================  ===============================================
+``serving_ckpt.write``       io/serving_checkpoint.save — after the temp
+                             file is written, before the atomic rename
+                             (a fire == crash mid-checkpoint: the temp is
+                             torn away, the previous checkpoint survives)
+``serving_ckpt.rename``      io/serving_checkpoint.save — at the rename
+                             itself
+``serving_ckpt.restore``     io/serving_checkpoint.restore entry
+``train_ckpt.write``         io/checkpoint manifest commit (model and
+                             train-state saves)
+``collector.read``           ingest/collector raw reader, per pipe chunk;
+                             ``truncate`` drops the chunk tail mid-record
+                             (framing must poison the seam), ``raise``
+                             kills the monitor mid-stream
+``supervisor.restart``       ingest/supervisor — the restart attempt
+                             itself fails (spawn failure); consumes one
+                             restart-budget slot and re-enters backoff
+``native.load``              native/engine.available() — the C++ engine
+                             is unavailable (build/dlopen failure)
+===========================  ===============================================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+from dataclasses import dataclass, field
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a firing fault site (``kind="raise"``)."""
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(f"injected fault at site {site!r} (hit #{hit})")
+        self.site = site
+        self.hit = hit
+
+
+@dataclass
+class FaultRule:
+    """One scheduled failure at one site.
+
+    ``after`` eligible hits are skipped, then the rule fires up to
+    ``times`` times (None = every subsequent hit). ``p`` gates each
+    otherwise-eligible hit on a seeded coin flip — with count scheduling
+    alone (``p=1.0``) the RNG is never consulted, so count plans are
+    exactly reproducible regardless of seed.
+    """
+
+    site: str
+    after: int = 0
+    times: int | None = 1
+    p: float = 1.0
+    kind: str = "raise"  # or "truncate" (byte sites only)
+    fired: int = field(default=0, compare=False)
+
+
+class FaultPlan:
+    """Seeded schedule of FaultRules, keyed by site name."""
+
+    def __init__(self, rules, seed: int = 0):
+        self.rules: dict[str, list[FaultRule]] = {}
+        for r in rules:
+            self.rules.setdefault(r.site, []).append(r)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.hits: dict[str, int] = {}  # site → eligible-hit count
+        self.fires: list[tuple[str, int]] = []  # (site, hit) audit log
+
+    def check(self, site: str) -> FaultRule | None:
+        """Record one hit at ``site``; the firing rule, or None."""
+        hit = self.hits.get(site, 0) + 1
+        self.hits[site] = hit
+        for r in self.rules.get(site, ()):
+            if hit <= r.after:
+                continue
+            if r.times is not None and r.fired >= r.times:
+                continue
+            if r.p < 1.0 and self._rng.random() >= r.p:
+                continue
+            r.fired += 1
+            self.fires.append((site, hit))
+            return r
+        return None
+
+
+# The active plan. ``None`` means every site is inert; sites guard on this
+# before doing any other work.
+_plan: FaultPlan | None = None
+
+
+def install(plan: FaultPlan | None) -> None:
+    global _plan
+    _plan = plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> FaultPlan | None:
+    return _plan
+
+
+@contextlib.contextmanager
+def installed(plan: FaultPlan):
+    """Scoped install — the chaos tests' idiom; always clears."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        clear()
+
+
+def fault_point(site: str) -> None:
+    """Raise ``FaultInjected`` if a rule fires at ``site``; else no-op."""
+    if _plan is None:
+        return
+    r = _plan.check(site)
+    if r is not None:
+        raise FaultInjected(site, _plan.hits[site])
+
+
+def fault_bytes(site: str, data: bytes) -> bytes:
+    """Byte-stream site: pass ``data`` through, truncated to its first
+    half on a ``truncate`` fire (a torn read — the tail of the chunk,
+    usually mid-record, is lost), or raise on a ``raise`` fire."""
+    if _plan is None:
+        return data
+    r = _plan.check(site)
+    if r is None:
+        return data
+    if r.kind == "truncate":
+        return data[: len(data) // 2]
+    raise FaultInjected(site, _plan.hits[site])
