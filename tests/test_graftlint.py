@@ -1,0 +1,669 @@
+"""graftlint's own test suite.
+
+Per rule: one minimal fixture that FIRES (positive) and one that is
+CLEAN (negative), so a rule regression is caught by name rather than as
+a silent coverage loss. Plus the suppression round-trip (a reasoned
+disable comment hides the finding; a reasonless one is itself a
+finding) and the tier-1 self-enforcement test: the whole installed
+package must lint clean.
+
+Fixture snippets are deliberately minimal — they isolate exactly the
+pattern a rule keys on, nothing else.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from traffic_classifier_sdn_tpu.analysis_static import lint_paths
+from traffic_classifier_sdn_tpu.analysis_static.framework import (
+    BAD_SUPPRESSION,
+    LintRunner,
+)
+from traffic_classifier_sdn_tpu.analysis_static.rules import (
+    ALL_RULES,
+    AtomicIoRule,
+    CtypesAbiRule,
+    FaultSiteRegistryRule,
+    JitPurityRule,
+    LockDisciplineRule,
+    RetraceHazardRule,
+)
+
+PACKAGE_DIR = os.path.dirname(
+    os.path.dirname(os.path.abspath(lint_paths.__code__.co_filename))
+)
+
+
+def run_rule(tmp_path, rule_cls, source, filename="snippet.py"):
+    path = tmp_path / filename
+    path.write_text(textwrap.dedent(source), encoding="utf-8")
+    return LintRunner([rule_cls()]).run([str(path)])
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+JIT_PURITY_POSITIVE = """
+    import time
+    import jax
+
+    @jax.jit
+    def step(x):
+        t = time.time()
+        print(x)
+        return float(x) + t
+"""
+
+JIT_PURITY_NEGATIVE = """
+    import time
+    import jax
+
+    @jax.jit
+    def step(x):
+        return x * 2
+
+    def host_loop(x):
+        t = time.time()
+        print(x)
+        return float(x) + t
+"""
+
+
+def test_jit_purity_fires(tmp_path):
+    findings = run_rule(tmp_path, JitPurityRule, JIT_PURITY_POSITIVE)
+    assert len(findings) == 3  # time.time, print, float()
+    assert {f.rule for f in findings} == {"jit-purity"}
+
+
+def test_jit_purity_clean(tmp_path):
+    assert run_rule(tmp_path, JitPurityRule, JIT_PURITY_NEGATIVE) == []
+
+
+def test_jit_purity_sees_wrapped_function(tmp_path):
+    src = """
+        import jax
+        import numpy as np
+
+        def kernel(x):
+            return np.random.rand() + x
+
+        kernel_jit = jax.jit(kernel)
+    """
+    findings = run_rule(tmp_path, JitPurityRule, src)
+    assert len(findings) == 1
+    assert "np.random" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+RETRACE_POSITIVE = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    buf = np.zeros(16)
+
+    def f(x):
+        return x
+
+    f_jit = jax.jit(f)
+    y = f_jit(3.5)
+"""
+
+RETRACE_NEGATIVE = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    buf = np.zeros(16, dtype=np.float32)
+
+    def f(x):
+        return x
+
+    f_jit = jax.jit(f, static_argnums=(0,))
+    y = f_jit(3.5)
+    z = f_jit(jnp.asarray(buf))
+"""
+
+
+def test_retrace_hazard_fires(tmp_path):
+    findings = run_rule(tmp_path, RetraceHazardRule, RETRACE_POSITIVE)
+    msgs = [f.message for f in findings]
+    assert len(findings) == 2
+    assert any("without an explicit dtype" in m for m in msgs)
+    assert any("bare Python scalar" in m for m in msgs)
+
+
+def test_retrace_hazard_clean(tmp_path):
+    assert run_rule(tmp_path, RetraceHazardRule, RETRACE_NEGATIVE) == []
+
+
+# ---------------------------------------------------------------------------
+# ctypes-abi
+# ---------------------------------------------------------------------------
+
+CTYPES_POSITIVE = """
+    import ctypes
+
+    lib = ctypes.CDLL("libfoo.so")
+
+    def evaluate(n):
+        return lib.fe_eval(n)
+"""
+
+CTYPES_NEGATIVE = """
+    import ctypes
+
+    lib = ctypes.CDLL("libfoo.so")
+    lib.fe_eval.argtypes = [ctypes.c_int64]
+    lib.fe_eval.restype = ctypes.c_int64
+
+    def evaluate(n):
+        return lib.fe_eval(n)
+"""
+
+
+def test_ctypes_abi_fires(tmp_path):
+    findings = run_rule(tmp_path, CtypesAbiRule, CTYPES_POSITIVE)
+    assert len(findings) == 1
+    assert "argtypes and restype" in findings[0].message
+
+
+def test_ctypes_abi_clean(tmp_path):
+    assert run_rule(tmp_path, CtypesAbiRule, CTYPES_NEGATIVE) == []
+
+
+def test_ctypes_abi_partial_prototype_still_fires(tmp_path):
+    src = CTYPES_NEGATIVE.replace(
+        "    lib.fe_eval.restype = ctypes.c_int64\n", ""
+    )
+    findings = run_rule(tmp_path, CtypesAbiRule, src)
+    assert len(findings) == 1
+    assert "restype" in findings[0].message
+    assert "argtypes" not in findings[0].message
+
+
+def test_ctypes_abi_two_libs_need_per_handle_prototypes(tmp_path):
+    # a prototype on one CDLL handle must not silence the check for a
+    # same-named symbol on a DIFFERENT lib
+    findings = run_rule(
+        tmp_path, CtypesAbiRule,
+        """
+        import ctypes
+
+        liba = ctypes.CDLL("a.so")
+        libb = ctypes.CDLL("b.so")
+        liba.fe_eval.argtypes = [ctypes.c_int64]
+        liba.fe_eval.restype = ctypes.c_int64
+
+        def evaluate(n):
+            return liba.fe_eval(n) + libb.fe_eval(n)
+        """,
+    )
+    assert len(findings) == 1
+    assert "fe_eval" in findings[0].message
+
+
+def test_ctypes_abi_tracks_nonconventional_handle_names(tmp_path):
+    # a CDLL handle bound to a name other than lib/_lib must not
+    # escape the rule
+    findings = run_rule(
+        tmp_path, CtypesAbiRule,
+        CTYPES_POSITIVE.replace("lib", "engine"),
+    )
+    assert len(findings) == 1
+    # ...including a handle obtained via LazyLib(...).load()
+    findings = run_rule(
+        tmp_path, CtypesAbiRule,
+        """
+        from engine import LazyLib
+
+        _loader = LazyLib("src.cpp", "out.so", "demo")
+        handle = _loader.load()
+
+        def evaluate(n):
+            return handle.fe_eval(n)
+        """,
+    )
+    assert len(findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+LOCK_POSITIVE = """
+    import threading
+
+    class Collector:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rows = 0
+            self._t = threading.Thread(target=self._run)
+
+        def _run(self):
+            self._rows += 1
+
+        def stats(self):
+            return self._rows
+"""
+
+LOCK_NEGATIVE = """
+    import threading
+
+    class Collector:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rows = 0
+            self._t = threading.Thread(target=self._run)
+
+        def _run(self):
+            with self._lock:
+                self._rows += 1
+
+        def stats(self):
+            with self._lock:
+                return self._rows
+"""
+
+
+def test_lock_discipline_fires(tmp_path):
+    findings = run_rule(tmp_path, LockDisciplineRule, LOCK_POSITIVE)
+    # unlocked write in the thread target AND unlocked read in stats()
+    assert len(findings) == 2
+    assert all("_rows" in f.message for f in findings)
+
+
+def test_lock_discipline_clean(tmp_path):
+    assert run_rule(tmp_path, LockDisciplineRule, LOCK_NEGATIVE) == []
+
+
+# ---------------------------------------------------------------------------
+# fault-site-registry
+# ---------------------------------------------------------------------------
+
+FAULTS_REGISTRY = """
+    SITES = {
+        "demo.write": "demo seam",
+    }
+
+    def fault_point(site):
+        pass
+"""
+
+FAULT_SITE_POSITIVE = """
+    from faults import fault_point
+
+    def save():
+        fault_point("demo.unregistered")
+"""
+
+FAULT_SITE_NEGATIVE = """
+    from faults import fault_point
+
+    def save():
+        fault_point("demo.write")
+"""
+
+
+def run_fault_rule(tmp_path, user_source):
+    (tmp_path / "faults.py").write_text(
+        textwrap.dedent(FAULTS_REGISTRY), encoding="utf-8"
+    )
+    (tmp_path / "user.py").write_text(
+        textwrap.dedent(user_source), encoding="utf-8"
+    )
+    return LintRunner([FaultSiteRegistryRule()]).run([str(tmp_path)])
+
+
+def test_fault_site_registry_fires(tmp_path):
+    findings = run_fault_rule(tmp_path, FAULT_SITE_POSITIVE)
+    msgs = [f.message for f in findings]
+    assert any("demo.unregistered" in m and "not registered" in m
+               for m in msgs)
+    # the registered site is now also unused — both directions check
+    assert any("demo.write" in m and "never used" in m for m in msgs)
+
+
+def test_fault_site_registry_clean(tmp_path):
+    assert run_fault_rule(tmp_path, FAULT_SITE_NEGATIVE) == []
+
+
+def test_fault_site_registry_subtree_scan_uses_external_registry(tmp_path):
+    # Registry outside the scanned paths (`tools/lint.sh some/subdir`
+    # usage): the use→registry direction must still audit against the
+    # nearest utils/faults.py, with no spurious missing-registry finding
+    # and no false "never used" registry-side positives.
+    (tmp_path / "utils").mkdir()
+    (tmp_path / "utils" / "faults.py").write_text(
+        textwrap.dedent(FAULTS_REGISTRY), encoding="utf-8"
+    )
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "user.py").write_text(
+        textwrap.dedent(FAULT_SITE_NEGATIVE), encoding="utf-8"
+    )
+    assert LintRunner([FaultSiteRegistryRule()]).run([str(sub)]) == []
+
+    (sub / "user.py").write_text(
+        textwrap.dedent(FAULT_SITE_POSITIVE), encoding="utf-8"
+    )
+    findings = LintRunner([FaultSiteRegistryRule()]).run([str(sub)])
+    assert any(
+        "demo.unregistered" in f.message and "not registered" in f.message
+        for f in findings
+    )
+
+
+def test_fault_site_registry_side_checks_need_full_package_scan(tmp_path):
+    # scanning ONLY the subtree holding the registry (lint.sh pkg/utils)
+    # must not claim registered sites are "never used" — the users are
+    # simply out of scope; the full-package scan still enforces it
+    pkg = tmp_path / "pkg"
+    (pkg / "utils").mkdir(parents=True)
+    (pkg / "utils" / "faults.py").write_text(
+        textwrap.dedent(FAULTS_REGISTRY), encoding="utf-8"
+    )
+    (pkg / "user.py").write_text(
+        textwrap.dedent(FAULT_SITE_NEGATIVE), encoding="utf-8"
+    )
+    partial = LintRunner([FaultSiteRegistryRule()]).run(
+        [str(pkg / "utils")]
+    )
+    assert [f.message for f in partial] == []
+    full = LintRunner([FaultSiteRegistryRule()]).run([str(pkg)])
+    assert [f.message for f in full] == []  # demo.write used by user.py
+
+
+def test_fault_site_registry_param_forwarding_is_scoped(tmp_path):
+    # a *_site parameter in ONE function must not exempt a same-named
+    # computed local in a DIFFERENT function from the literal check
+    findings = run_fault_rule(
+        tmp_path,
+        """
+        from faults import fault_point
+
+        def forwards(write_site):
+            fault_point(write_site)
+
+        def computes(prefix):
+            write_site = prefix + ".write"
+            fault_point(write_site)
+        """,
+    )
+    literal_msgs = [f for f in findings if "string literal" in f.message]
+    assert len(literal_msgs) == 1  # only the computed one, line 9
+    assert literal_msgs[0].line == 9
+
+
+def test_fault_site_registry_rejects_computed_site(tmp_path):
+    findings = run_fault_rule(
+        tmp_path,
+        """
+        from faults import fault_point
+
+        SITE = "demo" + ".write"
+
+        def save():
+            fault_point(SITE)
+        """,
+    )
+    assert any("string literal" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# atomic-io
+# ---------------------------------------------------------------------------
+
+ATOMIC_POSITIVE = """
+    import os
+
+    def save(path, data):
+        with open(path + ".tmp", "w") as f:
+            f.write(data)
+        os.replace(path + ".tmp", path)
+"""
+
+ATOMIC_MODULE_SCOPE = """
+    import os
+
+    with open("state.json.tmp", "w") as f:
+        f.write("{}")
+    os.replace("state.json.tmp", "state.json")
+"""
+
+ATOMIC_NEGATIVE = """
+    from traffic_classifier_sdn_tpu.utils.atomicio import atomic_write_bytes
+
+    def save(path, data):
+        atomic_write_bytes(path, data.encode())
+
+    def relocate(src, dst):
+        import os
+        os.replace(src, dst)  # rename without a write in scope: fine
+"""
+
+
+def test_atomic_io_fires(tmp_path):
+    findings = run_rule(tmp_path, AtomicIoRule, ATOMIC_POSITIVE)
+    assert len(findings) == 1
+    assert "atomic_write_bytes" in findings[0].message
+
+
+def test_atomic_io_clean(tmp_path):
+    assert run_rule(tmp_path, AtomicIoRule, ATOMIC_NEGATIVE) == []
+
+
+def test_atomic_io_fires_at_module_scope(tmp_path):
+    # script-style write+rename with no enclosing def is a scope too
+    findings = run_rule(tmp_path, AtomicIoRule, ATOMIC_MODULE_SCOPE)
+    assert len(findings) == 1
+    assert findings[0].rule == "atomic-io"
+
+
+def test_atomic_io_function_scope_excludes_nested_defs(tmp_path):
+    # a pure rename in the enclosing body must not pair with a write
+    # inside a nested helper (the helper is its own scope)
+    src = """
+        import os
+
+        def rotate(path):
+            def write_log(p, d):
+                with open(p, "w") as f:
+                    f.write(d)
+            os.replace(path, path + ".1")
+    """
+    assert run_rule(tmp_path, AtomicIoRule, src) == []
+
+
+def test_atomic_io_module_scope_excludes_nested_defs(tmp_path):
+    # a write inside a def nested under a module-level `if` must not
+    # pair with an unrelated top-level rename
+    src = """
+        import os
+
+        if True:
+            def helper(p, d):
+                with open(p, "w") as f:
+                    f.write(d)
+
+        os.replace("a.log", "b.log")
+    """
+    assert run_rule(tmp_path, AtomicIoRule, src) == []
+
+
+def test_atomic_io_exempts_atomicio_module(tmp_path):
+    d = tmp_path / "utils"
+    d.mkdir()
+    (d / "atomicio.py").write_text(
+        textwrap.dedent(ATOMIC_POSITIVE), encoding="utf-8"
+    )
+    assert LintRunner([AtomicIoRule()]).run([str(d)]) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_with_reason_hides_finding(tmp_path):
+    src = ATOMIC_POSITIVE.replace(
+        "os.replace(path + \".tmp\", path)",
+        "os.replace(path + \".tmp\", path)"
+        "  # graftlint: disable=atomic-io -- fixture exercises raw rename",
+    )
+    assert run_rule(tmp_path, AtomicIoRule, src) == []
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    src = ATOMIC_POSITIVE.replace(
+        "os.replace(path + \".tmp\", path)",
+        "os.replace(path + \".tmp\", path)"
+        "  # graftlint: disable=atomic-io",
+    )
+    findings = run_rule(tmp_path, AtomicIoRule, src)
+    rules = sorted(f.rule for f in findings)
+    # the reasonless disable does NOT hide the finding, and is flagged
+    assert rules == ["atomic-io", BAD_SUPPRESSION]
+
+
+def test_suppression_unknown_rule_id_is_a_finding(tmp_path):
+    src = "x = 1  # graftlint: disable=no-such-rule -- typo'd id\n"
+    findings = run_rule(tmp_path, AtomicIoRule, src)
+    assert [f.rule for f in findings] == [BAD_SUPPRESSION]
+    assert "unknown rule id" in findings[0].message
+
+
+def test_suppression_on_multiline_statement_closing_line(tmp_path):
+    # the finding anchors at the statement's first line; a trailing
+    # disable comment on the closing line must still suppress it
+    src = ATOMIC_POSITIVE.replace(
+        "os.replace(path + \".tmp\", path)",
+        "os.replace(\n"
+        "        path + \".tmp\",\n"
+        "        path,\n"
+        "    )  # graftlint: disable=atomic-io -- fixture exercises "
+        "raw rename",
+    )
+    assert run_rule(tmp_path, AtomicIoRule, src) == []
+
+
+def test_suppression_only_hides_named_rule(tmp_path):
+    src = ATOMIC_POSITIVE.replace(
+        "os.replace(path + \".tmp\", path)",
+        "os.replace(path + \".tmp\", path)"
+        "  # graftlint: disable=jit-purity -- wrong rule named",
+    )
+    path = tmp_path / "snippet.py"
+    path.write_text(textwrap.dedent(src), encoding="utf-8")
+    findings = lint_paths([str(path)])
+    assert [f.rule for f in findings] == ["atomic-io"]
+
+
+# ---------------------------------------------------------------------------
+# self-enforcement + CLI contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.lint
+def test_package_is_clean():
+    findings = lint_paths([PACKAGE_DIR])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.lint
+def test_cli_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    clean = subprocess.run(
+        [sys.executable, "-m",
+         "traffic_classifier_sdn_tpu.analysis_static", PACKAGE_DIR],
+        capture_output=True, text=True, env=env,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert "clean" in clean.stdout
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(textwrap.dedent(ATOMIC_POSITIVE), encoding="utf-8")
+    found = subprocess.run(
+        [sys.executable, "-m",
+         "traffic_classifier_sdn_tpu.analysis_static", "--json",
+         str(dirty)],
+        capture_output=True, text=True, env=env,
+    )
+    assert found.returncode == 1
+    import json
+
+    report = json.loads(found.stdout)
+    assert report["count"] == 1
+    assert report["findings"][0]["rule"] == "atomic-io"
+
+    # a --select scoped run must not flag valid suppressions of real
+    # but unselected rule ids as bad-suppression
+    suppressed = tmp_path / "suppressed.py"
+    suppressed.write_text(
+        "lib.fn()  # graftlint: disable=ctypes-abi -- prototype set "
+        "elsewhere\n",
+        encoding="utf-8",
+    )
+    scoped = subprocess.run(
+        [sys.executable, "-m",
+         "traffic_classifier_sdn_tpu.analysis_static",
+         "--select=jit-purity", str(suppressed)],
+        capture_output=True, text=True, env=env,
+    )
+    assert scoped.returncode == 0, scoped.stdout + scoped.stderr
+
+    # --select that parses to zero rule ids must be a usage error, not
+    # a run of zero rules reporting "clean"
+    empty_select = subprocess.run(
+        [sys.executable, "-m",
+         "traffic_classifier_sdn_tpu.analysis_static",
+         "--select=,", str(suppressed)],
+        capture_output=True, text=True, env=env,
+    )
+    assert empty_select.returncode == 2
+    assert "no rule ids" in empty_select.stderr
+
+    # a non-.py target must be a usage error, not a silent "clean"
+    not_py = tmp_path / "script.sh"
+    not_py.write_text("echo hi\n", encoding="utf-8")
+    usage = subprocess.run(
+        [sys.executable, "-m",
+         "traffic_classifier_sdn_tpu.analysis_static", str(not_py)],
+        capture_output=True, text=True, env=env,
+    )
+    assert usage.returncode == 2
+    assert "not a directory or .py file" in usage.stderr
+
+    # a directory with zero .py files must be a usage error too — a
+    # typo'd-but-existing data dir would otherwise pass a gate while
+    # linting nothing
+    empty_dir = tmp_path / "nodata"
+    empty_dir.mkdir()
+    (empty_dir / "notes.txt").write_text("no python here\n",
+                                         encoding="utf-8")
+    no_py = subprocess.run(
+        [sys.executable, "-m",
+         "traffic_classifier_sdn_tpu.analysis_static", str(empty_dir)],
+        capture_output=True, text=True, env=env,
+    )
+    assert no_py.returncode == 2
+    assert "no .py files" in no_py.stderr
+
+
+def test_every_rule_has_fixture_coverage():
+    """Adding a rule without fixture tests should fail loudly here."""
+    covered = {
+        "jit-purity", "retrace-hazard", "ctypes-abi", "lock-discipline",
+        "fault-site-registry", "atomic-io",
+    }
+    assert {cls.id for cls in ALL_RULES} == covered

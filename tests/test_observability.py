@@ -227,7 +227,7 @@ def test_supervisor_aggregates_lines_dropped_across_incarnations():
     sup = SupervisedCollector("true", max_restarts=0)
     sup.start()
     time.sleep(0.2)
-    sup._collector.lines_dropped = 7
+    sup._collector._lines_dropped = 7  # storage behind the locked property
     sup._check()  # detects death, accumulates into _dropped_prior
     assert sup.lines_dropped == 7
     sup.stop()

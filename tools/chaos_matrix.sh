@@ -15,6 +15,15 @@
 # report names both the seed and the seam that broke. Scenario-level
 # `slow` marks keep anything long out of the tier-1 budget; this script
 # itself is the full sweep (CI tier-1 runs the suite once at seed 0).
+#
+# Coverage map: graftlint's `fault-site-registry` rule (see
+# docs/STATIC_ANALYSIS.md) statically guarantees that every injection
+# seam uses a site registered in utils.faults.SITES, that every
+# registered site is live, and that tests/test_chaos.py references it —
+# so the site groups below cannot silently drift out of sync with the
+# seams this sweep is supposed to cover. If you add a site, the linter
+# fails tier-1 until the registry, a chaos test, and (if it is a new
+# seam family) a group below all exist.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."

@@ -1,0 +1,129 @@
+#!/bin/sh
+# One-shot static-analysis gate: graftlint + ruff + mypy.
+#
+#   graftlint  project-native AST rules (jit-purity, retrace-hazard,
+#              ctypes-abi, lock-discipline, fault-site-registry,
+#              atomic-io) — always runs, zero findings required. Also
+#              enforced in tier-1 via `pytest -m lint`
+#              (tests/test_graftlint.py::test_package_is_clean).
+#   ruff       generic baseline, config pinned in [tool.ruff]
+#   mypy       typing baseline, config pinned in [tool.mypy]
+#
+# ruff/mypy are optional in the container image: when absent they are
+# reported as "skipped" (visible in the JSON summary below), never
+# silently dropped — the gate still fails if an INSTALLED tool finds
+# violations. Machine-readable findings land in $LINT_SUMMARY (default:
+# a per-run /tmp/lint_summary.<pid>.json, path echoed on exit):
+# per-tool status plus graftlint's full --json findings array.
+#
+# Usage: tools/lint.sh [paths...]   (default: the package only — tests/
+# and tools/ are not held to the graftlint bar; pass them explicitly to
+# audit them, e.g. `tools/lint.sh traffic_classifier_sdn_tpu tests tools`)
+cd "$(dirname "$0")/.." || exit 2
+
+# per-run default so concurrent runs don't overwrite each other's
+# summary; set LINT_SUMMARY for a stable consumer-facing location
+SUMMARY="${LINT_SUMMARY:-/tmp/lint_summary.$$.json}"
+# positional params (not a flattened string) so paths containing
+# spaces/globs survive: pass "$@" everywhere
+[ "$#" -eq 0 ] && set -- traffic_classifier_sdn_tpu
+
+fail=0
+
+# ---- graftlint -------------------------------------------------------------
+echo "=== graftlint ($*)"
+# per-run temp file: concurrent lint runs (CI matrix, two worktrees)
+# must not clobber each other's findings before the summary step reads
+# them back
+GRAFT_JSON="$(mktemp /tmp/graftlint_findings.XXXXXX.json)" || exit 2
+trap 'rm -f "$GRAFT_JSON"' EXIT
+if JAX_PLATFORMS=cpu python -m traffic_classifier_sdn_tpu.analysis_static \
+     --json "$@" > "$GRAFT_JSON"; then
+  graftlint_status=pass
+  echo "graftlint: clean"
+else
+  graftlint_status=fail
+  fail=1
+  python - "$GRAFT_JSON" <<'EOF'
+import json, sys
+try:
+    with open(sys.argv[1]) as f:
+        report = json.load(f)
+except (OSError, ValueError):
+    # exit 2 (usage error): graftlint wrote its diagnostic to stderr
+    # above and no findings report exists
+    print("graftlint: usage error (no findings report)")
+    sys.exit(0)
+for finding in report["findings"]:
+    print("{path}:{line}: [{rule}] {message}".format(**finding))
+print(f"graftlint: {report['count']} finding(s)")
+EOF
+fi
+
+# ---- ruff ------------------------------------------------------------------
+echo "=== ruff"
+if python -m ruff --version >/dev/null 2>&1; then
+  if python -m ruff check "$@"; then
+    ruff_status=pass
+    echo "ruff: clean"
+  else
+    ruff_status=fail
+    fail=1
+  fi
+else
+  ruff_status=skipped
+  echo "ruff: skipped (not installed in this image; config pinned in [tool.ruff])"
+fi
+
+# ---- mypy ------------------------------------------------------------------
+# NB: mypy's scope is FIXED to the files list pinned in [tool.mypy]
+# (the package), regardless of the paths passed to this script — the
+# typing bar applies to the package only, and a scoped graftlint/ruff
+# run should not silently imply those extra paths were type-checked.
+echo "=== mypy (scope pinned in [tool.mypy], ignores script paths)"
+if python -m mypy --version >/dev/null 2>&1; then
+  if python -m mypy; then
+    mypy_status=pass
+    echo "mypy: clean"
+  else
+    mypy_status=fail
+    fail=1
+  fi
+else
+  mypy_status=skipped
+  echo "mypy: skipped (not installed in this image; config pinned in [tool.mypy])"
+fi
+
+# ---- summary ---------------------------------------------------------------
+python - "$SUMMARY" "$GRAFT_JSON" \
+    "$graftlint_status" "$ruff_status" "$mypy_status" <<'EOF'
+import json, sys
+out, graft_json, graftlint, ruff, mypy = sys.argv[1:6]
+try:
+    with open(graft_json) as f:
+        findings = json.load(f)["findings"]
+except (OSError, ValueError, KeyError):
+    findings = []
+summary = {
+    "tools": [
+        {"name": "graftlint", "status": graftlint, "findings": findings},
+        {"name": "ruff", "status": ruff},
+        {"name": "mypy", "status": mypy},
+    ],
+    "ok": graftlint == "pass" and "fail" not in (ruff, mypy),
+}
+with open(out, "w") as f:
+    json.dump(summary, f, indent=2)
+print(json.dumps(summary if findings else {
+    k: ([{t["name"]: t["status"]} for t in summary["tools"]]
+        if k == "tools" else v)
+    for k, v in summary.items()
+}))
+EOF
+
+if [ "$fail" -eq 0 ]; then
+  echo "lint: gate clean (graftlint=$graftlint_status ruff=$ruff_status mypy=$mypy_status; summary: $SUMMARY)"
+  exit 0
+fi
+echo "lint: FAILURES (graftlint=$graftlint_status ruff=$ruff_status mypy=$mypy_status; summary: $SUMMARY)" >&2
+exit 1

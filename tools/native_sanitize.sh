@@ -1,50 +1,91 @@
 #!/bin/sh
-# Sanitizer pass over the native C++ evaluators: ASan+UBSan builds of
-# forest_eval.cpp and knn_eval.cpp driven across the reference corpus,
-# nonfinite/odd-shape inputs (including the exact 8-row query block),
-# chunk-boundary corpus sizes, and irregular freshly-fit sklearn forests
-# (exercising the DFS-preorder remap). The sanitized builds go through
-# the SAME LazyLib machinery the real loaders use — with the sanitizer
-# flags on the LazyLib itself, so even a mid-run rebuild stays
-# sanitized. Exits 0 iff everything is clean. Not part of the test
-# suite (the LD_PRELOAD ASan runtime is too invasive for pytest); run
-# standalone: `sh tools/native_sanitize.sh`.
-set -e
-cd "$(dirname "$0")/.."
+# Three-sanitizer gate over the native C++ host spine:
+#
+#   asan   ASan(+UBSan) builds of forest_eval.cpp and knn_eval.cpp driven
+#          across nonfinite/odd-shape inputs (including the exact 8-row
+#          query block), chunk-boundary corpus sizes, and irregular
+#          freshly-fit sklearn forests (the DFS-preorder remap) — plus
+#          the reference corpus when /root/reference is present. The
+#          sanitized builds go through the SAME LazyLib machinery the
+#          real loaders use, so even a mid-run rebuild stays sanitized.
+#   ubsan  UBSan-only build of flow_engine.cpp linked against the
+#          feed/flush driver (tools/sanitize_feed_flush.cpp): integer/
+#          pointer UB under both single- and multi-threaded load.
+#   tsan   ThreadSanitizer build of the same pair, driving concurrent
+#          tc_engine_feed / tc_engine_flush / bookkeeping-poll threads —
+#          the engine's mutex contract, checked for real (a lock removal
+#          fails this phase with TSan exit 66, verified).
+#
+# Exits 0 iff every phase is clean, and always writes a machine-readable
+# per-phase summary (JSON) to $NATIVE_SANITIZE_SUMMARY (default: a
+# per-run /tmp/native_sanitize_summary.<pid>.json, path echoed on exit)
+# — the chaos/lint tooling reads phase names from there rather than
+# scraping logs. Not part of the
+# pytest suite (the LD_PRELOAD ASan runtime is too invasive for pytest);
+# run standalone: `sh tools/native_sanitize.sh`.
+cd "$(dirname "$0")/.." || exit 2
 
-PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu ASAN_OPTIONS=detect_leaks=0 \
-LD_PRELOAD="$(g++ -print-file-name=libasan.so)" python - <<'EOF'
+# per-run default so concurrent runs don't overwrite each other's
+# summary; set NATIVE_SANITIZE_SUMMARY for a stable location
+SUMMARY="${NATIVE_SANITIZE_SUMMARY:-/tmp/native_sanitize_summary.$$.json}"
+# per-run scratch dir: concurrent runs (CI matrix, two worktrees) must
+# not execute each other's half-rebuilt driver binaries
+WORK="$(mktemp -d /tmp/native_sanitize.XXXXXX)" || exit 2
+trap 'rm -rf "$WORK"' EXIT
+asan_status=fail
+ubsan_status=fail
+tsan_status=fail
+
+# ---- phase 1: asan (ASan+UBSan on the ctypes evaluators) -------------------
+echo "=== phase asan: forest_eval + knn_eval under ASan+UBSan"
+if PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu ASAN_OPTIONS=detect_leaks=0 \
+   NATIVE_SANITIZE_WORK="$WORK" \
+   LD_PRELOAD="$(g++ -print-file-name=libasan.so)" python - <<'EOF'
+import os
+
+WORK = os.environ["NATIVE_SANITIZE_WORK"]
+
 import numpy as np
 import traffic_classifier_sdn_tpu.native.forest as nf
 import traffic_classifier_sdn_tpu.native.knn as nk
 
 SAN = ("-O1", "-g", "-fsanitize=address,undefined",
        "-fno-sanitize-recover=all")
-nf._lazy = nf.LazyLib(nf._lazy._src, "/tmp/_fe_asan.so",
+nf._lazy = nf.LazyLib(nf._lazy._src, WORK + "/fe_asan.so",
                       "asan forest", flags=SAN)
-nk._lazy = nk.LazyLib(nk._lazy._src, "/tmp/_knn_asan.so",
+nk._lazy = nk.LazyLib(nk._lazy._src, WORK + "/knn_asan.so",
                       "asan knn", flags=SAN + ("-march=native",))
 
-from traffic_classifier_sdn_tpu.io import sklearn_import as ski
-from traffic_classifier_sdn_tpu.io.datasets import load_reference_datasets
-
-d = ski.import_forest('/root/reference/models/RandomForestClassifier')
-f = nf.NativeForest(d)
-ds = load_reference_datasets('/root/reference/datasets')
-X = ds.X.astype(np.float32)
-f.predict(X)
-f.predict_proba(X[:256])
+rng = np.random.RandomState(0)
 bad = np.zeros((13, 12), np.float32)
 bad[0] = -np.inf; bad[1] = np.nan; bad[2] = np.inf
-for Xs in (bad, X[:1], X[:8], X[:255], X[:257]):
-    f.predict(Xs)
-print('forest: asan/ubsan clean', flush=True)
 
-h = nk.NativeKnn(ski.import_knn('/root/reference/models/KNeighbors'))
-# 8 = exactly one query block (kQueryBlock): the no-tail path
-for Xs in (X, X[:1], X[:7], X[:8], X[:9], bad):
-    h.predict(Xs)
-rng = np.random.RandomState(0)
+# Reference checkpoints/datasets when baked into the image; the
+# synthetic sweeps below cover the same code paths when they are not.
+if os.path.isdir('/root/reference'):
+    from traffic_classifier_sdn_tpu.io import sklearn_import as ski
+    from traffic_classifier_sdn_tpu.io.datasets import (
+        load_reference_datasets,
+    )
+
+    d = ski.import_forest('/root/reference/models/RandomForestClassifier')
+    f = nf.NativeForest(d)
+    ds = load_reference_datasets('/root/reference/datasets')
+    X = ds.X.astype(np.float32)
+    f.predict(X)
+    f.predict_proba(X[:256])
+    for Xs in (bad, X[:1], X[:8], X[:255], X[:257]):
+        f.predict(Xs)
+    h = nk.NativeKnn(ski.import_knn('/root/reference/models/KNeighbors'))
+    # 8 = exactly one query block (kQueryBlock): the no-tail path
+    for Xs in (X, X[:1], X[:7], X[:8], X[:9], bad):
+        h.predict(Xs)
+    print('reference corpus: asan/ubsan clean', flush=True)
+else:
+    print('NOTE: /root/reference absent — synthetic sweeps only',
+          flush=True)
+
+# chunk-boundary corpus sizes + the 8-row query block, synthetic
 for S in (5, 255, 256, 257, 511, 513):
     hh = nk.NativeKnn({
         'fit_X': rng.rand(S, 12),
@@ -53,12 +94,14 @@ for S in (5, 255, 256, 257, 511, 513):
     })
     hh.predict(np.asarray(rng.rand(33, 12), np.float32))
     hh.predict(np.asarray(rng.rand(16, 12), np.float32))  # N % 8 == 0
+    hh.predict(bad)
     hh.close()
 print('knn: asan/ubsan clean', flush=True)
 
 import warnings
 warnings.filterwarnings('ignore')
 from sklearn.ensemble import RandomForestClassifier
+from traffic_classifier_sdn_tpu.io import sklearn_import as ski
 for t in range(3):
     Xt = rng.randint(0, 5, (300, 12)).astype(np.float64)
     yt = rng.randint(0, 4, 300)
@@ -69,7 +112,49 @@ for t in range(3):
     # hand-set) — the fuzz exercises exactly the production layout
     ff = nf.NativeForest(ski.forest_dict_from_estimator(est))
     ff.predict(np.asarray(rng.rand(77, 12) * 6, np.float32))
+    ff.predict(bad)
     ff.close()
 print('irregular-forest remap: asan/ubsan clean', flush=True)
 EOF
-echo "native_sanitize: all clean"
+then
+  asan_status=pass
+fi
+
+# ---- phase 2: ubsan (flow_engine + feed/flush driver) ----------------------
+echo "=== phase ubsan: flow_engine under UBSan (single + multi thread)"
+if g++ -O1 -g -fsanitize=undefined -fno-sanitize-recover=all \
+     -std=c++17 -pthread -o "$WORK/tc_ubsan_drv" \
+     tools/sanitize_feed_flush.cpp \
+     traffic_classifier_sdn_tpu/native/flow_engine.cpp \
+   && "$WORK/tc_ubsan_drv" \
+   && TC_ENGINE_THREADS=4 "$WORK/tc_ubsan_drv"; then
+  ubsan_status=pass
+  echo "flow_engine: ubsan clean"
+fi
+
+# ---- phase 3: tsan (concurrent feed/flush) ---------------------------------
+echo "=== phase tsan: concurrent tc_engine_feed/tc_engine_flush under TSan"
+if g++ -O1 -g -fsanitize=thread \
+     -std=c++17 -pthread -o "$WORK/tc_tsan_drv" \
+     tools/sanitize_feed_flush.cpp \
+     traffic_classifier_sdn_tpu/native/flow_engine.cpp \
+   && TSAN_OPTIONS=halt_on_error=1 "$WORK/tc_tsan_drv" \
+   && TSAN_OPTIONS=halt_on_error=1 TC_ENGINE_THREADS=4 "$WORK/tc_tsan_drv"
+then
+  tsan_status=pass
+  echo "flow_engine: tsan clean"
+fi
+
+# ---- summary ---------------------------------------------------------------
+printf '{"phases": [{"name": "asan", "status": "%s"}, {"name": "ubsan", "status": "%s"}, {"name": "tsan", "status": "%s"}], "ok": %s}\n' \
+  "$asan_status" "$ubsan_status" "$tsan_status" \
+  "$([ "$asan_status$ubsan_status$tsan_status" = passpasspass ] \
+     && echo true || echo false)" > "$SUMMARY"
+cat "$SUMMARY"
+
+if [ "$asan_status$ubsan_status$tsan_status" = passpasspass ]; then
+  echo "native_sanitize: all clean (summary: $SUMMARY)"
+  exit 0
+fi
+echo "native_sanitize: FAILURES (asan=$asan_status ubsan=$ubsan_status tsan=$tsan_status)" >&2
+exit 1

@@ -1,0 +1,156 @@
+// Sanitizer driver for native/flow_engine.cpp: hammer tc_engine_feed and
+// tc_engine_flush from DIFFERENT threads, with a third thread polling the
+// bookkeeping surface — the exact concurrency the engine's mutex contract
+// promises (ctypes releases the GIL during foreign calls, so a Python
+// reader thread feeding while the classify loop flushes is real C++-level
+// concurrency). Built twice by tools/native_sanitize.sh: once with
+// -fsanitize=undefined (UB under single- and multi-thread load) and once
+// with -fsanitize=thread (data races in the feed/flush interleaving).
+//
+// Also self-checks semantics so a silent lock-ordering bug can't pass as
+// "no race": every parsed record must come back out of flush exactly once
+// (capacity exceeds the synthetic flow population, so nothing is dropped),
+// and chunks are deliberately split mid-line so the tail-carry seam runs
+// concurrently with flush too.
+//
+// Compile: g++ <sanitizer flags> -std=c++17 -pthread \
+//     tools/sanitize_feed_flush.cpp traffic_classifier_sdn_tpu/native/flow_engine.cpp
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* tc_engine_create(uint32_t capacity, uint32_t max_batch);
+void tc_engine_destroy(void* h);
+uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len);
+uint64_t tc_engine_pending(void* h);
+uint32_t tc_engine_flush(void* h, int32_t* slot, int32_t* time,
+                         uint32_t* pkts_lo, float* pkts_f,
+                         uint32_t* bytes_lo, float* bytes_f,
+                         uint8_t* is_fwd, uint8_t* is_create);
+int tc_engine_last_flush_conflict(void* h);
+uint64_t tc_engine_dropped(void* h);
+uint64_t tc_engine_parsed(void* h);
+int32_t tc_engine_last_time(void* h);
+uint32_t tc_engine_num_flows(void* h);
+int tc_engine_slot_meta(void* h, uint32_t slot, char* src_out,
+                        char* dst_out, uint32_t cap);
+uint32_t tc_engine_export_index(void* h, uint64_t* fp_out,
+                                uint8_t* used_out);
+}
+
+namespace {
+
+constexpr uint32_t kCap = 4096;
+constexpr uint32_t kMaxBatch = 256;
+constexpr int kChunks = 400;
+constexpr int kLinesPerChunk = 200;
+constexpr int kFlows = 1000;  // < kCap: nothing is ever dropped
+
+}  // namespace
+
+int main() {
+  void* eng = tc_engine_create(kCap, kMaxBatch);
+  if (eng == nullptr) {
+    std::fprintf(stderr, "tc_engine_create failed\n");
+    return 1;
+  }
+  std::atomic<bool> done{false};
+
+  std::thread feeder([&] {
+    uint64_t counter = 1;
+    for (int c = 0; c < kChunks; ++c) {
+      std::string chunk;
+      for (int l = 0; l < kLinesPerChunk; ++l) {
+        int flow = (c * kLinesPerChunk + l) % kFlows;
+        char line[256];
+        int n = std::snprintf(
+            line, sizeof line,
+            "data\t%d\tdp%d\t1\taa:bb:%02x:%02x\tcc:dd:%02x:%02x\t2"
+            "\t%llu\t%llu\n",
+            c + 1, flow % 7, flow & 0xff, (flow >> 8) & 0xff,
+            flow & 0xff, (flow >> 8) & 0xff,
+            static_cast<unsigned long long>(counter),
+            static_cast<unsigned long long>(counter * 64));
+        chunk.append(line, static_cast<size_t>(n));
+        ++counter;
+      }
+      // split mid-line: the partial-line tail carry must be safe
+      // against a concurrent flush as well
+      size_t half = chunk.size() / 2;
+      tc_engine_feed(eng, chunk.data(), half);
+      tc_engine_feed(eng, chunk.data() + half, chunk.size() - half);
+    }
+    done.store(true);
+  });
+
+  std::atomic<uint64_t> rows{0};
+  std::thread flusher([&] {
+    std::vector<int32_t> slot(kMaxBatch), time_(kMaxBatch);
+    std::vector<uint32_t> pkts_lo(kMaxBatch), bytes_lo(kMaxBatch);
+    std::vector<float> pkts_f(kMaxBatch), bytes_f(kMaxBatch);
+    std::vector<uint8_t> is_fwd(kMaxBatch), is_create(kMaxBatch);
+    while (true) {
+      uint32_t n = tc_engine_flush(
+          eng, slot.data(), time_.data(), pkts_lo.data(), pkts_f.data(),
+          bytes_lo.data(), bytes_f.data(), is_fwd.data(),
+          is_create.data());
+      tc_engine_last_flush_conflict(eng);
+      if (n == 0) {
+        if (done.load() && tc_engine_pending(eng) == 0) break;
+        std::this_thread::yield();
+        continue;
+      }
+      rows += n;
+    }
+  });
+
+  std::thread poller([&] {
+    char src[64], dst[64];
+    std::vector<uint64_t> fp(kCap);
+    std::vector<uint8_t> used(kCap);
+    while (!done.load()) {
+      tc_engine_parsed(eng);
+      tc_engine_dropped(eng);
+      tc_engine_num_flows(eng);
+      tc_engine_last_time(eng);
+      tc_engine_pending(eng);
+      tc_engine_slot_meta(eng, 0, src, dst, sizeof src);
+      tc_engine_export_index(eng, fp.data(), used.data());
+      std::this_thread::yield();
+    }
+  });
+
+  feeder.join();
+  flusher.join();
+  poller.join();
+
+  const uint64_t expect =
+      static_cast<uint64_t>(kChunks) * kLinesPerChunk;
+  uint64_t parsed = tc_engine_parsed(eng);
+  uint64_t dropped = tc_engine_dropped(eng);
+  int rc = 0;
+  if (parsed != expect || dropped != 0 || rows.load() != expect) {
+    std::fprintf(stderr,
+                 "parity failure: parsed=%llu dropped=%llu rows=%llu "
+                 "expected=%llu\n",
+                 static_cast<unsigned long long>(parsed),
+                 static_cast<unsigned long long>(dropped),
+                 static_cast<unsigned long long>(rows.load()),
+                 static_cast<unsigned long long>(expect));
+    rc = 1;
+  }
+  tc_engine_destroy(eng);
+  if (rc == 0) {
+    std::printf("feed/flush driver: %llu records in, %llu rows out, "
+                "0 dropped\n",
+                static_cast<unsigned long long>(parsed),
+                static_cast<unsigned long long>(rows.load()));
+  }
+  return rc;
+}

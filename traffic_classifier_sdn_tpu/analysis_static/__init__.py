@@ -1,0 +1,31 @@
+"""graftlint — project-native static analysis for the jax_graft layers.
+
+The reference implementation is a 315-line script whose heavy numerics
+hide inside sklearn's compiled internals; this reproduction replaced
+that surface with jitted JAX, Pallas kernels, threaded ingest, and
+ctypes-wrapped C++ evaluators — exactly the layers where silent
+invariant violations (host syncs inside jit, un-typed CDLL calls,
+unlocked cross-thread mutation, unregistered fault sites) produce
+wrong-but-plausible results rather than crashes. graftlint encodes
+those invariants as AST rules and enforces them in tier-1
+(tests/test_graftlint.py runs the whole package through it and asserts
+zero findings), so the guarantee compounds across every future PR.
+
+Run it:
+
+    python -m traffic_classifier_sdn_tpu.analysis_static <paths> [--json]
+    tools/lint.sh            # graftlint + ruff + mypy one-shot gate
+
+Suppress a finding with a trailing comment that CARRIES A REASON::
+
+    x = risky()  # graftlint: disable=rule-id -- why this is safe
+
+A ``disable`` comment without a reason is itself a finding
+(``bad-suppression``) that cannot be suppressed. Each rule is
+documented in docs/STATIC_ANALYSIS.md.
+"""
+
+from .framework import Finding, LintRunner, Rule, lint_paths
+from .rules import ALL_RULES
+
+__all__ = ["Finding", "LintRunner", "Rule", "ALL_RULES", "lint_paths"]

@@ -1,0 +1,88 @@
+"""CLI: ``python -m traffic_classifier_sdn_tpu.analysis_static``.
+
+Exit status: 0 clean, 1 findings, 2 usage error. ``tools/lint.sh``
+wraps this together with the generic ruff/mypy baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .framework import LintRunner, _iter_py_files, render_report
+from .rules import ALL_RULES
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m traffic_classifier_sdn_tpu.analysis_static",
+        description="graftlint: project-native static analysis "
+                    "(JAX/ctypes/concurrency invariants)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the package)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable findings on stdout",
+    )
+    parser.add_argument(
+        "--select",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print rule ids and descriptions, then exit",
+    )
+    args = parser.parse_args(argv)
+
+    rules = [cls() for cls in ALL_RULES]
+    all_ids = [r.id for r in rules]
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id:22s} {r.description}")
+        return 0
+    if args.select:
+        wanted = {s.strip() for s in args.select.split(",") if s.strip()}
+        if not wanted:
+            # running zero rules would print "clean" for a tree that
+            # was never linted — a typo'd --select must not pass a gate
+            print("--select given but no rule ids parsed",
+                  file=sys.stderr)
+            return 2
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+
+    paths = args.paths or [
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+        if not os.path.isdir(p) and not p.endswith(".py"):
+            # _iter_py_files would silently skip it and the run would
+            # report "clean" for a target that was never linted
+            print(f"not a directory or .py file: {p}", file=sys.stderr)
+            return 2
+    if not any(True for _ in _iter_py_files(paths)):
+        # a directory holding zero .py files (typo'd data dir, emptied
+        # by a refactor) would otherwise report "clean" for a target
+        # that was never linted — same hazard as the non-.py guard
+        print("no .py files found under the given path(s)",
+              file=sys.stderr)
+        return 2
+
+    findings = LintRunner(rules, known_ids=all_ids).run(paths)
+    print(render_report(findings, as_json=args.json))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

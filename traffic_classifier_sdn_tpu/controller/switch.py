@@ -25,7 +25,6 @@ Behavioral parity notes:
 from __future__ import annotations
 
 import asyncio
-import struct
 import sys
 import time
 from dataclasses import dataclass, field

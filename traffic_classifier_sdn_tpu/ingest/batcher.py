@@ -15,7 +15,7 @@ in-place in HBM.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Iterable, Sequence
+from collections.abc import Iterable, Sequence
 
 import jax
 import numpy as np

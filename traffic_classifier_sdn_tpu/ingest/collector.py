@@ -20,7 +20,6 @@ import queue
 import signal
 import subprocess
 import threading
-from typing import Iterable
 
 from ..utils.faults import FaultInjected, fault_bytes
 from .protocol import TelemetryRecord, parse_line
@@ -42,23 +41,39 @@ class SubprocessCollector:
         self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
         self._proc: subprocess.Popen | None = None
         self._thread: threading.Thread | None = None
-        self.lines_dropped = 0
+        # Written by the reader thread, read by the classify loop and
+        # the supervisor's drain: every access holds _drop_lock
+        # (graftlint's lock-discipline rule enforces this statically;
+        # an unlocked += is two interpreter ops and can lose increments
+        # under free-threaded builds or a mid-statement drain).
+        self._drop_lock = threading.Lock()
+        self._lines_dropped = 0
+        # The reader thread's fault path calls stop(), which writes
+        # self._proc = None while the classify loop may be inside
+        # running/returncode/stop polling the same handle — a TOCTOU
+        # that turns into AttributeError on .pid/.poll. Every _proc
+        # access snapshots the handle under this lock; the Popen object
+        # itself is thread-safe to poll once you hold a reference.
+        self._proc_lock = threading.Lock()
 
     def start(self) -> None:
-        self._proc = subprocess.Popen(
-            self.cmd,
-            shell=True,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            preexec_fn=os.setsid,
-        )
+        with self._proc_lock:
+            self._proc = subprocess.Popen(
+                self.cmd,
+                shell=True,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                preexec_fn=os.setsid,
+            )
         self._thread = threading.Thread(target=self._reader, daemon=True)
         self._thread.start()
 
     def _reader(self) -> None:
-        assert self._proc is not None and self._proc.stdout is not None
+        with self._proc_lock:
+            proc = self._proc
+        assert proc is not None and proc.stdout is not None
         if self.raw:
-            stream = self._proc.stdout
+            stream = proc.stdout
             drop_seam = False
             while True:
                 chunk = stream.read1(1 << 16)
@@ -77,9 +92,9 @@ class SubprocessCollector:
                     return
                 truncated = len(short) != len(chunk)
                 if truncated:
-                    self.lines_dropped += chunk.count(b"\n") - short.count(
-                        b"\n"
-                    )
+                    lost = chunk.count(b"\n") - short.count(b"\n")
+                    with self._drop_lock:
+                        self._lines_dropped += lost
                     chunk = short
                 if drop_seam:
                     # a dropped/truncated chunk broke line framing: poison
@@ -97,10 +112,12 @@ class SubprocessCollector:
                     self._queue.put_nowait(chunk)
                     drop_seam = truncated
                 except queue.Full:
-                    self.lines_dropped += chunk.count(b"\n")
+                    lost = chunk.count(b"\n")
+                    with self._drop_lock:
+                        self._lines_dropped += lost
                     drop_seam = True
             return
-        for line in self._proc.stdout:
+        for line in proc.stdout:
             r = parse_line(line)
             if r is None:
                 continue
@@ -108,7 +125,16 @@ class SubprocessCollector:
                 self._queue.put_nowait(r)
             except queue.Full:
                 # back-pressure: drop oldest-style accounting, keep newest
-                self.lines_dropped += 1
+                with self._drop_lock:
+                    self._lines_dropped += 1
+
+    @property
+    def lines_dropped(self) -> int:
+        """Lines lost to queue overflow or injected truncation (same
+        counter the pre-lock attribute exposed; the reader thread owns
+        the writes, so reads synchronize on the same lock)."""
+        with self._drop_lock:
+            return self._lines_dropped
 
     def poll_records(self, max_records: int = 1 << 20) -> list[TelemetryRecord]:
         """Drain whatever has arrived (non-blocking)."""
@@ -128,13 +154,17 @@ class SubprocessCollector:
 
     @property
     def running(self) -> bool:
-        return self._proc is not None and self._proc.poll() is None
+        with self._proc_lock:
+            proc = self._proc
+        return proc is not None and proc.poll() is None
 
     @property
     def returncode(self) -> int | None:
         """Exit status of the monitor process (None while running or
         before start)."""
-        return self._proc.poll() if self._proc is not None else None
+        with self._proc_lock:
+            proc = self._proc
+        return proc.poll() if proc is not None else None
 
     @property
     def finished(self) -> bool:
@@ -151,12 +181,13 @@ class SubprocessCollector:
     def stop(self) -> None:
         """Terminate the monitor's process group (the reference's
         ``os.killpg`` teardown at traffic_classifier.py:222)."""
-        if self._proc is not None and self._proc.poll() is None:
+        with self._proc_lock:
+            proc, self._proc = self._proc, None
+        if proc is not None and proc.poll() is None:
             try:
-                os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
             except ProcessLookupError:
                 pass
-        self._proc = None
 
     def drain(self) -> list:
         """All queued items (records or raw chunks), non-blocking."""

@@ -11,7 +11,7 @@ millions of concurrent flows).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Iterator
+from collections.abc import Iterator
 
 import numpy as np
 

@@ -10,7 +10,8 @@ replacement for the pickle-loading if-chain at traffic_classifier.py:229-243
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable
+from collections.abc import Callable
+from typing import Any
 
 import jax.numpy as jnp
 

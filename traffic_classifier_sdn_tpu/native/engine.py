@@ -18,7 +18,6 @@ from __future__ import annotations
 import ctypes as ct
 import os
 import threading
-from typing import Iterable
 
 import numpy as np
 
@@ -43,6 +42,7 @@ def _load():
         lib = _lazy.load()  # build machinery shared with forest.py
         lib.tc_engine_create.restype = ct.c_void_p
         lib.tc_engine_create.argtypes = [ct.c_uint32, ct.c_uint32]
+        lib.tc_engine_destroy.restype = None
         lib.tc_engine_destroy.argtypes = [ct.c_void_p]
         lib.tc_engine_feed.restype = ct.c_uint64
         lib.tc_engine_feed.argtypes = [ct.c_void_p, ct.c_char_p, ct.c_uint64]
@@ -64,7 +64,9 @@ def _load():
         lib.tc_engine_slot_meta.argtypes = [
             ct.c_void_p, ct.c_uint32, ct.c_char_p, ct.c_char_p, ct.c_uint32,
         ]
+        lib.tc_engine_release_slot.restype = None
         lib.tc_engine_release_slot.argtypes = [ct.c_void_p, ct.c_uint32]
+        lib.tc_engine_release_slots.restype = None
         lib.tc_engine_release_slots.argtypes = [
             ct.c_void_p, ct.c_void_p, ct.c_uint32,
         ]
@@ -74,13 +76,16 @@ def _load():
         ]
         lib.tc_engine_export_free.restype = ct.c_uint32
         lib.tc_engine_export_free.argtypes = [ct.c_void_p, ct.c_void_p]
+        lib.tc_engine_import_slots.restype = None
         lib.tc_engine_import_slots.argtypes = [
             ct.c_void_p, ct.c_void_p, ct.c_void_p, ct.c_void_p,
             ct.c_void_p, ct.c_uint32,
         ]
+        lib.tc_engine_import_finish.restype = None
         lib.tc_engine_import_finish.argtypes = [
             ct.c_void_p, ct.c_uint32, ct.c_int32, ct.c_void_p, ct.c_uint32,
         ]
+        lib.tc_engine_export_meta.restype = None
         lib.tc_engine_export_meta.argtypes = [
             ct.c_void_p, ct.c_void_p, ct.c_uint32, ct.c_void_p, ct.c_void_p,
         ]

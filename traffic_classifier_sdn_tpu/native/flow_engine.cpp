@@ -48,6 +48,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -284,6 +285,14 @@ struct Engine {
   std::vector<uint8_t> occ_bits;
   std::string tail;  // partial line carried across feed() calls
   int last_flush_conflict = 0;  // conflict_start of the last popped gen
+  // Serializes every public entry point (see the extern "C" contract
+  // below): ctypes releases the GIL for the duration of a foreign
+  // call, so a Python reader thread feeding while the classify loop
+  // flushes is REAL C++-level concurrency. One uncontended lock per
+  // feed/flush (per chunk / per generation, never per record) is noise
+  // against the 1 Hz poll cadence; tools/native_sanitize.sh's TSan
+  // phase drives concurrent feed/flush to prove the discipline holds.
+  std::mutex mu;
 
   explicit Engine(uint32_t cap, uint32_t mb)
       : capacity(cap), max_batch(mb), slot_fp(cap, 0), slot_used(cap, 0),
@@ -584,8 +593,23 @@ void feed_threaded(Engine* e, const char* buf, size_t begin, size_t end,
   }
 }
 
+// Free one slot back to the allocator. Callers hold e->mu.
+void release_slot_locked(Engine* e, uint32_t slot) {
+  if (slot >= e->capacity || !e->slot_used[slot]) return;
+  e->key_to_slot.erase(e->slot_fp[slot]);
+  e->slot_used[slot] = 0;
+  e->slot_src[slot].clear();
+  e->slot_dst[slot].clear();
+  e->free_slots.push_back(slot);
+}
+
 }  // namespace
 
+// Concurrency contract: every function below except tc_engine_create /
+// tc_engine_destroy takes the engine mutex, so feed, flush, and the
+// bookkeeping queries may be called from different threads
+// concurrently. Destruction is the caller's ordering problem (as with
+// any handle API): no call may race tc_engine_destroy.
 extern "C" {
 
 void* tc_engine_create(uint32_t capacity, uint32_t max_batch) {
@@ -600,6 +624,7 @@ void tc_engine_destroy(void* h) { delete static_cast<Engine*>(h); }
 // Returns the number of telemetry records parsed from this chunk.
 uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len) {
   Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
   uint64_t before = e->parsed;
   size_t begin = 0;
   if (!e->tail.empty()) {
@@ -662,6 +687,7 @@ uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len) {
 
 uint64_t tc_engine_pending(void* h) {
   Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> guard(e->mu);
   uint64_t n = 0;
   for (const auto& g : e->gens) n += g.rows.size();
   return n;
@@ -675,6 +701,7 @@ uint32_t tc_engine_flush(void* h, int32_t* slot, int32_t* time,
                          uint32_t* pkts_lo, float* pkts_f, uint32_t* bytes_lo,
                          float* bytes_f, uint8_t* is_fwd, uint8_t* is_create) {
   Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> guard(e->mu);
   while (!e->gens.empty() && e->gens.front().rows.empty()) {
     e->gens.pop_front();
   }
@@ -703,17 +730,30 @@ uint32_t tc_engine_flush(void* h, int32_t* slot, int32_t* time,
 // scatter as the batch flushed before it. 0 for size-rollover
 // generations and the first generation of a drain.
 int tc_engine_last_flush_conflict(void* h) {
-  return static_cast<Engine*>(h)->last_flush_conflict;
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return e->last_flush_conflict;
 }
 
-uint64_t tc_engine_dropped(void* h) { return static_cast<Engine*>(h)->dropped; }
-uint64_t tc_engine_parsed(void* h) { return static_cast<Engine*>(h)->parsed; }
+uint64_t tc_engine_dropped(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return e->dropped;
+}
+uint64_t tc_engine_parsed(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return e->parsed;
+}
 int32_t tc_engine_last_time(void* h) {
-  return static_cast<Engine*>(h)->last_time;
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return e->last_time;
 }
 
 uint32_t tc_engine_num_flows(void* h) {
   Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
   return static_cast<uint32_t>(e->key_to_slot.used);
 }
 
@@ -723,6 +763,7 @@ uint32_t tc_engine_num_flows(void* h) {
 int tc_engine_slot_meta(void* h, uint32_t slot, char* src_out, char* dst_out,
                         uint32_t cap) {
   Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
   if (slot >= e->capacity || !e->slot_used[slot] || cap == 0) return 0;
   std::snprintf(src_out, cap, "%s", e->slot_src[slot].c_str());
   std::snprintf(dst_out, cap, "%s", e->slot_dst[slot].c_str());
@@ -734,19 +775,17 @@ int tc_engine_slot_meta(void* h, uint32_t slot, char* src_out, char* dst_out,
 // FlowStateEngine.evict_idle.
 void tc_engine_release_slot(void* h, uint32_t slot) {
   Engine* e = static_cast<Engine*>(h);
-  if (slot >= e->capacity || !e->slot_used[slot]) return;
-  e->key_to_slot.erase(e->slot_fp[slot]);
-  e->slot_used[slot] = 0;
-  e->slot_src[slot].clear();
-  e->slot_dst[slot].clear();
-  e->free_slots.push_back(slot);
+  std::lock_guard<std::mutex> g(e->mu);
+  release_slot_locked(e, slot);
 }
 
 // Bulk release: one ctypes crossing for an eviction batch instead of one
 // per slot — an idle-storm at the 2^20-flow scale releases hundreds of
 // thousands of slots in one tick.
 void tc_engine_release_slots(void* h, const uint32_t* slots, uint32_t n) {
-  for (uint32_t i = 0; i < n; ++i) tc_engine_release_slot(h, slots[i]);
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  for (uint32_t i = 0; i < n; ++i) release_slot_locked(e, slots[i]);
 }
 
 // --- serving-state checkpoint support --------------------------------------
@@ -755,6 +794,7 @@ void tc_engine_release_slots(void* h, const uint32_t* slots, uint32_t n) {
 // next_slot — the sequential-assignment frontier a restore must resume.
 uint32_t tc_engine_export_index(void* h, uint64_t* fp_out, uint8_t* used_out) {
   Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
   std::memcpy(fp_out, e->slot_fp.data(),
               static_cast<size_t>(e->capacity) * sizeof(uint64_t));
   std::memcpy(used_out, e->slot_used.data(), e->capacity);
@@ -766,6 +806,7 @@ uint32_t tc_engine_export_index(void* h, uint64_t* fp_out, uint8_t* used_out) {
 // restored engine's future slot assignments to match a never-stopped one.
 uint32_t tc_engine_export_free(void* h, uint32_t* out) {
   Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
   std::memcpy(out, e->free_slots.data(),
               e->free_slots.size() * sizeof(uint32_t));
   return static_cast<uint32_t>(e->free_slots.size());
@@ -778,6 +819,7 @@ void tc_engine_import_slots(void* h, const uint32_t* slots,
                             const uint64_t* fps, const char* src,
                             const char* dst, uint32_t n) {
   Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
   for (uint32_t i = 0; i < n; ++i) {
     uint32_t s = slots[i];
     if (s >= e->capacity || e->slot_used[s]) continue;
@@ -798,6 +840,7 @@ void tc_engine_import_slots(void* h, const uint32_t* slots,
 void tc_engine_import_finish(void* h, uint32_t next_slot, int32_t last_time,
                              const uint32_t* free_list, uint32_t n_free) {
   Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
   e->next_slot = next_slot;
   e->last_time = last_time;
   e->free_slots.assign(free_list, free_list + n_free);
@@ -808,6 +851,7 @@ void tc_engine_import_finish(void* h, uint32_t next_slot, int32_t last_time,
 void tc_engine_export_meta(void* h, const uint32_t* slots, uint32_t n,
                            char* src_out, char* dst_out) {
   Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
   for (uint32_t i = 0; i < n; ++i) {
     uint32_t s = slots[i];
     char* so = src_out + static_cast<size_t>(i) * 64;

@@ -50,10 +50,13 @@ def _load():
             ct.c_uint32, ct.c_uint32, ct.c_uint32,
             ct.c_void_p, ct.c_void_p, ct.c_void_p, ct.c_void_p, ct.c_void_p,
         ]
+        lib.tcf_destroy.restype = None
         lib.tcf_destroy.argtypes = [ct.c_void_p]
+        lib.tcf_predict.restype = None
         lib.tcf_predict.argtypes = [
             ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
         ]
+        lib.tcf_proba.restype = None
         lib.tcf_proba.argtypes = [
             ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
         ]

@@ -47,7 +47,9 @@ def _load():
             ct.c_uint32, ct.c_uint32, ct.c_uint32, ct.c_uint32,
             ct.c_void_p, ct.c_void_p,
         ]
+        lib.tck_destroy.restype = None
         lib.tck_destroy.argtypes = [ct.c_void_p]
+        lib.tck_predict.restype = None
         lib.tck_predict.argtypes = [
             ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
         ]

@@ -38,7 +38,8 @@ def pad_corpus(d: dict, n_shards: int) -> dict:
         return d
     out = dict(d)
     out["fit_X"] = np.concatenate(
-        [d["fit_X"], np.zeros((pad, d["fit_X"].shape[1]))], axis=0
+        [d["fit_X"], np.zeros((pad, d["fit_X"].shape[1]),
+                              d["fit_X"].dtype)], axis=0
     )
     out["y"] = np.concatenate([d["y"], np.zeros(pad, d["y"].dtype)])
     out["pad_mask"] = np.concatenate(

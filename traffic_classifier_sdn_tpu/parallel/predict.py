@@ -12,7 +12,8 @@ decode, the flow table) can stay distributed.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable
+from collections.abc import Callable
+from typing import Any
 
 import jax
 

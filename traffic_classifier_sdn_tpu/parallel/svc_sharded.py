@@ -44,7 +44,7 @@ def pad_support(d: dict, n_shards: int) -> dict:
     )
     dual = np.asarray(d["dual_coef"], np.float64)
     out["dual_coef"] = np.concatenate(
-        [dual, np.zeros((dual.shape[0], pad))], axis=1
+        [dual, np.zeros((dual.shape[0], pad), np.float64)], axis=1
     )
     return out
 

@@ -44,7 +44,8 @@ def fit_gnb(mesh, X, y, n_classes: int, *,
     y = np.asarray(y, np.int32)
     pad = (-len(y)) % d
     if pad:
-        X = np.concatenate([X, np.zeros((pad, X.shape[1]))], axis=0)
+        X = np.concatenate([X, np.zeros((pad, X.shape[1]), X.dtype)],
+                           axis=0)
         y = np.concatenate([y, np.full(pad, -1, np.int32)])
     Xs = jax.device_put(jnp.asarray(X), batch_sharded(mesh))
     ys = jax.device_put(jnp.asarray(y), batch_sharded(mesh))

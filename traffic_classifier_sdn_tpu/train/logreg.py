@@ -10,7 +10,6 @@ across the mesh's data axis by XLA when the batch is sharded).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -57,8 +56,8 @@ def fit(
         sd = jnp.where(jnp.std(X, axis=0) == 0, 1.0, jnp.std(X, axis=0))
         Xs = (X - mu) / sd
     else:
-        mu = jnp.zeros(F)
-        sd = jnp.ones(F)
+        mu = jnp.zeros(F, X.dtype)
+        sd = jnp.ones(F, X.dtype)
         Xs = X
 
     def flat_loss(w):

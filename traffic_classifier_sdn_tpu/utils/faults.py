@@ -24,28 +24,13 @@ Design constraints, in order:
    unrelated tests fail loudly with ``FaultInjected`` rather than
    silently corrupt state.
 
-Sites currently threaded (grep for ``fault_point(``/``fault_bytes(``):
-
-===========================  ===============================================
-``serving_ckpt.write``       io/serving_checkpoint.save — after the temp
-                             file is written, before the atomic rename
-                             (a fire == crash mid-checkpoint: the temp is
-                             torn away, the previous checkpoint survives)
-``serving_ckpt.rename``      io/serving_checkpoint.save — at the rename
-                             itself
-``serving_ckpt.restore``     io/serving_checkpoint.restore entry
-``train_ckpt.write``         io/checkpoint manifest commit (model and
-                             train-state saves)
-``collector.read``           ingest/collector raw reader, per pipe chunk;
-                             ``truncate`` drops the chunk tail mid-record
-                             (framing must poison the seam), ``raise``
-                             kills the monitor mid-stream
-``supervisor.restart``       ingest/supervisor — the restart attempt
-                             itself fails (spawn failure); consumes one
-                             restart-budget slot and re-enters backoff
-``native.load``              native/engine.available() — the C++ engine
-                             is unavailable (build/dlopen failure)
-===========================  ===============================================
+The canonical site table is ``SITES`` below — the single source of
+truth the static analyzer's ``fault-site-registry`` rule enforces: every
+site string used at an injection seam must be registered here, every
+registered site must be threaded through at least one seam, and every
+registered site must have a chaos test (tests/test_chaos.py) referencing
+it. ``tools/chaos_matrix.sh`` sweeps the same table, so a seam can
+neither be added without coverage nor silently lose it.
 """
 
 from __future__ import annotations
@@ -53,6 +38,42 @@ from __future__ import annotations
 import contextlib
 import random
 from dataclasses import dataclass, field
+
+# The canonical fault-site registry. Keys are the exact strings passed to
+# fault_point()/fault_bytes() (or the *_site kwargs of atomicio's
+# atomic_write_bytes); values say where the seam lives and what a fire
+# simulates. Enforced by graftlint's fault-site-registry rule (see
+# docs/STATIC_ANALYSIS.md): unregistered use, registered-but-unthreaded,
+# and registered-but-chaos-untested are all tier-1 lint failures.
+SITES: dict[str, str] = {
+    "serving_ckpt.write": (
+        "io/serving_checkpoint.save — temp file half-written (a fire == "
+        "crash mid-checkpoint: the temp is torn away, the previous "
+        "checkpoint survives)"
+    ),
+    "serving_ckpt.rename": (
+        "io/serving_checkpoint.save — complete fsynced temp, crash at "
+        "the atomic rename itself (durability without visibility)"
+    ),
+    "serving_ckpt.restore": "io/serving_checkpoint.restore entry",
+    "train_ckpt.write": (
+        "io/checkpoint manifest commit (model and train-state saves)"
+    ),
+    "collector.read": (
+        "ingest/collector raw reader, per pipe chunk; 'truncate' drops "
+        "the chunk tail mid-record (framing must poison the seam), "
+        "'raise' kills the monitor mid-stream"
+    ),
+    "supervisor.restart": (
+        "ingest/supervisor — the restart attempt itself fails (spawn "
+        "failure); consumes one restart-budget slot and re-enters "
+        "backoff"
+    ),
+    "native.load": (
+        "native/engine.available() — the C++ engine is unavailable "
+        "(build/dlopen failure)"
+    ),
+}
 
 
 class FaultInjected(RuntimeError):

@@ -8,7 +8,7 @@ Status``.
 
 from __future__ import annotations
 
-from typing import Iterable, Sequence
+from collections.abc import Iterable, Sequence
 
 CLASSIFIER_FIELDS = (
     "Flow ID",
