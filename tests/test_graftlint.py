@@ -287,6 +287,50 @@ def test_lock_discipline_clean(tmp_path):
     assert run_rule(tmp_path, LockDisciplineRule, LOCK_NEGATIVE) == []
 
 
+# the obs flight-recorder shape: an event ring appended from a worker
+# thread. Written WITHOUT the ring lock it is exactly the hazard
+# lock-discipline exists for — this fixture pins that the rule covers
+# the obs package's ring-writer pattern, not just counters.
+LOCK_RING_POSITIVE = """
+    import threading
+
+    class BadRecorder:
+        def __init__(self):
+            self._ring_lock = threading.Lock()
+            self._ring = []
+            self._seq = 0
+
+        def start(self):
+            threading.Thread(target=self._writer).start()
+
+        def _writer(self):
+            self._seq = self._seq + 1
+            self._ring.append(self._seq)
+
+        def tail(self):
+            return list(self._ring)
+"""
+
+
+def test_lock_discipline_covers_obs_style_ring_writers(tmp_path):
+    findings = run_rule(tmp_path, LockDisciplineRule, LOCK_RING_POSITIVE)
+    # _seq read+written and _ring read in _writer without the lock,
+    # plus the unlocked _ring read in tail()
+    assert findings
+    flagged = {f.message.split("'")[1] for f in findings}
+    assert {"self._seq", "self._ring"} <= flagged
+
+
+def test_obs_package_is_clean():
+    """The observability plane is held to the same static bar as the
+    rest of the package (lock-discipline over its ring/health locks,
+    atomic-io over its post-mortem dump, fault-site audit over its
+    observer wiring) — a scoped scan so a violation names the obs file
+    directly rather than drowning in a whole-package report."""
+    findings = lint_paths([os.path.join(PACKAGE_DIR, "obs")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 # ---------------------------------------------------------------------------
 # fault-site-registry
 # ---------------------------------------------------------------------------

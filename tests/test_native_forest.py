@@ -128,6 +128,42 @@ def test_argmax_first_max_on_exact_ties():
     assert (got == 1).all()  # first maximum, never class 2
 
 
+def test_midpoint_threshold_rounds_down_like_sklearn():
+    """f32-unsafe midpoint regression (ADVICE r5 high): sklearn stores
+    float64 midpoints of adjacent float32 feature values and compares
+    ``f32(x) <= f64(thr)``. Pick adjacent f32 values a < b whose f64
+    midpoint rounds UP to b under a plain f32 cast (ties-to-even with b
+    the even mantissa): a query at exactly b must go RIGHT (b > thr in
+    f64), but a plain-cast walk compares b <= f32(thr) == b and goes
+    left. The fuzz suite cannot catch this — its small-integer features
+    have f32-exact midpoints — so this pins the f32_safe_thresholds
+    routing directly."""
+    a = np.float32(np.nextafter(np.float32(1.0), np.float32(2.0)))
+    b = np.float32(np.nextafter(a, np.float32(2.0)))
+    thr = (np.float64(a) + np.float64(b)) / 2.0
+    # the premise of the regression: the plain cast rounds up to b
+    assert np.float32(thr) == b and np.float64(np.float32(thr)) > thr
+    left = np.array([[1, -1, -1]], np.int32)
+    right = np.array([[2, -1, -1]], np.int32)
+    feature = np.zeros((1, 3), np.int32)
+    threshold = np.array([[thr, 0.0, 0.0]], np.float64)
+    values = np.zeros((1, 3, 2))
+    values[0, 1] = [4, 0]  # left leaf -> class 0
+    values[0, 2] = [0, 4]  # right leaf -> class 1
+    d = {
+        "left": left, "right": right, "feature": feature,
+        "threshold": threshold, "values": values, "max_depth": 1,
+        "classes": np.arange(2), "n_features": 12,
+    }
+    f = native_forest.NativeForest(d)
+    X = np.zeros((2, 12), np.float32)
+    X[0, 0] = b  # exactly the upper adjacent value: must go right
+    X[1, 0] = a  # clearly below the midpoint: must go left
+    got = f.predict(X)
+    np.testing.assert_array_equal(got, _oracle(d, X))
+    np.testing.assert_array_equal(got, [1, 0])
+
+
 def test_nonfinite_features_match_oracle(forest_dict):
     """-inf / NaN / +inf feature values: numpy's `x <= thr` is True for
     -inf and False for NaN, and the walk must terminate at a real leaf

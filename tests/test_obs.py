@@ -1,0 +1,516 @@
+"""Tests for the observability plane (obs/): span tracer nesting and
+timing under an injected fake clock, Prometheus text exposition
+(golden), /healthz staleness transitions, flight-recorder ring
+integrity under concurrent spans, and the chaos story — a fault-site
+firing must leave a valid JSONL post-mortem naming the failing span.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.obs import (
+    ExpositionServer,
+    FlightRecorder,
+    HealthState,
+    Tracer,
+    prometheus_text,
+)
+from traffic_classifier_sdn_tpu.utils import faults
+from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+
+
+def test_span_nesting_and_timing_with_fake_clock():
+    clk = [100.0]
+    m = Metrics()
+    rec = FlightRecorder()
+    tr = Tracer(metrics=m, recorder=rec, clock=lambda: clk[0])
+    with tr.span("tick"):
+        clk[0] += 0.25
+        with tr.span("predict"):
+            assert tr.current().name == "predict"
+            clk[0] += 1.5
+        with tr.span("render"):
+            clk[0] += 0.125
+    assert tr.current() is None
+    snap = m.snapshot()
+    assert snap["stage_predict_s_p50"] == 1.5
+    assert snap["stage_render_s_p50"] == 0.125
+    assert snap["stage_tick_s_p50"] == 0.25 + 1.5 + 0.125
+    events = rec.tail()
+    by_name = {e["name"]: e for e in events}
+    assert by_name["predict"]["parent"] == "tick"
+    assert by_name["predict"]["depth"] == 1
+    assert by_name["tick"]["parent"] is None
+    assert by_name["tick"]["depth"] == 0
+    # children complete before the parent — recorder order is causal
+    assert [e["name"] for e in events] == ["predict", "render", "tick"]
+
+
+def test_span_exception_propagates_and_marks_error():
+    clk = [0.0]
+    m = Metrics()
+    rec = FlightRecorder()
+    tr = Tracer(metrics=m, recorder=rec, clock=lambda: clk[0])
+    with pytest.raises(ValueError, match="boom"):
+        with tr.span("tick"):
+            with tr.span("snapshot"):
+                clk[0] += 2.0
+                raise ValueError("boom")
+    by_name = {e["name"]: e for e in rec.tail()}
+    assert by_name["snapshot"]["error"] == "ValueError"
+    assert by_name["snapshot"]["duration_s"] == 2.0
+    assert by_name["tick"]["error"] == "ValueError"
+    # the failed stage still lands in the histogram (its latency is real)
+    assert m.snapshot()["stage_snapshot_s_p50"] == 2.0
+    assert tr.current() is None  # stack fully unwound
+
+
+def test_span_stacks_are_thread_local():
+    tr = Tracer()
+    seen = {}
+    gate = threading.Barrier(2)
+
+    def worker(name):
+        with tr.span(name):
+            gate.wait(timeout=10)
+            seen[name] = tr.current().name
+            gate.wait(timeout=10)
+
+    threads = [
+        threading.Thread(target=worker, args=(n,)) for n in ("a", "b")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # each thread saw ITS OWN span as innermost, never the sibling's
+    assert seen == {"a": "a", "b": "b"}
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition
+
+
+def test_prometheus_exposition_golden_text():
+    m = Metrics()
+    m.inc("records", 3)
+    m.set("flows_dropped", 2)
+    for v in (0.25, 0.5, 1.0):
+        m.observe("stage_predict_s", v)
+    got = prometheus_text(m, now=m.started_at + 5.0)
+    assert got == (
+        "# HELP tcsdn_uptime_seconds Seconds since the metrics "
+        "registry reset.\n"
+        "# TYPE tcsdn_uptime_seconds gauge\n"
+        "tcsdn_uptime_seconds 5\n"
+        "# TYPE tcsdn_records counter\n"
+        "tcsdn_records 3\n"
+        "# TYPE tcsdn_flows_dropped gauge\n"
+        "tcsdn_flows_dropped 2\n"
+        "# HELP tcsdn_stage_predict_s Window quantiles are exact "
+        "nearest-rank over the newest 1024 samples; sum/count are "
+        "lifetime.\n"
+        "# TYPE tcsdn_stage_predict_s summary\n"
+        'tcsdn_stage_predict_s{quantile="0.5"} 0.5\n'
+        'tcsdn_stage_predict_s{quantile="0.9"} 1\n'
+        'tcsdn_stage_predict_s{quantile="0.99"} 1\n'
+        "tcsdn_stage_predict_s_sum 1.75\n"
+        "tcsdn_stage_predict_s_count 3\n"
+    )
+
+
+def test_prometheus_sanitizes_metric_names():
+    m = Metrics()
+    m.inc("weird.name-with chars", 1)
+    text = prometheus_text(m)
+    assert "tcsdn_weird_name_with_chars 1" in text
+
+
+# ---------------------------------------------------------------------------
+# health
+
+
+def test_healthz_staleness_transitions():
+    clk = [1000.0]
+    h = HealthState(clock=lambda: clk[0], max_tick_age_s=30.0)
+    # before any tick, age runs from construction: young serve is healthy
+    healthy, report = h.check()
+    assert healthy and report["ticks"] == 0
+    h.tick()
+    clk[0] += 29.0
+    healthy, report = h.check()
+    assert healthy and not report["tick_stale"]
+    clk[0] += 2.0  # 31 s since the tick: stale
+    healthy, report = h.check()
+    assert not healthy and report["tick_stale"]
+    h.tick()  # recovery: a fresh tick flips it back
+    healthy, report = h.check()
+    assert healthy and report["last_tick_age_s"] == 0.0
+    # a serve that never ticks goes stale from its start time too
+    h2 = HealthState(clock=lambda: clk[0], max_tick_age_s=30.0)
+    clk[0] += 31.0
+    assert h2.check()[0] is False
+
+
+def test_healthz_collector_probe_and_checkpoint_freshness():
+    clk = [0.0]
+    h = HealthState(
+        clock=lambda: clk[0], max_tick_age_s=30.0,
+        max_checkpoint_age_s=60.0,
+    )
+    h.tick()
+    alive = [True]
+    h.set_collector_probe(lambda: alive[0])
+    healthy, report = h.check()
+    assert healthy and report["collector_alive"] is True
+    alive[0] = False
+    healthy, report = h.check()
+    assert not healthy and report["collector_alive"] is False
+    alive[0] = True
+    # checkpoint freshness: none yet → measured from start; then beats
+    clk[0] += 59.0
+    h.tick()
+    assert h.check()[0] is True
+    clk[0] += 2.0  # 61 s with no checkpoint ever: stale
+    h.tick()
+    healthy, report = h.check()
+    assert not healthy and report["checkpoint_stale"]
+    h.checkpoint()
+    healthy, report = h.check()
+    assert healthy and report["checkpoint_age_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# exposition server
+
+
+def test_exposition_endpoints_and_clean_shutdown():
+    m = Metrics()
+    m.inc("ticks", 7)
+    rec = FlightRecorder()
+    for i in range(5):
+        rec.record("span", name=f"s{i}")
+    clk = [0.0]
+    h = HealthState(clock=lambda: clk[0], max_tick_age_s=10.0)
+    h.tick()
+    srv = ExpositionServer(m, recorder=rec, health=h, port=0,
+                           host="127.0.0.1")
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        resp = urllib.request.urlopen(base + "/metrics")
+        assert resp.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4"
+        )
+        assert "tcsdn_ticks 7" in resp.read().decode()
+        payload = json.loads(
+            urllib.request.urlopen(base + "/healthz").read()
+        )
+        assert payload["healthy"] is True
+        events = json.loads(
+            urllib.request.urlopen(base + "/events?n=2").read()
+        )
+        assert [e["name"] for e in events] == ["s3", "s4"]
+        # n=0 means "no events", not "the whole ring"
+        assert json.loads(
+            urllib.request.urlopen(base + "/events?n=0").read()
+        ) == []
+        with pytest.raises(urllib.error.HTTPError) as e404:
+            urllib.request.urlopen(base + "/nope")
+        assert e404.value.code == 404
+        clk[0] += 11.0  # stale → 503 with the report in the body
+        with pytest.raises(urllib.error.HTTPError) as e503:
+            urllib.request.urlopen(base + "/healthz")
+        assert e503.value.code == 503
+        assert json.loads(e503.value.read())["tick_stale"] is True
+    finally:
+        srv.stop()
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(base + "/metrics", timeout=0.5)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder ring
+
+
+def test_ring_is_bounded_and_thread_safe_under_concurrent_spans():
+    rec = FlightRecorder(capacity=256)
+    tr = Tracer(recorder=rec)  # ring integrity is the claim under test
+    n_threads, per_thread = 8, 200
+
+    def worker():
+        for _ in range(per_thread):
+            with tr.span("outer"):
+                with tr.span("inner"):
+                    pass
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread * 2
+    assert rec.events_seen == total
+    events = rec.tail()
+    assert len(events) == 256  # bounded, not grown
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert all(e["kind"] == "span" for e in events)
+
+
+def test_dump_writes_valid_jsonl_with_meta_header(tmp_path):
+    rec = FlightRecorder(capacity=8, clock=lambda: 123.5)
+    for i in range(12):  # overflow the ring: oldest 4 displaced
+        rec.record("span", name=f"s{i}", payload=np.int64(i))
+    path = rec.dump(str(tmp_path), "unit test/reason")
+    assert os.sep not in os.path.basename(path).replace("-", "")
+    lines = [json.loads(line) for line in open(path)]
+    assert lines[0]["kind"] == "meta"
+    assert lines[0]["reason"] == "unit test/reason"
+    assert lines[0]["events"] == 8
+    assert lines[0]["displaced"] == 4
+    assert [e["name"] for e in lines[1:]] == [f"s{i}" for i in range(4, 12)]
+    # non-JSON payloads were clamped at record time, not dump time
+    assert all(isinstance(e["payload"], (int, str)) for e in lines[1:])
+
+
+def test_tail_zero_is_empty_not_everything():
+    rec = FlightRecorder()
+    for i in range(3):
+        rec.record("span", name=f"s{i}")
+    assert rec.tail(0) == []
+    assert len(rec.tail(2)) == 2
+    assert len(rec.tail()) == 3
+
+
+def test_fault_observer_records_firings():
+    rec = FlightRecorder()
+    plan = faults.FaultPlan(
+        [faults.FaultRule("serving_ckpt.write", kind="raise")]
+    )
+    with rec.observing_faults(), faults.installed(plan):
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("serving_ckpt.write")
+    assert faults._observers == []  # scoped registration detached
+    (ev,) = rec.tail()
+    assert ev["kind"] == "fault.fire"
+    assert ev["site"] == "serving_ckpt.write"
+    assert ev["hit"] == 1 and ev["fault_kind"] == "raise"
+
+
+# ---------------------------------------------------------------------------
+# chaos: fault firings must leave a post-mortem
+
+
+@pytest.mark.chaos
+def test_collector_read_fault_leaves_terminal_post_mortem(tmp_path):
+    """An injected collector.read 'raise' kills the monitor mid-stream;
+    with no restart budget the supervisor goes terminal — and the
+    flight recorder must hold the whole story: the fault firing, the
+    death, and the terminal event, dumpable as valid JSONL."""
+    from traffic_classifier_sdn_tpu.ingest.supervisor import (
+        SupervisedCollector,
+    )
+
+    rec = FlightRecorder()
+    code = (
+        "import sys, time\n"
+        "for i in range(50):\n"
+        "    print('data\\t'+str(i+1)+'\\t1\\t1\\taa\\tbb\\t2\\t5\\t12',"
+        " flush=True)\n"
+        "    time.sleep(0.05)\n"
+    )
+    cmd = f'{sys.executable} -c "{code}"'
+    sup = SupervisedCollector(cmd, raw=True, max_restarts=0,
+                              backoff_base=0.01, recorder=rec)
+    plan = faults.FaultPlan([faults.FaultRule("collector.read")])
+    with rec.observing_faults(), faults.installed(plan):
+        sup.start()
+        deadline = time.time() + 20
+        while sup.running and time.time() < deadline:
+            sup.wait_record(timeout=0.1)
+    sup.stop()
+    assert rec.count("fault.fire") == 1
+    assert rec.count("supervisor.terminal") == 1
+    path = rec.dump(str(tmp_path), "collector-read-fault")
+    lines = [json.loads(line) for line in open(path)]
+    fires = [e for e in lines if e["kind"] == "fault.fire"]
+    assert fires and fires[0]["site"] == "collector.read"
+    terminal = [e for e in lines if e["kind"] == "supervisor.terminal"]
+    assert terminal and "budget" in terminal[0]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# CLI integration: the acceptance scenario
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def capture_file(tmp_path_factory):
+    from traffic_classifier_sdn_tpu.ingest.protocol import format_line
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+
+    path = tmp_path_factory.mktemp("obs_cap") / "capture.tsv"
+    syn = SyntheticFlows(n_flows=16, seed=7)
+    with open(path, "wb") as f:
+        f.write(b"header to ignore\n")
+        for _ in range(24):
+            for r in syn.tick():
+                f.write(format_line(r))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def gnb_checkpoint(tmp_path_factory):
+    """A native checkpoint so CLI serve tests need no reference pickles."""
+    from traffic_classifier_sdn_tpu.io.checkpoint import save_model
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (4, 12)),
+        "var": rng.gamma(2.0, 50.0, (4, 12)) + 1.0,
+        "class_prior": np.full(4, 0.25),
+    })
+    path = str(tmp_path_factory.mktemp("obs_model") / "gnb")
+    save_model(path, "gnb", params, ["dns", "ping", "telnet", "voice"])
+    return path
+
+
+def test_cli_serve_exposes_obs_plane_during_replay(
+    capture_file, gnb_checkpoint, tmp_path, capsys
+):
+    """The acceptance scenario: ``serve --obs-port N --metrics-every K``
+    exposes /metrics (with per-stage stage_* series), /healthz, and
+    /events while a replay-driven run is live."""
+    from traffic_classifier_sdn_tpu import cli
+
+    port = _free_port()
+    obs_dir = str(tmp_path / "dumps")
+    got: dict = {}
+
+    def probe():
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                text = urllib.request.urlopen(
+                    base + "/metrics", timeout=2).read().decode()
+                if "tcsdn_stage_tick_s" not in text:
+                    # the serve loop hasn't completed a tick yet —
+                    # scrape again until the stage series exist
+                    time.sleep(0.02)
+                    continue
+                got["metrics"] = text
+                got["healthz"] = json.loads(urllib.request.urlopen(
+                    base + "/healthz", timeout=2).read())
+                got["events"] = json.loads(urllib.request.urlopen(
+                    base + "/events?n=10", timeout=2).read())
+                return
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.02)
+
+    t = threading.Thread(target=probe)
+    t.start()
+    cli.main([
+        "gaussiannb",
+        "--source", "replay",
+        "--capture", capture_file,
+        "--native-checkpoint", gnb_checkpoint,
+        "--capacity", "64",
+        "--print-every", "5",
+        "--max-ticks", "24",
+        "--metrics-every", "4",
+        "--obs-port", str(port),
+        "--obs-dir", obs_dir,
+        "--obs-dump-on-exit",
+    ])
+    t.join(timeout=30)
+    capsys.readouterr()  # drain the rendered tables
+    metrics_text = got.get("metrics", "")
+    assert "# TYPE tcsdn_ticks counter" in metrics_text
+    # the per-stage latency series the tentpole promises
+    for stage in ("poll", "parse", "scatter", "tick"):
+        assert f"# TYPE tcsdn_stage_{stage}_s summary" in metrics_text
+        assert f'tcsdn_stage_{stage}_s{{quantile="0.99"}}' in metrics_text
+    assert got["healthz"]["healthy"] is True
+    assert got["healthz"]["ticks"] >= 1
+    assert isinstance(got["events"], list) and got["events"]
+    # --obs-dump-on-exit wrote the on-demand post-mortem
+    dumps = [f for f in os.listdir(obs_dir) if f.endswith(".jsonl")]
+    assert len(dumps) == 1 and "on-demand" in dumps[0]
+    lines = [
+        json.loads(line)
+        for line in open(os.path.join(obs_dir, dumps[0]))
+    ]
+    assert lines[0]["kind"] == "meta"
+    span_names = {e.get("name") for e in lines if e["kind"] == "span"}
+    assert {"poll", "tick", "parse", "scatter"} <= span_names
+
+
+@pytest.mark.chaos
+def test_cli_chaos_snapshot_fault_dump_names_failing_span(
+    capture_file, gnb_checkpoint, tmp_path, capsys
+):
+    """Acceptance: a fault-site firing inside the serve loop produces a
+    valid JSONL flight-recorder dump that names the failing span. The
+    serving_ckpt.write fire kills the tick-2 snapshot; the dump must
+    contain the fault.fire event, the snapshot span marked with the
+    error, and the serve.exception terminal record."""
+    from traffic_classifier_sdn_tpu import cli
+
+    obs_dir = str(tmp_path / "dumps")
+    plan = faults.FaultPlan([faults.FaultRule("serving_ckpt.write")])
+    with faults.installed(plan):
+        with pytest.raises(faults.FaultInjected):
+            cli.main([
+                "gaussiannb",
+                "--source", "replay",
+                "--capture", capture_file,
+                "--native-checkpoint", gnb_checkpoint,
+                "--capacity", "64",
+                "--print-every", "5",
+                "--max-ticks", "24",
+                "--serve-checkpoint-every", "2",
+                "--serve-checkpoint-dir", str(tmp_path / "ckpt"),
+                "--obs-dir", obs_dir,
+            ])
+    capsys.readouterr()
+    dumps = [f for f in os.listdir(obs_dir) if f.endswith(".jsonl")]
+    assert len(dumps) == 1 and "serve-exception" in dumps[0]
+    lines = [
+        json.loads(line)
+        for line in open(os.path.join(obs_dir, dumps[0]))
+    ]
+    assert lines[0]["kind"] == "meta"
+    assert lines[0]["reason"] == "serve-exception"
+    fires = [e for e in lines if e["kind"] == "fault.fire"]
+    assert fires and fires[0]["site"] == "serving_ckpt.write"
+    # the failing span, by name, with the error that killed it
+    failing = [
+        e for e in lines
+        if e["kind"] == "span" and e.get("error") == "FaultInjected"
+    ]
+    assert {e["name"] for e in failing} >= {"snapshot", "tick"}
+    terminal = [e for e in lines if e["kind"] == "serve.exception"]
+    assert terminal and terminal[0]["error"] == "FaultInjected"

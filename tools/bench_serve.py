@@ -119,8 +119,10 @@ def main() -> None:
             # jitted host callback deadlocks pipelined single-core loops
             predict = raw_predict
             if args.shards >= 1:
-                sys.exit("TCSDN_FOREST_KERNEL=native is single-device "
-                         "host serving; use a device kernel with --shards")
+                sys.exit("host-native kernels (TCSDN_FOREST_KERNEL="
+                         "native, TCSDN_KNN_TOPK=native) are "
+                         "single-device host serving; use a device "
+                         "kernel with --shards")
         else:
             predict = jax.jit(raw_predict)
     else:

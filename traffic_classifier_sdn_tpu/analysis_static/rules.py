@@ -442,8 +442,22 @@ class LockDisciplineRule(Rule):
     id = "lock-discipline"
     description = (
         "attributes written from a threading.Thread target method must "
-        "be accessed under the owning *_lock everywhere in the class"
+        "be accessed under the owning *_lock everywhere in the class "
+        "(container-mutator calls like .append/.update count as writes)"
     )
+
+    # Mutation hides behind method calls as often as behind assignment:
+    # an event ring appended from a reader thread (the obs
+    # flight-recorder shape) races exactly like a counter `+=`, but a
+    # store-only scan never sees it. These are the stdlib container
+    # mutators; deliberately NOT queue.Queue's put/get names — Queue
+    # does its own locking, and flagging it would teach people to
+    # suppress the rule rather than fix real races.
+    _CONTAINER_MUTATORS = {
+        "append", "appendleft", "extend", "extendleft", "add", "insert",
+        "remove", "discard", "pop", "popleft", "popitem", "clear",
+        "update", "setdefault",
+    }
 
     def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
         if mod.tree is None:
@@ -542,6 +556,16 @@ class LockDisciplineRule(Rule):
             ):
                 if is_store:
                     shared.add(attr)
+            for node in ast.walk(methods[name]):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._CONTAINER_MUTATORS
+                    and isinstance(node.func.value, ast.Attribute)
+                    and isinstance(node.func.value.value, ast.Name)
+                    and node.func.value.value.id == "self"
+                ):
+                    shared.add(node.func.value.attr)
         if not shared:
             return
         for name, fn in methods.items():

@@ -19,8 +19,11 @@ batched predict over the whole table → label decode → table render.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
+import signal
 import sys
+import threading
 import time
 
 import numpy as np
@@ -214,6 +217,46 @@ def _build_parser() -> argparse.ArgumentParser:
         "poll ticks (0 disables)",
     )
     p.add_argument(
+        "--obs-port", type=int, default=0, metavar="PORT",
+        help="serve the observability plane on this port (0 disables): "
+        "/metrics (Prometheus text with per-stage stage_* latency "
+        "series), /healthz (collector alive, last-tick age, checkpoint "
+        "freshness), /events (flight-recorder tail)",
+    )
+    p.add_argument(
+        "--obs-dir", default=None, metavar="DIR",
+        help="flight-recorder post-mortem directory: on an unhandled "
+        "serve-loop exception, supervisor terminal failure, or SIGTERM "
+        "the recent-event ring is dumped there as JSONL "
+        "(obs/flight_recorder.py)",
+    )
+    p.add_argument(
+        "--obs-dump-on-exit", action="store_true",
+        help="also dump the flight-recorder ring into --obs-dir on a "
+        "clean exit (the on-demand post-mortem)",
+    )
+    p.add_argument(
+        "--obs-host", default="127.0.0.1", metavar="ADDR",
+        help="bind address for --obs-port (default 127.0.0.1 — the "
+        "events/metrics surface carries paths and failure detail, so "
+        "exposing it beyond loopback is an explicit choice: pass "
+        "0.0.0.0 for a real scrape target)",
+    )
+    p.add_argument(
+        "--obs-stale-after", type=float, default=30.0, metavar="SECS",
+        help="/healthz reports unhealthy (503) once the last poll tick "
+        "is older than this many seconds (default 30)",
+    )
+    p.add_argument(
+        "--obs-checkpoint-stale-after", type=float, default=0.0,
+        metavar="SECS",
+        help="/healthz also reports unhealthy once the last committed "
+        "serving snapshot (or, before the first one, the serve start) "
+        "is older than this many seconds (0 disables; pair with "
+        "--serve-checkpoint-every so silent checkpoint failure pages "
+        "instead of rotting)",
+    )
+    p.add_argument(
         "--profile-dir", default=None,
         help="capture a jax.profiler trace of the run into this directory",
     )
@@ -231,10 +274,16 @@ def _use_native(args) -> bool:
     return ok
 
 
-def _tick_source(args, raw: bool = False):
+def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
     """Yield one batch of telemetry per poll tick: a list of
     TelemetryRecords, or raw pipe bytes when ``raw`` (the native-engine
-    fast path — no per-line Python anywhere between the pipe and C++)."""
+    fast path — no per-line Python anywhere between the pipe and C++).
+
+    ``recorder`` threads the obs flight recorder into the collector/
+    supervisor stack; ``probe_out`` (a dict) receives a ``"probe"``
+    callable reporting collector liveness once a subprocess source
+    starts — the /healthz collector-alive feed (replay/synthetic
+    sources set nothing: there is no collector to be dead)."""
     if args.source == "replay":
         if not args.capture:
             sys.exit("--source replay requires --capture FILE")
@@ -273,10 +322,12 @@ def _tick_source(args, raw: bool = False):
 
             coll = SupervisedCollector(
                 cmd, raw=raw, max_restarts=args.monitor_restarts,
-                metrics=global_metrics,
+                metrics=global_metrics, recorder=recorder,
             )
         else:
-            coll = SubprocessCollector(cmd, raw=raw)
+            coll = SubprocessCollector(cmd, raw=raw, recorder=recorder)
+        if probe_out is not None:
+            probe_out["probe"] = lambda: coll.running
         coll.start()
         try:
             while True:
@@ -310,6 +361,8 @@ def _run_classify(args) -> None:
         sys.exit("serving-state checkpoints are single-device (no --shards)")
     if args.serve_checkpoint_every and not args.serve_checkpoint_dir:
         sys.exit("--serve-checkpoint-every needs --serve-checkpoint-dir")
+    if args.obs_dump_on_exit and not args.obs_dir:
+        sys.exit("--obs-dump-on-exit needs --obs-dir (the dump target)")
 
     name = SUBCOMMAND_ALIASES[args.subcommand]
     if args.native_checkpoint:
@@ -331,12 +384,22 @@ def _run_classify(args) -> None:
     )
 
     from .utils.metrics import global_metrics as m
+    from .obs import FlightRecorder, Tracer
+
+    # the obs plane: the flight recorder exists whenever any obs surface
+    # is on (it feeds both /events and the post-mortem dump); the tracer
+    # is ALWAYS on — per-tick spans cost microseconds and give
+    # --metrics-every its stage_* latency attribution unconditionally
+    recorder = (
+        FlightRecorder() if (args.obs_port or args.obs_dir) else None
+    )
+    tracer = Tracer(metrics=m, recorder=recorder)
 
     use_native = _use_native(args)
     if args.restore_serve_state:
         from .io import serving_checkpoint as _sc
 
-        engine = _sc.restore(args.restore_serve_state)
+        engine = _sc.restore(args.restore_serve_state, recorder=recorder)
         if engine.table.capacity != args.capacity:
             print(
                 f"WARNING: --capacity {args.capacity} ignored — the "
@@ -357,8 +420,9 @@ def _run_classify(args) -> None:
             # the sharded engine jits + shard_maps predict_fn — the one
             # thing the host_native contract forbids (models/__init__)
             sys.exit(
-                "TCSDN_FOREST_KERNEL=native is single-device host "
-                "serving; use a device kernel with --shards"
+                "host-native kernels (TCSDN_FOREST_KERNEL=native, "
+                "TCSDN_KNN_TOPK=native) are single-device host serving; "
+                "use a device kernel with --shards"
             )
         if args.table_rows <= 0:
             # the sharded render merges bounded per-shard candidates; an
@@ -375,10 +439,94 @@ def _run_classify(args) -> None:
         )
     else:
         engine = FlowStateEngine(args.capacity, native=use_native)
+
+    server = None
+    health = None
+    probe_out: dict = {}
+    if args.obs_port:
+        from .obs import ExpositionServer, HealthState
+
+        health = HealthState(
+            max_tick_age_s=args.obs_stale_after,
+            max_checkpoint_age_s=(
+                args.obs_checkpoint_stale_after or None
+            ),
+        )
+        server = ExpositionServer(
+            m, recorder=recorder, health=health, port=args.obs_port,
+            host=args.obs_host,
+        )
+        server.start()
+        print(
+            f"observability plane on port {server.port} "
+            f"(/metrics /healthz /events)",
+            file=sys.stderr,
+        )
+    # SIGTERM (the orchestrator's shutdown signal) must leave a
+    # post-mortem before dying. The handler itself does the MINIMUM —
+    # flag + raise: it runs on the main thread between bytecodes, and
+    # touching the recorder there would deadlock if the interrupted
+    # frame already holds the (non-reentrant) ring lock mid-record. The
+    # actual record+dump happens in the except path below, after stack
+    # unwinding has released every lock. Signal handlers install only
+    # from the main thread (the CPython rule); embedded callers on
+    # other threads simply skip the hook.
+    prev_sigterm = None
+    sigterm_hooked = False
+    sigterm_seen = False
+    if (recorder is not None and args.obs_dir
+            and threading.current_thread() is threading.main_thread()):
+        def _on_sigterm(signum, frame):
+            nonlocal sigterm_seen
+            sigterm_seen = True
+            raise SystemExit(143)
+
+        prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+        sigterm_hooked = True
+    obs_faults = (
+        recorder.observing_faults() if recorder is not None
+        else contextlib.nullcontext()
+    )
     try:
-        _serve_loop(args, engine, model, predict, serve_params, m, sharded,
-                    use_native, dropped_seen=0)
+        with obs_faults:
+            _serve_loop(args, engine, model, predict, serve_params, m,
+                        sharded, use_native, dropped_seen=0,
+                        tracer=tracer, recorder=recorder, health=health,
+                        probe_out=probe_out)
+    except BaseException as e:
+        # the crash-forensics moment: record the terminal exception and
+        # freeze the ring — safely outside any signal-handler frame.
+        # SystemExit is a dump only when the SIGTERM hook raised it
+        # (argparse/sys.exit paths are deliberate, not crashes).
+        if recorder is not None:
+            if sigterm_seen and isinstance(e, SystemExit):
+                recorder.record("signal.sigterm")
+                _dump_flight(recorder, args.obs_dir, "sigterm")
+            elif not isinstance(e, SystemExit):
+                recorder.record(
+                    "serve.exception", error=type(e).__name__,
+                    detail=str(e),
+                )
+                reason = (
+                    "keyboard-interrupt"
+                    if isinstance(e, KeyboardInterrupt)
+                    else "serve-exception"
+                )
+                _dump_flight(recorder, args.obs_dir, reason)
+        raise
+    else:
+        if recorder is not None:
+            if recorder.count("supervisor.terminal"):
+                # the monitor died for good and the source drained — the
+                # loop ends "cleanly" but an operator needs the trail
+                _dump_flight(recorder, args.obs_dir, "supervisor-terminal")
+            elif args.obs_dump_on_exit:
+                _dump_flight(recorder, args.obs_dir, "on-demand")
     finally:
+        if server is not None:
+            server.stop()
+        if sigterm_hooked:
+            signal.signal(signal.SIGTERM, prev_sigterm)
         # the checkpoint must survive EVERY exit, including Ctrl-C on a
         # long-running serve — the state is consistent between ticks
         # (save() flushes pending rows first)
@@ -393,7 +541,22 @@ def _run_classify(args) -> None:
             )
 
 
-def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float) -> None:
+def _dump_flight(recorder, obs_dir, reason: str) -> None:
+    """Best-effort post-mortem dump — the forensics path must never turn
+    a serve-loop failure into a different failure."""
+    if recorder is None or not obs_dir:
+        return
+    try:
+        path = recorder.dump(obs_dir, reason)
+    except OSError as e:
+        print(f"WARNING: flight-recorder dump failed: {e}",
+              file=sys.stderr)
+        return
+    print(f"flight recorder dumped to {path} ({reason})", file=sys.stderr)
+
+
+def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
+                     recorder=None, health=None) -> None:
     """Periodic in-loop serving snapshot (between ticks, state flushed).
 
     The wall-clock budget guard keeps checkpointing from starving the
@@ -419,6 +582,10 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float) -> None:
             and elapsed > 0
             and h.total / elapsed > args.serve_checkpoint_budget):
         m.inc("checkpoint_skipped")
+        if recorder is not None:
+            recorder.record(
+                "checkpoint.skip", tick=ticks, reason="budget",
+            )
         return
     try:
         with m.time("checkpoint_save_s"):
@@ -430,6 +597,11 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float) -> None:
         raise
     except OSError as e:
         m.inc("checkpoint_errors")
+        if recorder is not None:
+            recorder.record(
+                "checkpoint.error", tick=ticks,
+                error=type(e).__name__, detail=str(e),
+            )
         print(
             f"WARNING: serving snapshot failed (tick {ticks}): {e} — "
             f"will retry at the next due tick",
@@ -438,10 +610,15 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float) -> None:
         return
     m.inc("checkpoint_saves")
     m.inc("checkpoint_bytes", nbytes)
+    if recorder is not None:
+        recorder.record("checkpoint.save", tick=ticks, bytes=nbytes)
+    if health is not None:
+        health.checkpoint()
 
 
 def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
-                use_native, dropped_seen) -> None:
+                use_native, dropped_seen, tracer, recorder=None,
+                health=None, probe_out=None) -> None:
     from .utils.profiling import trace
 
     ticks = 0
@@ -457,71 +634,124 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
         if existing:
             tick_base = existing[0][0]
     loop_t0 = time.monotonic()
-    with trace(args.profile_dir):
-        for batch in _tick_source(
-            args, raw=use_native and args.source in ("ryu", "controller")
-        ):
-            engine.mark_tick()  # freshness floor for the render sample
-            with m.time("ingest_s"):
-                if isinstance(batch, bytes):
-                    m.inc("records", engine.ingest_bytes(batch))
-                else:
-                    m.inc("records", engine.ingest(batch))
-                engine.step()
-            ticks += 1
-            m.inc("ticks")
-            if ticks % args.print_every == 0:
-                if engine.dropped > dropped_seen:
-                    print(
-                        f"WARNING: flow table full — "
-                        f"{engine.dropped - dropped_seen} new flows "
-                        f"dropped since last report (capacity "
-                        f"{args.capacity}, idle-timeout "
-                        f"{args.idle_timeout}s)",
-                        file=sys.stderr,
-                    )
-                    dropped_seen = engine.dropped
-                m.set("flows_dropped", engine.dropped)
-                if sharded:
-                    # the sharded tick's whole read side (per-shard
-                    # predict + render candidates + stale masks) is one
-                    # dispatch, with eviction folded in
-                    with m.time("predict_s"):
-                        rows, evicted = engine.tick_render(
-                            now=engine.last_time,
-                            idle_seconds=args.idle_timeout or None,
-                        )
-                    m.inc("evicted", evicted)
-                    _print_ranked(engine, model, rows, engine.num_flows())
-                else:
-                    if args.idle_timeout and engine.last_time:
-                        m.inc(
-                            "evicted",
-                            engine.evict_idle(
-                                engine.last_time, args.idle_timeout
-                            ),
-                        )
-                    with m.time("predict_s"):
-                        _print_table(
-                            engine, model, predict, serve_params, args
-                        )
-            if (args.serve_checkpoint_every
-                    and ticks % args.serve_checkpoint_every == 0):
-                _snapshot_if_due(args, engine, m, tick_base + ticks, loop_t0)
-            if args.metrics_every and ticks % args.metrics_every == 0:
-                print(m.report(), file=sys.stderr, flush=True)
-            if args.max_ticks and ticks >= args.max_ticks:
-                break
+    probe_wired = False
+    end = object()  # next() sentinel: a batch is never None-able
+    source = _tick_source(
+        args, raw=use_native and args.source in ("ryu", "controller"),
+        recorder=recorder, probe_out=probe_out,
+    )
+    try:
+        with trace(args.profile_dir):
+            while True:
+                # poll is its own root span (not a child of tick): it
+                # measures waiting on EXTERNAL telemetry, and folding it
+                # into tick would drown the pipeline's own latency
+                with tracer.span("poll"):
+                    batch = next(source, end)
+                if batch is end:
+                    break
+                if health is not None:
+                    health.tick()
+                    if (not probe_wired and probe_out is not None
+                            and "probe" in probe_out):
+                        # the subprocess collector exists only once the
+                        # source generator has started — wire the
+                        # /healthz liveness probe at first arrival
+                        health.set_collector_probe(probe_out["probe"])
+                        probe_wired = True
+                with tracer.span("tick"):
+                    engine.mark_tick()  # freshness floor for the render
+                    with m.time("ingest_s"):
+                        with tracer.span("parse"):
+                            if isinstance(batch, bytes):
+                                n_rec = engine.ingest_bytes(batch)
+                            else:
+                                n_rec = engine.ingest(batch)
+                        m.inc("records", n_rec)
+                        with tracer.span("scatter"):
+                            engine.step()
+                    ticks += 1
+                    m.inc("ticks")
+                    if ticks % args.print_every == 0:
+                        if engine.dropped > dropped_seen:
+                            print(
+                                f"WARNING: flow table full — "
+                                f"{engine.dropped - dropped_seen} new "
+                                f"flows dropped since last report "
+                                f"(capacity {args.capacity}, "
+                                f"idle-timeout {args.idle_timeout}s)",
+                                file=sys.stderr,
+                            )
+                            dropped_seen = engine.dropped
+                        m.set("flows_dropped", engine.dropped)
+                        if sharded:
+                            # the sharded tick's whole read side
+                            # (per-shard predict + render candidates +
+                            # stale masks) is one dispatch, with
+                            # eviction folded in
+                            with m.time("predict_s"), \
+                                    tracer.span("predict"):
+                                rows, evicted = engine.tick_render(
+                                    now=engine.last_time,
+                                    idle_seconds=(
+                                        args.idle_timeout or None
+                                    ),
+                                )
+                            m.inc("evicted", evicted)
+                            with tracer.span("render"):
+                                _print_ranked(
+                                    engine, model, rows,
+                                    engine.num_flows(),
+                                )
+                        else:
+                            if args.idle_timeout and engine.last_time:
+                                m.inc(
+                                    "evicted",
+                                    engine.evict_idle(
+                                        engine.last_time,
+                                        args.idle_timeout,
+                                    ),
+                                )
+                            with m.time("predict_s"):
+                                _print_table(
+                                    engine, model, predict,
+                                    serve_params, args, tracer,
+                                )
+                    if (args.serve_checkpoint_every
+                            and ticks % args.serve_checkpoint_every == 0):
+                        with tracer.span("snapshot"):
+                            _snapshot_if_due(
+                                args, engine, m, tick_base + ticks,
+                                loop_t0, recorder=recorder,
+                                health=health,
+                            )
+                if args.metrics_every and ticks % args.metrics_every == 0:
+                    print(m.report(), file=sys.stderr, flush=True)
+                if args.max_ticks and ticks >= args.max_ticks:
+                    break
+    finally:
+        # deterministic teardown (the generator's finally stops the
+        # collector) BEFORE the obs server goes down, so /healthz can
+        # never observe a half-stopped source
+        source.close()
 
 
-def _print_table(engine, model, predict, serve_params, args) -> None:
+def _print_table(engine, model, predict, serve_params, args,
+                 tracer) -> None:
+    import jax
+
     from .utils.table import CLASSIFIER_FIELDS, render_table, status_str
 
     # The device flow table produces float32 features natively, so the
     # SVC/KNN hi/lo precise mode is moot here (lo would be identically
     # zero); it applies to float64 feature sources like the CSV pipeline.
-    X = engine.features()
-    labels = predict(serve_params, X)  # stays device-resident
+    with tracer.span("feature"):
+        X = engine.features()
+    with tracer.span("predict"):
+        labels = predict(serve_params, X)  # stays device-resident
+        # the dispatch is async; block here so the predict span carries
+        # the device compute instead of smearing it into render
+        jax.block_until_ready(labels)
     # Classification is batched over the WHOLE table on device; the table
     # *render* samples at most --table-rows flows (the reference prints
     # everything because it tracks dozens, traffic_classifier.py:99-118 —
@@ -539,25 +769,29 @@ def _print_table(engine, model, predict, serve_params, args) -> None:
         # activity-ranked sample: the rendered rows track live traffic
         # (device top_k over this tick's byte deltas), most active first;
         # labels + active flags gathered device-side, O(limit) fetched
-        _print_ranked(engine, model, engine.render_sample(labels, limit),
-                      n_flows)
-        return
-    rows = []
-    idx = np.asarray(labels)
-    fwd_active = np.asarray(engine.table.fwd.active)[:-1]
-    rev_active = np.asarray(engine.table.rev.active)[:-1]
-    for slot, (src, dst) in sorted(engine.slot_metadata().items()):
-        rows.append(
-            (
-                slot,
-                src,
-                dst,
-                name(int(idx[slot])),
-                status_str(bool(fwd_active[slot])),
-                status_str(bool(rev_active[slot])),
+        with tracer.span("render"):
+            _print_ranked(
+                engine, model, engine.render_sample(labels, limit),
+                n_flows,
             )
-        )
-    print(render_table(CLASSIFIER_FIELDS, rows), flush=True)
+        return
+    with tracer.span("render"):
+        rows = []
+        idx = np.asarray(labels)
+        fwd_active = np.asarray(engine.table.fwd.active)[:-1]
+        rev_active = np.asarray(engine.table.rev.active)[:-1]
+        for slot, (src, dst) in sorted(engine.slot_metadata().items()):
+            rows.append(
+                (
+                    slot,
+                    src,
+                    dst,
+                    name(int(idx[slot])),
+                    status_str(bool(fwd_active[slot])),
+                    status_str(bool(rev_active[slot])),
+                )
+            )
+        print(render_table(CLASSIFIER_FIELDS, rows), flush=True)
 
 
 def _print_ranked(engine, model, ranked, n_flows) -> None:

@@ -32,12 +32,15 @@ class SubprocessCollector:
     """Spawn a monitor command and iterate parsed records."""
 
     def __init__(self, cmd: str = DEFAULT_MONITOR_CMD, queue_size: int = 1 << 16,
-                 raw: bool = False):
+                 raw: bool = False, recorder=None):
         """``raw=True`` queues raw pipe chunks (bytes) instead of parsed
         TelemetryRecords — the zero-Python-per-line path for the native
-        C++ engine (FlowStateEngine.ingest_bytes)."""
+        C++ engine (FlowStateEngine.ingest_bytes). ``recorder`` (an
+        obs.FlightRecorder) receives a structured event per dropped-line
+        burst, so a post-mortem shows where telemetry was lost."""
         self.cmd = cmd
         self.raw = raw
+        self._recorder = recorder  # set once here, read-only afterwards
         self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
         self._proc: subprocess.Popen | None = None
         self._thread: threading.Thread | None = None
@@ -95,6 +98,11 @@ class SubprocessCollector:
                     lost = chunk.count(b"\n") - short.count(b"\n")
                     with self._drop_lock:
                         self._lines_dropped += lost
+                    if self._recorder is not None:
+                        self._recorder.record(
+                            "collector.drop", cause="truncated_chunk",
+                            lines=lost,
+                        )
                     chunk = short
                 if drop_seam:
                     # a dropped/truncated chunk broke line framing: poison
@@ -115,6 +123,11 @@ class SubprocessCollector:
                     lost = chunk.count(b"\n")
                     with self._drop_lock:
                         self._lines_dropped += lost
+                    if self._recorder is not None:
+                        self._recorder.record(
+                            "collector.drop", cause="queue_full",
+                            lines=lost,
+                        )
                     drop_seam = True
             return
         for line in proc.stdout:
@@ -127,6 +140,10 @@ class SubprocessCollector:
                 # back-pressure: drop oldest-style accounting, keep newest
                 with self._drop_lock:
                     self._lines_dropped += 1
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "collector.drop", cause="queue_full", lines=1,
+                    )
 
     @property
     def lines_dropped(self) -> int:

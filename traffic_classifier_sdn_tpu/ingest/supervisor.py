@@ -46,7 +46,7 @@ class SupervisedCollector:
 
     def __init__(self, cmd: str, raw: bool = False, max_restarts: int = 5,
                  backoff_base: float = 0.5, backoff_cap: float = 30.0,
-                 metrics=None, clock=time.monotonic):
+                 metrics=None, clock=time.monotonic, recorder=None):
         self.cmd = cmd
         self.raw = raw
         self.max_restarts = max_restarts
@@ -55,6 +55,10 @@ class SupervisedCollector:
         self.restarts = 0
         self._metrics = metrics
         self._clock = clock
+        # flight recorder (obs/flight_recorder.py): monitor deaths,
+        # restarts, and terminal failure become structured events so a
+        # post-mortem dump shows the supervision ladder's last steps
+        self._recorder = recorder
         self._collector: SubprocessCollector | None = None
         self._next_restart_at = 0.0
         self._done = False  # clean exit or budget exhausted
@@ -66,7 +70,9 @@ class SupervisedCollector:
     def _spawn(self) -> SubprocessCollector:
         """Collector factory — the seam chaos tests override to script
         incarnation lifecycles without real subprocesses."""
-        return SubprocessCollector(self.cmd, raw=self.raw)
+        return SubprocessCollector(
+            self.cmd, raw=self.raw, recorder=self._recorder
+        )
 
     def start(self) -> None:
         self._collector = self._spawn()
@@ -130,8 +136,30 @@ class SupervisedCollector:
                 self._carryover.append(b"\x00\n")
             c.stop()
             self._collector = None
-            if rc == 0 or self.restarts >= self.max_restarts:
+            if rc == 0:
                 self._done = True
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "monitor.clean_exit",
+                        lines_dropped=self._dropped_prior,
+                    )
+                return
+            if self._recorder is not None:
+                self._recorder.record(
+                    "monitor.death", returncode=rc,
+                    restarts=self.restarts,
+                    lines_dropped=self._dropped_prior,
+                )
+            if self.restarts >= self.max_restarts:
+                self._done = True
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "supervisor.terminal",
+                        reason="restart budget exhausted",
+                        restarts=self.restarts,
+                        max_restarts=self.max_restarts,
+                        lines_dropped=self._dropped_prior,
+                    )
                 return
             delay = min(
                 self.backoff_cap, self.backoff_base * (2 ** self.restarts)
@@ -147,6 +175,11 @@ class SupervisedCollector:
         self.restarts += 1
         if self._metrics is not None:
             self._metrics.inc("monitor_restarts")
+        if self._recorder is not None:
+            self._recorder.record(
+                "monitor.restart", attempt=self.restarts,
+                max_restarts=self.max_restarts,
+            )
         try:
             fault_point("supervisor.restart")
             self.start()
@@ -161,8 +194,21 @@ class SupervisedCollector:
                 print(f"WARNING: monitor restart failed: {e}",
                       file=sys.stderr)
             self._collector = None
+            if self._recorder is not None:
+                self._recorder.record(
+                    "monitor.spawn_failed", attempt=self.restarts,
+                    error=type(e).__name__, detail=str(e),
+                )
             if self.restarts >= self.max_restarts:
                 self._done = True
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "supervisor.terminal",
+                        reason="restart budget exhausted (spawn failure)",
+                        restarts=self.restarts,
+                        max_restarts=self.max_restarts,
+                        lines_dropped=self._dropped_prior,
+                    )
                 return
             self._next_restart_at = now + min(
                 self.backoff_cap, self.backoff_base * (2 ** self.restarts)

@@ -249,13 +249,25 @@ def validate(path: str) -> None:
     _load_validated(path)
 
 
-def _resolve_and_load(directory: str) -> tuple[str | None, dict | None]:
+def _resolve_and_load(
+    directory: str, recorder=None
+) -> tuple[str | None, dict | None]:
     """Newest member that validates, WITH its loaded content — so a
-    directory restore decompresses the winner exactly once."""
+    directory restore decompresses the winner exactly once. Each
+    invalid member skipped on the way down is a rollback: with a
+    ``recorder`` (obs.FlightRecorder) it becomes a structured
+    ``checkpoint.rollback`` event naming the rejected file, so the
+    post-mortem trail shows that a newer-but-corrupt checkpoint was
+    passed over — silent-looking recovery, made auditable."""
     for _, path in list_checkpoints(directory):
         try:
             return path, _load_validated(path)
-        except (CorruptCheckpointError, ValueError):
+        except (CorruptCheckpointError, ValueError) as e:
+            if recorder is not None:
+                recorder.record(
+                    "checkpoint.rollback", rejected=path,
+                    error=type(e).__name__, detail=str(e),
+                )
             continue
     return None, None
 
@@ -267,14 +279,15 @@ def resolve_latest(directory: str) -> str | None:
     return _resolve_and_load(directory)[0]
 
 
-def restore(path: str, buckets=None):
+def restore(path: str, buckets=None, recorder=None):
     """Rebuild a ``FlowStateEngine`` from ``save`` output. ``path`` may
-    be a rotation directory, resolved through ``resolve_latest``."""
+    be a rotation directory, resolved through ``resolve_latest``.
+    ``recorder`` receives rollback/restore events (obs plane)."""
     from ..ingest.batcher import DEFAULT_BUCKETS, FlowStateEngine
 
     fault_point("serving_ckpt.restore")
     if os.path.isdir(path):
-        resolved, z = _resolve_and_load(path)
+        resolved, z = _resolve_and_load(path, recorder=recorder)
         if resolved is None:
             raise CorruptCheckpointError(
                 f"no valid serving checkpoint in directory {path}"
@@ -282,6 +295,8 @@ def restore(path: str, buckets=None):
         path = resolved
     else:
         z = _load_validated(path)
+    if recorder is not None:
+        recorder.record("checkpoint.restore", path=path)
     required = {
         "capacity", "native", "last_time", "tick_floor", "index/slots",
         "index/keys", "index/src", "index/dst", "index/next_slot",

@@ -67,7 +67,14 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
       ``pallas`` (ops/pallas_knn fused distance+top-k kernel; TPU-only —
       Mosaic does not compile on CPU hosts) | ``native`` (the C++
       host-spine brute force for accelerator-less hosts; ``host_native``
-      — callers must NOT jit or shard_map it).
+      — callers must NOT jit or shard_map it). Numerics note: ``native``
+      ranks by exact float64 squared distances while the default XLA
+      path ranks by float32 dot-expansion similarity, so labels can
+      differ wherever f32 rounding makes or breaks a near-tie — a
+      documented divergence (ADVICE r5), warned once at selection time;
+      unlike bench promotion there is no same-run parity gate at
+      serving (only the reference-corpus parity in
+      tests/test_native_knn.py).
 
     Every option is argmax-parity-gated against the same oracles by
     tests and by the bench before promotion; selection never changes
@@ -86,9 +93,19 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
             # host-spine C++ brute force (native/knn_eval.cpp) for
             # accelerator-less hosts; host_native contract as the forest
             # branch below — a plain host call, never jitted/shard_mapped
+            import sys
+
             import numpy as np
 
             from ..native import knn as native_knn
+
+            print(
+                "NOTE: TCSDN_KNN_TOPK=native ranks by exact f64 "
+                "distances; labels can differ from the default f32 "
+                "device ranking on near-ties (no same-run parity gate "
+                "at serving time)",
+                file=sys.stderr,
+            )
 
             hk = native_knn.NativeKnn({
                 "fit_X": np.asarray(params.fit_X),  # the f32 hi corpus,

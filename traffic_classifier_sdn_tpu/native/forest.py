@@ -27,6 +27,7 @@ import threading
 
 import numpy as np
 
+from ..io.sklearn_import import f32_safe_thresholds
 from .loader import LazyLib
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -79,7 +80,18 @@ class NativeForest:
     def __init__(self, d: dict):
         lib = _load()
         feature = np.ascontiguousarray(d["feature"], np.int32)
-        threshold = np.ascontiguousarray(d["threshold"], np.float32)
+        # f32-safe cast, NOT a plain round-to-nearest: sklearn stores f64
+        # midpoints of adjacent f32 feature values and compares
+        # f32(x) <= f64(thr); a midpoint that rounds UP under f32 flips
+        # the decision for a sample sitting exactly at the upper value
+        # (ADVICE r5 high). Same routing as models/forest.from_numpy and
+        # ops/tree_gemm.compile_forest. Leaf slots are overwritten with
+        # the NaN sentinel in tcf_create, so applying it everywhere is
+        # safe.
+        threshold = np.ascontiguousarray(
+            f32_safe_thresholds(np.asarray(d["threshold"], np.float64)),
+            np.float32,
+        )
         left = np.ascontiguousarray(d["left"], np.int32)
         right = np.ascontiguousarray(d["right"], np.int32)
         values = np.asarray(d["values"], np.float64)  # (T, M, C)

@@ -8,7 +8,10 @@ corpus streams from cache once per 8-query block (see
 native/knn_eval.cpp). The XLA/Pallas kernels in models/knn.py and
 ops/pallas_knn.py remain the device paths; ``bench.py`` races this
 entrant on the CPU fallback under the same same-run parity gate as
-every other raced kernel.
+every other raced kernel. Serving divergence: this path's exact-f64
+ranking can disagree with the default f32 dot-expansion ranking on
+near-ties — ``TCSDN_KNN_TOPK=native`` is a documented opt-in and
+models/__init__ logs a one-line warning when it is selected.
 
 Built lazily with g++ ``-march=native`` on first use (the distance
 loops need the host's widest SIMD; the .so never leaves the machine it

@@ -1,0 +1,39 @@
+"""Observability plane: structured tracing, crash forensics, exposition.
+
+Three cooperating layers, each dependency-free (stdlib + the existing
+``utils.metrics`` registry) and individually testable:
+
+- ``obs.trace`` — a Dapper-style span tracer with explicit clock
+  injection. The serve loop opens one ``tick`` span per poll tick with
+  child spans for each pipeline stage (poll → parse → scatter → feature
+  → predict → render → snapshot); completions land in per-stage
+  ``Metrics`` histograms (``stage_<name>_s``), so ``--metrics-every``
+  and ``Metrics.snapshot()`` gain ``stage_*_p50/p99`` latency
+  attribution with no extra plumbing.
+- ``obs.flight_recorder`` — a bounded, lock-guarded ring of recent
+  structured events (span completions, monitor deaths/restarts,
+  checkpoint saves/rollbacks, fault-site firings). On an unhandled
+  serve-loop exception, supervisor terminal failure, or SIGTERM the CLI
+  dumps the ring as a JSONL post-mortem: "what happened in the 2 s
+  before it died", answerable after the fact.
+- ``obs.exposition`` — a stdlib ``http.server`` thread serving
+  ``/metrics`` (Prometheus text format), ``/healthz`` (liveness +
+  staleness), and ``/events`` (flight-recorder tail as JSON), wired
+  into ``cli.py`` behind ``--obs-port``.
+
+docs/OBSERVABILITY.md is the operator-facing catalog (metric names,
+span taxonomy, scrape and post-mortem workflow).
+"""
+
+from .exposition import ExpositionServer, HealthState, prometheus_text
+from .flight_recorder import FlightRecorder
+from .trace import Span, Tracer
+
+__all__ = [
+    "ExpositionServer",
+    "FlightRecorder",
+    "HealthState",
+    "Span",
+    "Tracer",
+    "prometheus_text",
+]

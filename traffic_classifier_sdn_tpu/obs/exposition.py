@@ -1,0 +1,293 @@
+"""Scrapeable serving telemetry: /metrics, /healthz, /events.
+
+A stdlib ``http.server`` thread an operator can point Prometheus at —
+no client library, no third-party deps (the container image is fixed).
+Three endpoints:
+
+- ``/metrics`` — Prometheus text exposition format 0.0.4. Counters and
+  gauges map directly; each ``utils.metrics.Histogram`` is rendered as
+  a summary family: ``<name>{quantile="0.5"}`` / ``{quantile="0.99"}``
+  (exact nearest-rank over the bounded sample window — window
+  quantiles, the honest label for what they are), plus lifetime
+  ``_sum`` and ``_count``. All families carry the ``tcsdn_`` prefix and
+  sanitized names, so ``stage_predict_s`` scrapes as
+  ``tcsdn_stage_predict_s``.
+- ``/healthz`` — JSON liveness: collector alive, last-tick age vs the
+  staleness threshold, checkpoint freshness when periodic snapshots are
+  enabled. HTTP 200 while healthy, 503 once stale/dead — ready for a
+  Kubernetes/Prometheus probe verbatim.
+- ``/events`` — the flight-recorder tail as a JSON array (``?n=`` to
+  bound), the live view of the same ring the post-mortem dump freezes.
+
+The server runs on a daemon thread (``ThreadingHTTPServer``; handlers
+never block the serve loop — they read under the metrics/ring locks
+only long enough to snapshot). ``stop()`` is a clean shutdown: socket
+closed, thread joined, port released — wired into the CLI's
+``finally`` so Ctrl-C never leaks the listener.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_PREFIX = "tcsdn_"
+
+# the bounded-window quantiles exposed per histogram (label, percentile)
+_QUANTILES = (("0.5", 50.0), ("0.9", 90.0), ("0.99", 99.0))
+
+
+def _metric_name(name: str) -> str:
+    return _PREFIX + _NAME_RE.sub("_", name)
+
+
+def _fmt(value: float) -> str:
+    """Prometheus-friendly float rendering (repr keeps full precision;
+    integers shed their trailing .0 for counter readability)."""
+    f = float(value)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def prometheus_text(metrics, now: float | None = None) -> str:
+    """Render a ``utils.metrics.Metrics`` registry in Prometheus text
+    format. ``now`` injects the uptime clock so golden tests are exact;
+    the serving path leaves it None (wall clock)."""
+    if now is None:
+        now = time.time()
+    # shallow-copy each family dict before iterating: the serve loop
+    # registers new metrics concurrently, and iterating a resizing dict
+    # raises; a dict() copy is atomic under the GIL
+    counters = dict(metrics.counters)
+    gauges = dict(metrics.gauges)
+    histograms = dict(metrics.histograms)
+    lines: list[str] = []
+    up = _metric_name("uptime_seconds")
+    lines.append(f"# HELP {up} Seconds since the metrics registry reset.")
+    lines.append(f"# TYPE {up} gauge")
+    lines.append(f"{up} {_fmt(max(0.0, now - metrics.started_at))}")
+    for name in sorted(counters):
+        pname = _metric_name(name)
+        lines.append(f"# TYPE {pname} counter")
+        lines.append(f"{pname} {_fmt(counters[name])}")
+    for name in sorted(gauges):
+        pname = _metric_name(name)
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {_fmt(gauges[name])}")
+    for name in sorted(histograms):
+        h = histograms[name]
+        pname = _metric_name(name)
+        lines.append(
+            f"# HELP {pname} Window quantiles are exact nearest-rank "
+            f"over the newest {h.window} samples; sum/count are "
+            f"lifetime."
+        )
+        lines.append(f"# TYPE {pname} summary")
+        values = h.quantiles([q for _, q in _QUANTILES])
+        for (label, _), v in zip(_QUANTILES, values):
+            lines.append(f'{pname}{{quantile="{label}"}} {_fmt(v)}')
+        lines.append(f"{pname}_sum {_fmt(h.total)}")
+        lines.append(f"{pname}_count {h.count}")
+    return "\n".join(lines) + "\n"
+
+
+class HealthState:
+    """Liveness/staleness aggregate behind ``/healthz``.
+
+    The serve loop beats ``tick()`` once per poll tick and
+    ``checkpoint()`` after each committed snapshot; ``probe`` (when
+    set) reports whether the telemetry collector is alive. ``check``
+    folds the three into one verdict: healthy until the last tick (or
+    the start, before any tick) is older than ``max_tick_age_s``, the
+    collector probe says dead, or — when a checkpoint cadence is
+    declared — the last checkpoint is older than
+    ``max_checkpoint_age_s``. Clock-injected and lock-guarded: beats
+    come from the serve loop, reads from the exposition thread.
+    """
+
+    def __init__(self, clock=time.monotonic, max_tick_age_s: float = 30.0,
+                 max_checkpoint_age_s: float | None = None):
+        self._clock = clock
+        self.max_tick_age_s = max_tick_age_s
+        self.max_checkpoint_age_s = max_checkpoint_age_s
+        self._lock = threading.Lock()
+        self._started_at = clock()
+        self._last_tick_at: float | None = None
+        self._last_checkpoint_at: float | None = None
+        self._ticks = 0
+        self._probe = None
+
+    def set_collector_probe(self, probe) -> None:
+        """``probe() -> bool | None`` (None = no collector, e.g. replay
+        sources — reported but never unhealthy)."""
+        with self._lock:
+            self._probe = probe
+
+    def tick(self) -> None:
+        with self._lock:
+            self._last_tick_at = self._clock()
+            self._ticks += 1
+
+    def checkpoint(self) -> None:
+        with self._lock:
+            self._last_checkpoint_at = self._clock()
+
+    def check(self) -> tuple[bool, dict]:
+        """(healthy, report) — the /healthz payload."""
+        with self._lock:
+            now = self._clock()
+            last_tick = self._last_tick_at
+            last_ckpt = self._last_checkpoint_at
+            ticks = self._ticks
+            probe = self._probe
+            started = self._started_at
+        tick_age = now - (last_tick if last_tick is not None else started)
+        stale = tick_age > self.max_tick_age_s
+        collector_alive = None
+        if probe is not None:
+            try:
+                collector_alive = probe()
+            except Exception as e:  # noqa: BLE001 — health must not crash
+                collector_alive = False
+                probe_error = str(e)
+            else:
+                probe_error = None
+        else:
+            probe_error = None
+        ckpt_age = None if last_ckpt is None else now - last_ckpt
+        ckpt_stale = False
+        if self.max_checkpoint_age_s is not None:
+            # before the first checkpoint, freshness is measured from
+            # start — a serve that never checkpoints must go unhealthy,
+            # not report "no checkpoint yet" forever
+            ckpt_stale = (
+                (ckpt_age if ckpt_age is not None else now - started)
+                > self.max_checkpoint_age_s
+            )
+        healthy = (
+            not stale and collector_alive is not False and not ckpt_stale
+        )
+        report = {
+            "healthy": healthy,
+            "ticks": ticks,
+            "last_tick_age_s": round(tick_age, 6),
+            "max_tick_age_s": self.max_tick_age_s,
+            "tick_stale": stale,
+            "collector_alive": collector_alive,
+            "checkpoint_age_s": (
+                None if ckpt_age is None else round(ckpt_age, 6)
+            ),
+            "max_checkpoint_age_s": self.max_checkpoint_age_s,
+            "checkpoint_stale": ckpt_stale,
+        }
+        if probe_error is not None:
+            report["collector_probe_error"] = probe_error
+        return healthy, report
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the server instance injects these via the class-factory below
+    server_version = "tcsdn-obs/1"
+
+    def _send(self, status: int, content_type: str, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        owner: ExpositionServer = self.server.owner  # type: ignore[attr-defined]
+        url = urlparse(self.path)
+        if url.path == "/metrics":
+            body = prometheus_text(owner.metrics).encode()
+            self._send(
+                200, "text/plain; version=0.0.4; charset=utf-8", body
+            )
+        elif url.path == "/healthz":
+            if owner.health is None:
+                payload: dict = {"healthy": True, "detail": "no health state"}
+                healthy = True
+            else:
+                healthy, payload = owner.health.check()
+            body = json.dumps(payload, sort_keys=True).encode()
+            self._send(
+                200 if healthy else 503, "application/json", body
+            )
+        elif url.path == "/events":
+            if owner.recorder is None:
+                events: list = []
+            else:
+                n = None
+                raw = parse_qs(url.query).get("n")
+                if raw:
+                    try:
+                        n = max(0, int(raw[0]))
+                    except ValueError:
+                        self._send(400, "application/json",
+                                   b'{"error": "n must be an integer"}')
+                        return
+                events = owner.recorder.tail(n)
+            body = json.dumps(events).encode()
+            self._send(200, "application/json", body)
+        else:
+            self._send(404, "application/json", b'{"error": "not found"}')
+
+    def log_message(self, fmt, *args) -> None:  # noqa: D102
+        pass  # scrapes every few seconds must not spam stderr
+
+
+class ExpositionServer:
+    """Owns the HTTP listener thread. ``port=0`` binds an ephemeral
+    port (tests); ``self.port`` is the actual bound port after
+    ``start()``. The default bind is loopback — /events carries
+    filesystem paths and failure detail, so reaching beyond the host
+    (``host="0.0.0.0"`` for a real scrape target) is the caller's
+    explicit choice (CLI: ``--obs-host``)."""
+
+    def __init__(self, metrics, recorder=None, health=None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self.metrics = metrics
+        self.recorder = recorder
+        self.health = health
+        self.host = host
+        self.port = port
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._server is not None:
+            raise RuntimeError("exposition server already started")
+        server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        server.daemon_threads = True
+        server.owner = self  # type: ignore[attr-defined]
+        self._server = server
+        self.port = server.server_address[1]
+        self._thread = threading.Thread(
+            target=server.serve_forever, name="tcsdn-obs-exposition",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Clean shutdown: stop accepting, close the socket, join the
+        thread. Idempotent (the CLI's ``finally`` may race a crash)."""
+        server, self._server = self._server, None
+        thread, self._thread = self._thread, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def __enter__(self) -> ExpositionServer:
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False

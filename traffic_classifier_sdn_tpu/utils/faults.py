@@ -137,6 +137,48 @@ class FaultPlan:
 # before doing any other work.
 _plan: FaultPlan | None = None
 
+# Fire observers: called as fn(site, hit, kind) AFTER a rule fires but
+# BEFORE the failure manifests (raise/truncate), so crash forensics (the
+# obs flight recorder) capture the firing even when the fire kills the
+# process path that would have reported it. Consulted only on a fire —
+# the inert-by-default cost of a site is unchanged.
+_observers: list = []
+
+
+def add_observer(fn) -> None:
+    """Register ``fn(site, hit, kind)`` to be called on every fire."""
+    if fn not in _observers:
+        _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    if fn in _observers:
+        _observers.remove(fn)
+
+
+@contextlib.contextmanager
+def observing(fn):
+    """Scoped observer registration — always detaches (the registry is
+    process-global; a leaked observer would haunt later runs)."""
+    add_observer(fn)
+    try:
+        yield fn
+    finally:
+        remove_observer(fn)
+
+
+def _notify(site: str, hit: int, kind: str) -> None:
+    # observation must never alter injection semantics: a broken
+    # observer is reported to stderr, not allowed to mask the fire
+    for fn in list(_observers):
+        try:
+            fn(site, hit, kind)
+        except Exception as e:  # noqa: BLE001 — forensics must not inject
+            import sys
+
+            print(f"WARNING: fault observer {fn!r} failed: {e}",
+                  file=sys.stderr)
+
 
 def install(plan: FaultPlan | None) -> None:
     global _plan
@@ -167,6 +209,7 @@ def fault_point(site: str) -> None:
         return
     r = _plan.check(site)
     if r is not None:
+        _notify(site, _plan.hits[site], r.kind)
         raise FaultInjected(site, _plan.hits[site])
 
 
@@ -179,6 +222,7 @@ def fault_bytes(site: str, data: bytes) -> bytes:
     r = _plan.check(site)
     if r is None:
         return data
+    _notify(site, _plan.hits[site], r.kind)
     if r.kind == "truncate":
         return data[: len(data) // 2]
     raise FaultInjected(site, _plan.hits[site])

@@ -40,11 +40,23 @@ class Histogram:
 
     def percentile(self, q: float) -> float:
         """q in [0, 100]; nearest-rank over the current window."""
+        return self.quantiles((q,))[0]
+
+    def quantiles(self, qs) -> list[float]:
+        """Batch percentiles over ONE snapshot of the window — the
+        exposition path's form: a concurrent ``observe`` between two
+        ``percentile`` calls cannot make the reported quantiles cross
+        (q50 > q99) because all of them rank the same sorted copy."""
         if not self._samples:
-            return 0.0
+            return [0.0 for _ in qs]
         s = sorted(self._samples)
-        idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
-        return s[idx]
+        out = []
+        for q in qs:
+            idx = min(
+                len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1))))
+            )
+            out.append(s[idx])
+        return out
 
     @property
     def mean(self) -> float:
@@ -86,6 +98,12 @@ class Metrics:
         return _TimerCtx(self, name)
 
     # -- read --------------------------------------------------------------
+    @property
+    def started_at(self) -> float:
+        """Wall-clock time of the last reset (uptime epoch) — the
+        public face of ``_t0`` for the exposition renderer."""
+        return self._t0
+
     def snapshot(self) -> dict:
         out: dict = {"uptime_s": time.time() - self._t0}
         out.update({k: v for k, v in self.counters.items()})
