@@ -475,3 +475,81 @@ def test_native_checkpoint_restore_during_native_outage_is_clear(tmp_path):
         with pytest.raises(RuntimeError, match="native"):
             sc.restore(path)
     assert sc.restore(path).num_flows() == 4  # fine once the engine is back
+
+
+# ---------------------------------------------------------------------------
+# pipeline.* — the pipelined serve loop's host→device handoff seams
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_handoff_fault_surfaces_in_host_stage():
+    """A failing handoff must kill the serve loop in the HOST stage
+    (where the crash-forensics path lives), not wedge the device worker
+    behind a seam that silently stopped accepting work."""
+    from traffic_classifier_sdn_tpu.serving.pipeline import ServePipeline
+
+    done = []
+    pipe = ServePipeline(done.append).start()
+    try:
+        plan = faults.FaultPlan(
+            [faults.FaultRule("pipeline.handoff", after=1)], SEED
+        )
+        with faults.installed(plan):
+            pipe.submit("t0")  # hit 1: passes
+            assert pipe.drain(timeout=5)
+            with pytest.raises(faults.FaultInjected):
+                pipe.submit("t1")  # hit 2: fires in the host thread
+        assert plan.fires == [("pipeline.handoff", 2)]
+    finally:
+        pipe.shutdown(drain=False)
+    assert done == ["t0"]  # the staged work before the fire completed
+
+
+def test_pipeline_coalesce_fault_fires_only_under_backpressure():
+    """The coalesce site guards the overload path exclusively: queued
+    handoffs never touch it, and a fire preempts the merge (the staged
+    tick survives — exactly what a crash mid-coalesce must leave)."""
+    from traffic_classifier_sdn_tpu.serving.pipeline import Handoff
+
+    h = Handoff(depth=1)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("pipeline.coalesce", times=None)], SEED
+    )
+    with faults.installed(plan):
+        h.put("t0")  # queued — the coalesce branch is never reached
+        with pytest.raises(faults.FaultInjected):
+            h.put("t1")  # full → coalesce branch → fires
+    assert [s for s, _ in plan.fires] == ["pipeline.coalesce"]
+    assert h.coalesced == 0  # the fire preempted the merge
+    assert h.get(timeout=0) == "t0"  # the staged tick survived intact
+
+
+def test_pipeline_handoff_probabilistic_any_seed_serve_survivable():
+    """Probability-scheduled handoff failures (any TCSDN_CHAOS_SEED):
+    every fire surfaces as FaultInjected at submit — never a hang, never
+    a silent drop — and the pipeline drains cleanly between fires."""
+    from traffic_classifier_sdn_tpu.serving.pipeline import ServePipeline
+
+    done = []
+    pipe = ServePipeline(done.append).start()
+    attempted = queued = 0
+    try:
+        with faults.installed(faults.FaultPlan(
+            [faults.FaultRule("pipeline.handoff", p=0.3, times=None)],
+            SEED,
+        )) as plan:
+            for i in range(20):
+                attempted += 1
+                try:
+                    if pipe.submit(i):
+                        queued += 1
+                except faults.FaultInjected:
+                    pass
+            assert pipe.drain(timeout=5)
+            # every attempt either queued, coalesced (superseded a
+            # staged tick), or fired — nothing vanished silently
+            coalesced = pipe.stats()["ticks_coalesced"]
+            assert queued + coalesced + len(plan.fires) == attempted
+    finally:
+        pipe.shutdown(drain=False)
+    assert len(done) == queued  # coalesced ticks superseded, not lost

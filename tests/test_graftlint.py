@@ -331,6 +331,98 @@ def test_obs_package_is_clean():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+# the pipelined serve loop's shape: producer/consumer threads sharing
+# rotating staging buffers (serving/pipeline.Handoff). Written WITHOUT
+# the condition lock it is exactly the double-buffer handoff race the
+# rule must catch: the device-stage thread pops staging slots and
+# bumps the in-flight count while the host stage appends — every one
+# of those accesses races unless it holds the owning *_lock.
+LOCK_HANDOFF_POSITIVE = """
+    import threading
+
+    class BadPipeline:
+        def __init__(self):
+            self._lock = threading.Condition()
+            self._slots = []
+            self._inflight = 0
+            self._t = threading.Thread(target=self._device_stage)
+
+        def _device_stage(self):
+            while True:
+                job = self._slots.pop(0)
+                self._inflight += 1
+                job()
+                self._done(job)
+
+        def _done(self, job):
+            self._inflight -= 1
+
+        def put(self, job):
+            self._slots.append(job)
+
+        def idle(self):
+            return not self._slots and not self._inflight
+"""
+
+LOCK_HANDOFF_NEGATIVE = """
+    import threading
+
+    class Pipeline:
+        def __init__(self):
+            self._lock = threading.Condition()
+            self._slots = []
+            self._inflight = 0
+            self._t = threading.Thread(target=self._device_stage)
+
+        def _device_stage(self):
+            while True:
+                with self._lock:
+                    job = self._slots.pop(0)
+                    self._inflight += 1
+                job()
+                self._done(job)
+
+        def _done(self, job):
+            with self._lock:
+                self._inflight -= 1
+
+        def put(self, job):
+            with self._lock:
+                self._slots.append(job)
+
+        def idle(self):
+            with self._lock:
+                return not self._slots and not self._inflight
+"""
+
+
+def test_lock_discipline_covers_double_buffer_handoff(tmp_path):
+    findings = run_rule(tmp_path, LockDisciplineRule,
+                        LOCK_HANDOFF_POSITIVE)
+    flagged = {f.message.split("'")[1] for f in findings}
+    # _slots popped on the device-stage thread and appended/read by the
+    # host side; _inflight written on BOTH sides (and through the
+    # _done helper — the thread-target transitive closure must pull
+    # helpers invoked from the target into the shared set)
+    assert {"self._slots", "self._inflight"} <= flagged
+
+
+def test_lock_discipline_clean_double_buffer_handoff(tmp_path):
+    assert run_rule(tmp_path, LockDisciplineRule,
+                    LOCK_HANDOFF_NEGATIVE) == []
+
+
+def test_serving_package_is_clean():
+    """The pipelined serve loop is new concurrency — producer/consumer
+    threads sharing staging buffers — and must hold the same static bar
+    (lock-discipline over the handoff's condition lock and the
+    pipeline's accounting lock, fault-site audit over the
+    pipeline.handoff/pipeline.coalesce seams, jit-purity over the
+    donated feature projection)."""
+    findings = lint_paths([os.path.join(PACKAGE_DIR, "serving")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 # ---------------------------------------------------------------------------
 # fault-site-registry
 # ---------------------------------------------------------------------------

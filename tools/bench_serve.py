@@ -16,6 +16,17 @@ Stages per tick:
   render   — sorted sample of --table-rows flows + footer (never O(N))
   evict    — device stale-mask + host release of idle slots
 
+--pipeline {off,on,both} A/Bs the serial chain against the pipelined
+serve loop (serving/pipeline.py: host poll/parse/scatter overlapped
+with device predict/render through the bounded handoff). `both` runs
+serial then pipelined over identical payloads and emits one
+`serve_pipeline_ab` JSON object with per-mode `serve_flows_per_sec`,
+the speedup, and the measured host/device `overlap_ratio`
+(overlap_s / device_busy_s). --warmup AOT-compiles the serving
+programs first (serving/warmup.py) — pass it for a clean A/B (the
+modes share jit caches, so an un-warmed first mode pays every compile)
+and to read `first_tick_ms` as the warm first-tick latency.
+
 Usage: bench_serve.py [--capacity 1048576] [--ticks 5] [--no-native]
 (CPU-safe: forces the host platform unless --platform default.)
 """
@@ -29,74 +40,11 @@ import sys
 import time
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--capacity", type=int, default=1 << 20)
-    ap.add_argument("--ticks", type=int, default=5)
-    ap.add_argument("--no-native", action="store_true")
-    ap.add_argument(
-        "--platform", choices=("cpu", "default"), default="cpu",
-        help="cpu (safe anywhere) or default (real TPU when healthy)",
-    )
-    ap.add_argument("--table-rows", type=int, default=64)
-    ap.add_argument(
-        "--model", choices=("gnb", "forest", "knn"), default="gnb",
-        help="predict stage: gnb (cheapest full-table predict; the CPU "
-        "default), forest (the flagship 100-tree checkpoint), or knn "
-        "(the KNeighbors checkpoint) — the latter two resolve through "
-        "the serving path and honor TCSDN_FOREST_KERNEL / "
-        "TCSDN_KNN_TOPK, so the raced kernels A/B directly in this "
-        "bench",
-    )
-    ap.add_argument(
-        "--shards", type=int, default=0,
-        help="shard the flow table over an N-device mesh "
-        "(parallel/table_sharded.py); on the cpu platform N virtual "
-        "devices are forced, so --shards 8 --capacity 8388608 exercises "
-        "the 2²³-flow sharded spine on one host",
-    )
-    args = ap.parse_args()
-
-    if args.platform == "cpu":
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        if args.shards >= 1:
-            import re
-
-            flags = re.sub(
-                r"--?xla_force_host_platform_device_count=\S*", "",
-                os.environ.get("XLA_FLAGS", ""),
-            )
-            os.environ["XLA_FLAGS"] = (
-                flags
-                + f" --xla_force_host_platform_device_count={args.shards}"
-            ).strip()
-    sys.path.insert(
-        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
-
+def _build_model(args):
+    """(predict, params, raw_fn) through the serving-path resolution."""
     import numpy as np
 
-    import jax
-
-    if args.platform == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-
-    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
-    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
-    from traffic_classifier_sdn_tpu.models import gnb
-    from traffic_classifier_sdn_tpu.native import engine as native_engine
-
-    native = (not args.no_native) and native_engine.available()
-    cap = args.capacity
-    n_flows = cap // 2  # two directions share one slot; stay under capacity
-    syn = SyntheticFlows(n_flows=n_flows, seed=0)
-
-    # init-first liveness: a wedged worker hangs the first device call,
-    # and a silent run is indistinguishable from a slow compile
-    print("# initializing devices", file=sys.stderr, flush=True)
-    print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
+    from traffic_classifier_sdn_tpu.models import gnb, jit_serving_fn
 
     if args.model in ("forest", "knn"):
         # the reference checkpoint through the serving-path resolution —
@@ -114,29 +62,28 @@ def main() -> None:
         }[args.model]
         m = load_reference_model(sub, f"{models_dir}/{ck}")
         raw_predict, params = m.serving_path()
-        if getattr(raw_predict, "host_native", False):
-            # eager by contract (see models/__init__ native branch): a
-            # jitted host callback deadlocks pipelined single-core loops
-            predict = raw_predict
-            if args.shards >= 1:
-                sys.exit("host-native kernels (TCSDN_FOREST_KERNEL="
-                         "native, TCSDN_KNN_TOPK=native) are "
-                         "single-device host serving; use a device "
-                         "kernel with --shards")
-        else:
-            predict = jax.jit(raw_predict)
-    else:
-        # 6-class GNB params (synthetic moments — the model family is the
-        # cheapest full-table predict; the forest/SVC cost is bench.py's job)
-        rng = np.random.RandomState(0)
-        params = gnb.from_numpy(
-            {
-                "theta": rng.gamma(2.0, 100.0, (6, 12)),
-                "var": rng.gamma(2.0, 50.0, (6, 12)) + 1.0,
-                "class_prior": np.full(6, 1 / 6),
-            }
-        )
-        predict = jax.jit(gnb.predict)
+        predict = jit_serving_fn(raw_predict)
+        if getattr(raw_predict, "host_native", False) and args.shards >= 1:
+            sys.exit("host-native kernels (TCSDN_FOREST_KERNEL="
+                     "native, TCSDN_KNN_TOPK=native) are "
+                     "single-device host serving; use a device "
+                     "kernel with --shards")
+        return predict, params, raw_predict
+    # 6-class GNB params (synthetic moments — the model family is the
+    # cheapest full-table predict; the forest/SVC cost is bench.py's job)
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy(
+        {
+            "theta": rng.gamma(2.0, 100.0, (6, 12)),
+            "var": rng.gamma(2.0, 50.0, (6, 12)) + 1.0,
+            "class_prior": np.full(6, 1 / 6),
+        }
+    )
+    return jit_serving_fn(gnb.predict), params, gnb.predict
+
+
+def _make_engine(args, native, raw_fn, params):
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
 
     if args.shards >= 1:
         from traffic_classifier_sdn_tpu.parallel import (
@@ -144,32 +91,24 @@ def main() -> None:
             table_sharded as tsh,
         )
 
-        # the un-jitted fn paired with params by the serving resolution
-        # above — raw_predict/params stay a matched (kernel, operands)
-        # unit whatever TCSDN_FOREST_KERNEL selected
-        raw_fn = (
-            raw_predict if args.model in ("forest", "knn") else gnb.predict
-        )
-        eng = tsh.ShardedFlowEngine(
+        return tsh.ShardedFlowEngine(
             meshlib.make_mesh(n_data=args.shards, n_state=1),
-            cap, predict_fn=raw_fn, params=params,
+            args.capacity, predict_fn=raw_fn, params=params,
             table_rows=args.table_rows, native=native,
         )
-    else:
-        eng = FlowStateEngine(capacity=cap, native=native)
+    return FlowStateEngine(capacity=args.capacity, native=native)
 
-    print(
-        f"# generating {args.ticks} ticks × {2 * n_flows} records "
-        f"(capacity {cap}, native={native})",
-        file=sys.stderr, flush=True,
-    )
-    payloads = [syn.tick_bytes() for _ in range(args.ticks)]
-    total_records = sum(p.count(b"\n") for p in payloads)
 
-    classes = None
+def _run_serial(args, eng, predict, params, payloads):
+    """The serial chain — one tick fully synchronous, per-stage timed."""
+    import numpy as np
+
+    import jax
+
     timings = {k: [] for k in ("ingest", "step", "predict", "render",
                                "evict", "tick")}
     n_parsed = 0
+    t_wall0 = time.perf_counter()
     for ti, payload in enumerate(payloads):
         eng.mark_tick()
         t0 = time.perf_counter()
@@ -229,10 +168,321 @@ def main() -> None:
             file=sys.stderr, flush=True,
         )
         assert len(rows) <= args.table_rows
-
+    wall = time.perf_counter() - t_wall0
     p50 = {k: float(np.median(v)) for k, v in timings.items()}
-    ingest_rate = (total_records / args.ticks) / p50["ingest"]
+    return {"timings": timings, "p50": p50, "wall_s": wall,
+            "n_parsed": n_parsed, "pipeline_stats": None}
 
+
+def _run_pipelined(args, eng, predict, params, payloads):
+    """The pipelined loop: host stage ingests/scatters/dispatches; the
+    device stage (worker) syncs and builds the render rows — the same
+    shape cli.py serves with (serving/pipeline.py).
+
+    Single-device A/B work parity: this mode runs the same per-tick
+    evict pass as the serial mode. The SHARDED pipelined mode does not
+    process stale bits (its read dispatch carries an inert horizon), so
+    a sharded A/B slightly favors this mode — read its speedup as a
+    ceiling, not a measurement of equal work."""
+    import numpy as np
+
+    from traffic_classifier_sdn_tpu.serving.pipeline import (
+        FeatureStage,
+        ServePipeline,
+        dispatch_read,
+    )
+
+    host_native = getattr(predict, "host_native", False)
+    fs = (
+        None if (args.shards >= 1 or host_native)
+        else FeatureStage(args.capacity)
+    )
+    rendered = []
+
+    def consume(job):
+        job()
+
+    pipe = ServePipeline(consume, depth=2).start()
+    timings = {k: [] for k in ("ingest", "step", "dispatch", "tick")}
+    n_parsed = 0
+    t_wall0 = time.perf_counter()
+    try:
+        for ti, payload in enumerate(payloads):
+            with pipe.host_stage():
+                eng.mark_tick()
+                t0 = time.perf_counter()
+                n_parsed += eng.ingest_bytes(payload)
+                t1 = time.perf_counter()
+                eng.step()
+                t2 = time.perf_counter()
+                if args.shards >= 1:
+                    outs = eng.tick_read_dispatch(now=eng.last_time)
+                    n_flows = eng.num_flows()
+
+                    def job(outs=outs, n_flows=n_flows):
+                        ranked = eng.tick_read_finish(outs)
+                        sample = eng.slot_metadata(
+                            [s for s, *_ in ranked]
+                        )
+                        rows = [
+                            (s, *sample[s], c)
+                            for s, c, _fa, _ra in ranked if s in sample
+                        ]
+                        rendered.append((len(rows), n_flows))
+                else:
+                    # every tick, unconditionally — the A/B must pay
+                    # identical per-tick work in both modes (the serial
+                    # mode's evict stage is O(capacity) host work; an
+                    # idle()-gated evict would let the pipelined mode
+                    # skip it under load and report overlap it doesn't
+                    # have). Safe here unlike cli: the 3600 s horizon
+                    # releases nothing, so no render's slot metadata is
+                    # ever at stake.
+                    eng.evict_idle(now=eng.last_time, idle_seconds=3600)
+                    read = dispatch_read(
+                        eng, predict, params, args.table_rows, fs
+                    )
+
+                    def job(read=read):
+                        ranked = read.rows()
+                        # the serial mode's render half: slot metadata
+                        # + row assembly, on the device stage like cli
+                        sample = eng.slot_metadata(
+                            slots=[s for s, *_ in ranked]
+                        )
+                        rows = [
+                            (s, *sample[s], c)
+                            for s, c, _fa, _ra in ranked if s in sample
+                        ]
+                        rendered.append((len(rows), read.n_flows))
+                pipe.submit(job)
+                t3 = time.perf_counter()
+            timings["ingest"].append(t1 - t0)
+            timings["step"].append(t2 - t1)
+            timings["dispatch"].append(t3 - t2)
+            timings["tick"].append(t3 - t0)
+            print(
+                f"# tick {ti}: host {(t3 - t0) * 1e3:.0f} ms "
+                f"(queue {pipe._handoff.queued})",
+                file=sys.stderr, flush=True,
+            )
+        pipe.shutdown(drain=True)
+        pipe.raise_if_failed()
+    finally:
+        pipe.shutdown(drain=False)
+    wall = time.perf_counter() - t_wall0
+    for n_rows, _nf in rendered:
+        assert n_rows <= args.table_rows
+    p50 = {k: float(np.median(v)) for k, v in timings.items()}
+    return {"timings": timings, "p50": p50, "wall_s": wall,
+            "n_parsed": n_parsed, "pipeline_stats": pipe.stats(),
+            "ticks_rendered": len(rendered)}
+
+
+def _mode_summary(args, runs, n_flows_per_tick):
+    """Aggregate one mode's repeats: median-of-repeats throughput (the
+    robust center on a noisy shared host), pooled stage medians, and
+    first-tick latency from the FIRST repeat (the only cold one)."""
+    import numpy as np
+
+    fps = [
+        n_flows_per_tick * args.ticks / r["wall_s"] for r in runs
+    ]
+    pooled = {}
+    for r in runs:
+        for k, v in r["timings"].items():
+            pooled.setdefault(k, []).extend(v)
+    t0 = runs[0]["timings"]["tick"]
+    steady = t0[1:] or t0
+    out = {
+        "serve_flows_per_sec": round(float(np.median(fps)), 1),
+        "serve_flows_per_sec_per_repeat": [round(f, 1) for f in fps],
+        "records_per_sec": round(
+            sum(r["n_parsed"] for r in runs)
+            / sum(r["wall_s"] for r in runs), 1
+        ),
+        "wall_s": round(sum(r["wall_s"] for r in runs), 3),
+        "first_tick_ms": round(t0[0] * 1e3, 1),
+        "steady_tick_p50_ms": round(float(np.median(steady)) * 1e3, 2),
+        "stage_p50_ms": {
+            k: round(float(np.median(v)) * 1e3, 2)
+            for k, v in pooled.items()
+        },
+    }
+    stats = [r["pipeline_stats"] for r in runs if r["pipeline_stats"]]
+    if stats:
+        host = sum(s["host_busy_s"] for s in stats)
+        dev = sum(s["device_busy_s"] for s in stats)
+        ov = sum(s["overlap_s"] for s in stats)
+        out.update({
+            "host_busy_s": round(host, 3),
+            "device_busy_s": round(dev, 3),
+            "overlap_s": round(ov, 3),
+            "overlap_ratio": round(ov / dev, 3) if dev else 0.0,
+            "ticks_coalesced": sum(s["ticks_coalesced"] for s in stats),
+        })
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--capacity", type=int, default=1 << 20)
+    ap.add_argument(
+        "--flows-per-tick", type=int, default=0,
+        help="synthetic conversations per tick (2 records each); "
+        "0 = capacity/2 (the historical fill-the-table default). "
+        "Decoupled from --capacity so the A/B can pin the ingest batch "
+        "(e.g. 16384) while the full-table predict cost scales with "
+        "capacity independently",
+    )
+    ap.add_argument("--ticks", type=int, default=5)
+    ap.add_argument("--no-native", action="store_true")
+    ap.add_argument(
+        "--platform", choices=("cpu", "default"), default="cpu",
+        help="cpu (safe anywhere) or default (real TPU when healthy)",
+    )
+    ap.add_argument("--table-rows", type=int, default=64)
+    ap.add_argument(
+        "--model", choices=("gnb", "forest", "knn"), default="gnb",
+        help="predict stage: gnb (cheapest full-table predict; the CPU "
+        "default), forest (the flagship 100-tree checkpoint), or knn "
+        "(the KNeighbors checkpoint) — the latter two resolve through "
+        "the serving path and honor TCSDN_FOREST_KERNEL / "
+        "TCSDN_KNN_TOPK, so the raced kernels A/B directly in this "
+        "bench",
+    )
+    ap.add_argument(
+        "--shards", type=int, default=0,
+        help="shard the flow table over an N-device mesh "
+        "(parallel/table_sharded.py); on the cpu platform N virtual "
+        "devices are forced, so --shards 8 --capacity 8388608 exercises "
+        "the 2²³-flow sharded spine on one host",
+    )
+    ap.add_argument(
+        "--pipeline", choices=("off", "on", "both"), default="off",
+        help="serve-loop mode: off = serial chain (the historical "
+        "bench), on = pipelined (serving/pipeline.py), both = A/B over "
+        "identical payloads, one serve_pipeline_ab JSON object",
+    )
+    ap.add_argument(
+        "--repeat", type=int, default=1,
+        help="repeat the measurement N times (modes interleaved per "
+        "repeat, fresh payload chunk each, engines reused so later "
+        "repeats measure the saturated steady state) and report "
+        "median-of-repeats throughput — the noisy-neighbor antidote "
+        "for shared CI hosts",
+    )
+    ap.add_argument(
+        "--warmup", action="store_true",
+        help="AOT-compile the serving programs before timing "
+        "(serving/warmup.py) — required for a clean A/B (the modes "
+        "share jit caches) and for first_tick_ms to mean warm latency",
+    )
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if args.shards >= 1:
+            import re
+
+            flags = re.sub(
+                r"--?xla_force_host_platform_device_count=\S*", "",
+                os.environ.get("XLA_FLAGS", ""),
+            )
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.shards}"
+            ).strip()
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    import numpy as np
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
+
+    native = (not args.no_native) and native_engine.available()
+    cap = args.capacity
+    # two directions share one slot; the default fills half the table
+    n_flows = args.flows_per_tick or cap // 2
+    if n_flows > cap:
+        sys.exit("--flows-per-tick exceeds --capacity (every "
+                 "conversation needs a slot)")
+    syn = SyntheticFlows(n_flows=n_flows, seed=0)
+
+    # init-first liveness: a wedged worker hangs the first device call,
+    # and a silent run is indistinguishable from a slow compile
+    print("# initializing devices", file=sys.stderr, flush=True)
+    print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
+
+    predict, params, raw_fn = _build_model(args)
+
+    print(
+        f"# generating {args.repeat} × {args.ticks} ticks × "
+        f"{2 * n_flows} records (capacity {cap}, native={native})",
+        file=sys.stderr, flush=True,
+    )
+    payload_chunks = [
+        [syn.tick_bytes() for _ in range(args.ticks)]
+        for _ in range(args.repeat)
+    ]
+    total_records = sum(p.count(b"\n") for p in payload_chunks[0])
+
+    modes = (
+        ("serial", "pipelined") if args.pipeline == "both"
+        else (("pipelined",) if args.pipeline == "on" else ("serial",))
+    )
+    if args.pipeline == "both" and not args.warmup:
+        print(
+            "# NOTE: A/B without --warmup — the serial mode runs first "
+            "and pays every cold compile the pipelined mode then "
+            "inherits; pass --warmup for a clean comparison",
+            file=sys.stderr, flush=True,
+        )
+
+    engines = {
+        mode: _make_engine(args, native, raw_fn, params)
+        for mode in modes
+    }
+    if args.warmup:
+        from traffic_classifier_sdn_tpu.serving.warmup import (
+            warmup_serving,
+        )
+
+        t0 = time.perf_counter()
+        stats = warmup_serving(
+            engines[modes[0]], predict, params,
+            table_rows=args.table_rows,
+            idle_timeout=3600 if args.shards < 1 else None,
+        )
+        print(
+            f"# warmup: {len(stats['warmed'])} programs in "
+            f"{time.perf_counter() - t0:.2f}s",
+            file=sys.stderr, flush=True,
+        )
+    runs: dict = {mode: [] for mode in modes}
+    for rep, chunk in enumerate(payload_chunks):
+        for mode in modes:
+            print(f"# repeat {rep} mode: {mode}",
+                  file=sys.stderr, flush=True)
+            run = _run_serial if mode == "serial" else _run_pipelined
+            runs[mode].append(
+                run(args, engines[mode], predict, params, chunk)
+            )
+    results = {
+        mode: _mode_summary(args, runs[mode], n_flows)
+        for mode in modes
+    }
+
+    eng = engines[modes[-1]]
     # Per-tick host->device wire bytes actually moved for the update
     # batches (padded flow_table.pack_wire matrices, counted by the
     # engine) and the measured link bandwidth — on a slow device link the
@@ -240,7 +490,7 @@ def main() -> None:
     # in single-digit ms. The bandwidth probe only means "device link"
     # off the cpu platform, so it is omitted there (a cpu-platform probe
     # would time a host memcpy).
-    wire_mb = eng.wire_bytes / args.ticks / 1e6
+    wire_mb = eng.wire_bytes / (args.ticks * args.repeat) / 1e6
     link_mb_s = None
     if jax.devices()[0].platform != "cpu":
         # sync by scalar fetch: on this rig's tunnel block_until_ready
@@ -254,33 +504,46 @@ def main() -> None:
             float(np.asarray(jnp.sum(jnp.asarray(blob))))
             bw.append(probe_mb / (time.perf_counter() - t0))
         link_mb_s = float(np.median(bw))
-    print(
-        json.dumps(
-            {
-                "metric": "serve_tick_p50_ms_at_capacity",
-                "value": round(p50["tick"] * 1e3, 1),
-                "unit": "ms",
-                "capacity": cap,
-                "tracked_flows": eng.num_flows(),
-                "records_per_tick": total_records // args.ticks,
-                "ingest_records_per_sec": round(ingest_rate, 1),
-                "stage_p50_ms": {
-                    k: round(v * 1e3, 2) for k, v in p50.items()
-                },
-                "update_wire_mb_per_tick": round(wire_mb, 1),
-                **(
-                    {"host_to_device_mb_per_sec": round(link_mb_s, 1)}
-                    if link_mb_s is not None else {}
-                ),
-                "native_ingest": native,
-                **({"shards": args.shards} if args.shards >= 1 else {}),
-                "platform": jax.devices()[0].platform,
-                "predict_model": args.model,
-                "table_rows_rendered": args.table_rows,
-            }
+
+    common = {
+        "capacity": cap,
+        "tracked_flows": eng.num_flows(),
+        "records_per_tick": total_records // args.ticks,
+        "update_wire_mb_per_tick": round(wire_mb, 1),
+        **(
+            {"host_to_device_mb_per_sec": round(link_mb_s, 1)}
+            if link_mb_s is not None else {}
         ),
-        flush=True,
-    )
+        "native_ingest": native,
+        **({"shards": args.shards} if args.shards >= 1 else {}),
+        "platform": jax.devices()[0].platform,
+        "predict_model": args.model,
+        "table_rows_rendered": args.table_rows,
+        "warmup": args.warmup,
+    }
+
+    if args.pipeline == "both":
+        s = results["serial"]["serve_flows_per_sec"]
+        p = results["pipelined"]["serve_flows_per_sec"]
+        out = {
+            "metric": "serve_pipeline_ab",
+            "serial": results["serial"],
+            "pipelined": results["pipelined"],
+            "speedup_flows_per_sec": round(p / s, 3) if s else None,
+            **common,
+        }
+    else:
+        mode = modes[0]
+        r = results[mode]
+        out = {
+            "metric": "serve_tick_p50_ms_at_capacity",
+            "value": r["stage_p50_ms"]["tick"],
+            "unit": "ms",
+            "mode": mode,
+            **r,
+            **common,
+        }
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -41,6 +41,7 @@ GROUPS_KEYS=(
   "collector:truncated_chunk or monitor_killed"
   "supervisor:spawn_failure"
   "native:native_load or native_checkpoint"
+  "pipeline:pipeline_handoff or pipeline_coalesce"
 )
 
 fail=0

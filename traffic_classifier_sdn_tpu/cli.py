@@ -257,6 +257,29 @@ def _build_parser() -> argparse.ArgumentParser:
         "instead of rotting)",
     )
     p.add_argument(
+        "--pipeline", choices=("auto", "on", "off"), default="auto",
+        help="pipelined serving (serving/pipeline.py): overlap host "
+        "poll/parse/scatter with device predict/render through a "
+        "bounded two-deep handoff (auto = on). When the device stage "
+        "falls behind, render ticks coalesce (ticks_coalesced counter) "
+        "instead of queueing unboundedly; 'off' restores the serial "
+        "poll → parse → scatter → predict → render chain",
+    )
+    p.add_argument(
+        "--warmup", action="store_true",
+        help="AOT-compile the serving programs at startup "
+        "(serving/warmup.py: donated scatter per batch bucket, feature "
+        "projection, predict, render gather) so the first tick runs "
+        "hot instead of paying a multi-second compile stall",
+    )
+    p.add_argument(
+        "--compilation-cache-dir", default=None, metavar="DIR",
+        help="JAX persistent compilation cache: compiles (including "
+        "--warmup's) land here and restarts — including "
+        "checkpoint-rollback restarts — reuse them instead of "
+        "recompiling",
+    )
+    p.add_argument(
         "--profile-dir", default=None,
         help="capture a jax.profiler trace of the run into this directory",
     )
@@ -347,10 +370,12 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
 
 
 def _run_classify(args) -> None:
-    import jax
-
     from .ingest.batcher import FlowStateEngine
-    from .models import SUBCOMMAND_ALIASES, load_reference_model
+    from .models import (
+        SUBCOMMAND_ALIASES,
+        jit_serving_fn,
+        load_reference_model,
+    )
     from .io.sklearn_import import REFERENCE_CHECKPOINTS
 
     # serve-durability flag validation runs before any model/device work
@@ -373,15 +398,10 @@ def _run_classify(args) -> None:
         ckpt = f"{args.checkpoint_dir}/{REFERENCE_CHECKPOINTS[name]}"
         model = load_reference_model(args.subcommand, ckpt)
     # the serving-optimized (predict_fn, params) pair, resolved as one
-    # unit (GEMM-form forest, chunked KNN/SVC; canonical otherwise)
+    # unit (GEMM-form forest, chunked KNN/SVC; canonical otherwise),
+    # jitted unless host-native (models.jit_serving_fn owns that rule)
     serve_fn, serve_params = model.serving_path()
-    # host-native serving fns (TCSDN_FOREST_KERNEL=native) run eagerly:
-    # jitting them queues the host callback on the XLA CPU pool behind
-    # its own input's producer — a deadlock on single-core hosts
-    predict = (
-        serve_fn if getattr(serve_fn, "host_native", False)
-        else jax.jit(serve_fn)
-    )
+    predict = jit_serving_fn(serve_fn)
 
     from .utils.metrics import global_metrics as m
     from .obs import FlightRecorder, Tracer
@@ -439,6 +459,29 @@ def _run_classify(args) -> None:
         )
     else:
         engine = FlowStateEngine(args.capacity, native=use_native)
+
+    # persistent-cache wiring must precede warmup so its compiles land
+    # on disk; it also helps un-warmed serves — lazy compiles persist,
+    # and the NEXT restart (including a checkpoint-rollback restart)
+    # starts hot
+    if args.compilation_cache_dir:
+        from .serving.warmup import enable_compilation_cache
+
+        enable_compilation_cache(args.compilation_cache_dir)
+    if args.warmup:
+        from .serving.warmup import warmup_serving
+
+        wstats = warmup_serving(
+            engine, predict, serve_params,
+            table_rows=args.table_rows,
+            idle_timeout=args.idle_timeout,
+        )
+        print(
+            f"warmup: compiled {len(wstats['warmed'])} serving "
+            f"programs in {wstats['seconds']:.2f}s "
+            f"({', '.join(wstats['warmed'])})",
+            file=sys.stderr,
+        )
 
     server = None
     health = None
@@ -621,6 +664,32 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 health=None, probe_out=None) -> None:
     from .utils.profiling import trace
 
+    # Pipelined serving (serving/pipeline.py): the host stage (this
+    # thread) polls/parses/scatters and DISPATCHES each render tick's
+    # read side; the device stage (one worker thread) absorbs the sync
+    # and renders. The handoff is bounded (depth 2) with coalescing
+    # backpressure; 'off' keeps the serial chain byte-for-byte.
+    pipe = None
+    feature_stage = None
+    # consecutive render ticks whose idle eviction had to defer — the
+    # bounded catch-up in _dispatch_render keys off it
+    evict_state = {"misses": 0}
+    host_busy = host_span = contextlib.nullcontext
+    if getattr(args, "pipeline", "off") != "off":
+        import functools
+
+        from .serving.pipeline import FeatureStage, ServePipeline
+
+        pipe = ServePipeline(
+            consume=lambda job: job(), depth=2, metrics=m,
+        ).start()
+        host_busy = pipe.host_stage
+        host_span = functools.partial(tracer.span, "stage.host")
+        if (not sharded and args.table_rows > 0
+                and not getattr(predict, "host_native", False)):
+            # donated double-buffers pin the per-render feature matrix
+            feature_stage = FeatureStage(engine.table.capacity)
+
     ticks = 0
     # A restarted serve must keep numbering ABOVE the rotation's existing
     # members: ticks restart at 0 here, and lower-numbered snapshots
@@ -650,6 +719,10 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                     batch = next(source, end)
                 if batch is end:
                     break
+                if pipe is not None:
+                    # a dead device stage must kill the serve (and leave
+                    # a post-mortem), not let the host spin silently
+                    pipe.raise_if_failed()
                 if health is not None:
                     health.tick()
                     if (not probe_wired and probe_out is not None
@@ -659,7 +732,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                         # /healthz liveness probe at first arrival
                         health.set_collector_probe(probe_out["probe"])
                         probe_wired = True
-                with tracer.span("tick"):
+                with tracer.span("tick"), host_busy(), host_span():
                     engine.mark_tick()  # freshness floor for the render
                     with m.time("ingest_s"):
                         with tracer.span("parse"):
@@ -672,6 +745,10 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                             engine.step()
                     ticks += 1
                     m.inc("ticks")
+                    # every tick, not just render ticks: a /metrics
+                    # scrape between renders must not read a drop count
+                    # up to print_every ticks stale
+                    m.set("flows_dropped", engine.dropped)
                     if ticks % args.print_every == 0:
                         if engine.dropped > dropped_seen:
                             print(
@@ -683,8 +760,14 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 file=sys.stderr,
                             )
                             dropped_seen = engine.dropped
-                        m.set("flows_dropped", engine.dropped)
-                        if sharded:
+                        if pipe is not None:
+                            _dispatch_render(
+                                args, engine, model, predict,
+                                serve_params, m, tracer, pipe,
+                                feature_stage, sharded,
+                                evict_state=evict_state,
+                            )
+                        elif sharded:
                             # the sharded tick's whole read side
                             # (per-shard predict + render candidates +
                             # stale masks) is one dispatch, with
@@ -729,11 +812,127 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                     print(m.report(), file=sys.stderr, flush=True)
                 if args.max_ticks and ticks >= args.max_ticks:
                     break
+        if pipe is not None:
+            # end of stream: staged renders finish before the loop
+            # returns (save-serve-state and capsys-style capture both
+            # rely on it), and a device-stage failure surfaces here
+            pipe.shutdown(drain=True)
+            pipe.raise_if_failed()
     finally:
+        if pipe is not None:
+            pipe.shutdown(drain=False)  # idempotent; error paths drop
         # deterministic teardown (the generator's finally stops the
         # collector) BEFORE the obs server goes down, so /healthz can
         # never observe a half-stopped source
         source.close()
+
+
+def _dispatch_render(args, engine, model, predict, serve_params, m,
+                     tracer, pipe, feature_stage, sharded,
+                     evict_state=None) -> None:
+    """Host-stage half of one pipelined render tick: dispatch the read
+    side against THIS tick's table and stage the device-stage job.
+    Output is byte-identical to the serial render of the same tick —
+    n_flows is captured at dispatch, the dispatched arrays are fixed
+    against tick-N state, and idle eviction only runs while no render
+    is in flight (a released slot's metadata must outlive its render)."""
+    from .serving.pipeline import dispatch_read
+
+    idle = args.idle_timeout or None
+    if sharded:
+        if idle is not None and engine.last_time:
+            # the sharded read side fuses eviction into the render
+            # dispatch and releasing slots needs the synced stale bits
+            # on the host stage: run the fused tick here and hand only
+            # the formatting to the device stage (the no-eviction
+            # sharded serve overlaps fully — docs/ARCHITECTURE.md)
+            with m.time("predict_s"), tracer.span("predict"):
+                rows, evicted = engine.tick_render(
+                    now=engine.last_time, idle_seconds=idle,
+                )
+            m.inc("evicted", evicted)
+            n_flows = engine.num_flows()
+            # resolve slot metadata HERE, before returning to ingest: a
+            # slot this tick just released could be reused by the next
+            # tick's ingest, and a deferred lookup on the worker would
+            # print the NEW flow's addresses under the OLD flow's label
+            sample = engine.slot_metadata([s for s, *_ in rows])
+
+            def render_only(rows=rows, n_flows=n_flows, sample=sample):
+                with tracer.span("stage.device"), tracer.span("render"):
+                    _print_ranked_resolved(model, rows, sample, n_flows)
+
+            pipe.submit(render_only)
+            return
+        with tracer.span("dispatch"):
+            outs = engine.tick_read_dispatch(now=engine.last_time)
+            n_flows = engine.num_flows()
+
+        def sharded_job(outs=outs, n_flows=n_flows):
+            with tracer.span("stage.device"):
+                with m.time("predict_s"), tracer.span("predict"):
+                    rows = engine.tick_read_finish(outs)
+                with tracer.span("render"):
+                    _print_ranked(engine, model, rows, n_flows)
+
+        pipe.submit(sharded_job)
+        return
+    if idle is not None and engine.last_time:
+        if not pipe.idle():
+            # an eviction while a dispatched render is in flight could
+            # release a ranked slot's metadata before the device stage
+            # reads it — defer, and count the deferral
+            m.inc("evict_deferred")
+            if evict_state is not None:
+                evict_state["misses"] += 1
+                if evict_state["misses"] >= 2:
+                    # bounded catch-up: under sustained backpressure
+                    # "defer" must not become "never" (the table would
+                    # fill and drop flows forever) — wait out the
+                    # in-flight render, then reclaim
+                    pipe.drain(timeout=10.0)
+        if pipe.idle():
+            if evict_state is not None:
+                evict_state["misses"] = 0
+            m.inc(
+                "evicted",
+                engine.evict_idle(engine.last_time, idle),
+            )
+    with tracer.span("dispatch"):
+        read = dispatch_read(
+            engine, predict, serve_params, args.table_rows,
+            feature_stage,
+        )
+
+    def job(read=read):
+        with tracer.span("stage.device"):
+            with m.time("predict_s"), tracer.span("predict"):
+                rows = read.rows()
+            with tracer.span("render"):
+                if args.table_rows > 0:
+                    _print_ranked(engine, model, rows, read.n_flows)
+                else:
+                    _print_full(model, rows)
+
+    pipe.submit(job)
+
+
+def _print_full(model, rows) -> None:
+    """Render the unbounded (``--table-rows 0``) table from a
+    ``serving.pipeline.FullRead`` row list — the device-stage
+    counterpart of ``_print_table``'s full branch."""
+    from .utils.table import CLASSIFIER_FIELDS, render_table, status_str
+
+    names = model.classes.names
+    out = [
+        (
+            slot, src, dst,
+            names[c] if c < len(names) else "?",
+            status_str(f), status_str(r),
+        )
+        for slot, src, dst, c, f, r in rows
+    ]
+    print(render_table(CLASSIFIER_FIELDS, out), flush=True)
 
 
 def _print_table(engine, model, predict, serve_params, args,
@@ -797,10 +996,17 @@ def _print_table(engine, model, predict, serve_params, args,
 def _print_ranked(engine, model, ranked, n_flows) -> None:
     """Render activity-ranked ``(slot, label, fwd, rev)`` rows — the shared
     table surface for the single-device and mesh-sharded serve loops."""
+    sample = engine.slot_metadata(slots=[s for s, *_ in ranked])
+    _print_ranked_resolved(model, ranked, sample, n_flows)
+
+
+def _print_ranked_resolved(model, ranked, sample, n_flows) -> None:
+    """``_print_ranked`` with the slot→(src, dst) sample already
+    resolved — the pipelined sharded eviction path resolves it on the
+    host stage (the lookup must precede any slot reuse)."""
     from .utils.table import CLASSIFIER_FIELDS, render_table, status_str
 
     names = model.classes.names
-    sample = engine.slot_metadata(slots=[s for s, *_ in ranked])
     rows = []
     for slot, c, fa, ra in ranked:
         if slot not in sample:

@@ -478,6 +478,25 @@ def top_active_scored(table: FlowTable, labels, n: int, floor):
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
+def top_active_flags(table: FlowTable, n: int, floor):
+    """``top_active_render`` minus the label gather:
+    ``(idx, valid, fwd_active[idx], rev_active[idx])`` for the ≤n most
+    active slots. The host-native pipelined serve path dispatches this
+    at tick N (fixing the ranked set against tick N's table) while the
+    full-table labels are computed later on the device-stage worker by
+    the C++ predict — which needs no (capacity,) dummy label vector
+    crossing the link just to satisfy ``top_active_render``'s
+    signature."""
+    idx, valid = top_active_slots(table, n, floor)
+    return (
+        idx,
+        valid,
+        jnp.take(table.fwd.active[:-1], idx),
+        jnp.take(table.rev.active[:-1], idx),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
 def top_active_render(table: FlowTable, labels, n: int, floor):
     """Everything one rendered table row needs, gathered on device in one
     dispatch: ``(idx, valid, labels[idx], fwd_active[idx], rev_active[idx])``

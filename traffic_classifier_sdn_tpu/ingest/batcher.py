@@ -194,8 +194,11 @@ class Batcher:
 # Donated so XLA updates the table in-place in HBM between poll ticks.
 # The batch crosses as one packed (B, 4) compact or (B, 6) full uint32
 # buffer (flow_table.pack_wire chooses per batch) and unpacks on device —
-# one transfer per flush instead of eight.
-_apply = jax.jit(ft.apply_wire, donate_argnums=0)
+# one transfer per flush instead of eight. Public (not ``_apply``): the
+# AOT warmup (serving/warmup.py) must prime THIS callable's compile
+# cache per bucket shape — a separately-jitted apply_wire would warm a
+# different cache and leave the first-tick stall in place.
+apply_wire_jit = jax.jit(ft.apply_wire, donate_argnums=0)
 
 
 class HostSpine:
@@ -286,6 +289,13 @@ class HostSpine:
         demote a busy flow. Never calling it degrades the ranking to
         all-time activity."""
         self._tick_floor = self.last_time
+
+    @property
+    def tick_floor(self) -> int:
+        """The activity-ranking freshness floor snapped by the last
+        ``mark_tick`` — the read-dispatch path (serving/pipeline.py)
+        needs it to rank against exactly this tick's floor."""
+        return self._tick_floor
 
     def _slot_meta_for(self, slots) -> dict:
         """slot → (eth_src, eth_dst) for exactly the given slots."""
@@ -392,7 +402,7 @@ class FlowStateEngine(HostSpine):
         while (batch := self.batcher.flush()) is not None:
             w = ft.pack_wire(batch)
             self.wire_bytes += w.nbytes  # padded, i.e. what actually moves
-            self.table = _apply(self.table, w)
+            self.table = apply_wire_jit(self.table, w)
             applied = True
         return applied
 

@@ -226,6 +226,21 @@ class LoadedModel:
         return self.serve_predict, self.serve_params
 
 
+def jit_serving_fn(serve_fn: Callable) -> Callable:
+    """The one correct way to jit a serving predict fn: jit device
+    kernels, return host-native kernels untouched. The ``host_native``
+    contract (see _build_serving_path's native branches) forbids
+    jitting: a jitted host callback queues on the XLA CPU pool behind
+    its own input's producer — a deterministic deadlock on single-core
+    hosts at the second pipelined tick. Shared by cli.py and
+    tools/bench_serve.py so neither re-derives the rule."""
+    import jax
+
+    if getattr(serve_fn, "host_native", False):
+        return serve_fn
+    return jax.jit(serve_fn)
+
+
 def make_loaded_model(name: str, params, classes) -> LoadedModel:
     """Assemble a LoadedModel — shared by the sklearn-pickle importer and
     the native checkpoint loader (io/checkpoint.load_model)."""

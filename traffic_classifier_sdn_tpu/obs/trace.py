@@ -124,8 +124,15 @@ class Tracer:
         elif span in stack:
             stack.remove(span)
         if self.metrics is not None:
+            # the pipeline's stage-boundary spans are named "stage.host"/
+            # "stage.device" (docs/OBSERVABILITY.md); strip the family
+            # prefix so their histograms land as stage_host_s rather
+            # than the double-prefixed stage_stage.host_s
+            base = span.name
+            if base.startswith("stage."):
+                base = base[len("stage."):]
             self.metrics.observe(
-                f"{self.METRIC_PREFIX}{span.name}_s", span.duration
+                f"{self.METRIC_PREFIX}{base}_s", span.duration
             )
         if self.recorder is not None:
             self.recorder.record(

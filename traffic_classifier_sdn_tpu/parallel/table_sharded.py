@@ -273,6 +273,50 @@ class ShardedFlowEngine(HostSpine):
                 self.tables = self._apply(self.tables, chunk)
         return True
 
+    def tick_read_dispatch(self, now: int,
+                           idle_seconds: int | None = None):
+        """Flush pending updates and DISPATCH the tick's whole read side
+        (one shard_map call); returns the un-synced device outputs.
+        The pipelined serve loop's host stage calls this so the device
+        stage can absorb the sync (``tick_read_finish``) off the poll
+        path; ``tick_render`` composes both for the serial loop.
+
+        ``idle_seconds=None`` compiles the same shape with an inert
+        2^30 s horizon — callers that skip eviction must not act on the
+        returned stale bits (see tick_render)."""
+        if self._tick_outputs is None:
+            raise ValueError("engine built without a predict_fn")
+        self.step()
+        return self._tick_outputs(
+            self.tables, self.params, self._tick_floor, now,
+            idle_seconds if idle_seconds is not None else (1 << 30),
+        )
+
+    def tick_read_finish(self, outs) -> list[tuple]:
+        """Sync the dispatched read side and merge the per-shard
+        candidates into the global top-``table_rows`` render rows —
+        the device-stage half of a pipelined sharded render (no
+        eviction: that stays on the host stage, which owns the index)."""
+        idx, valid, score, lab, fa, ra, _bits = (
+            np.asarray(o) for o in outs
+        )
+        return self._merge_candidates(idx, valid, score, lab, fa, ra)
+
+    def _merge_candidates(self, idx, valid, score, lab, fa, ra):
+        """Global render merge: best table_rows of n_shards×table_rows
+        candidates (tiny, host-side)."""
+        cand = []
+        for s in range(self.n_shards):
+            for j in range(idx.shape[1]):
+                if valid[s, j]:
+                    cand.append((
+                        float(score[s, j]),
+                        int(idx[s, j]) * self.n_shards + s,
+                        int(lab[s, j]), bool(fa[s, j]), bool(ra[s, j]),
+                    ))
+        cand.sort(key=lambda c: (-c[0], c[1]))
+        return [(g, c, f, r) for _sc, g, c, f, r in cand[: self.table_rows]]
+
     def tick_render(self, now: int, idle_seconds: int | None):
         """One fused read-side dispatch for the whole mesh: returns
         ``(rows, evicted)`` where rows are the global top table_rows
@@ -289,27 +333,13 @@ class ShardedFlowEngine(HostSpine):
         if self._tick_outputs is None:
             raise ValueError("engine built without a predict_fn")
         evict = idle_seconds is not None
-        self.step()
-        idx, valid, score, lab, fa, ra, bits = (
-            np.asarray(o)
-            for o in self._tick_outputs(
-                self.tables, self.params, self._tick_floor, now,
-                idle_seconds if evict else (1 << 30),
-            )
+        outs = self.tick_read_dispatch(
+            now, idle_seconds if evict else None
         )
-        # global render merge: best table_rows of n_shards×table_rows
-        # candidates (tiny, host-side)
-        cand = []
-        for s in range(self.n_shards):
-            for j in range(idx.shape[1]):
-                if valid[s, j]:
-                    cand.append((
-                        float(score[s, j]),
-                        int(idx[s, j]) * self.n_shards + s,
-                        int(lab[s, j]), bool(fa[s, j]), bool(ra[s, j]),
-                    ))
-        cand.sort(key=lambda c: (-c[0], c[1]))
-        rows = [(g, c, f, r) for _sc, g, c, f, r in cand[: self.table_rows]]
+        idx, valid, score, lab, fa, ra, bits = (
+            np.asarray(o) for o in outs
+        )
+        rows = self._merge_candidates(idx, valid, score, lab, fa, ra)
 
         # eviction: unpack each shard's bits, release + clear
         evicted = 0

@@ -1,0 +1,39 @@
+"""Pipelined serving: the serve loop as a bounded two-stage software
+pipeline.
+
+The reference classifies flows strictly serially — one blocking
+``model.predict`` per flow inside the poll loop
+(traffic_classifier.py:103-106) — and the tick-granular serve loop
+inherits that shape: poll → parse → scatter → predict → render as one
+synchronous chain, device idle while the host waits on telemetry, host
+idle while the device computes. This package breaks the chain:
+
+- ``serving.pipeline`` — the bounded host/device stage handoff
+  (depth 1–2, explicit backpressure: ticks coalesce instead of queueing
+  unboundedly), the device-stage worker thread, donated double-buffers
+  for the feature matrix, and the dispatched read-side objects shared
+  by ``cli.py`` and ``tools/bench_serve.py``.
+- ``serving.warmup`` — AOT lowering of the serving fns at startup
+  (``jax.jit(...).lower(...).compile()`` against the batcher's
+  power-of-two bucket shapes) wired to JAX's persistent compilation
+  cache, so the multi-second first-tick compile stall disappears and
+  restarts — including checkpoint-rollback restarts — start hot.
+
+docs/ARCHITECTURE.md (serve-loop diagram) and docs/OBSERVABILITY.md
+(``stage.host``/``stage.device`` spans, ``queue_depth``,
+``ticks_coalesced``, ``stage_overlap_s``) are the operator-facing story.
+"""
+
+from .pipeline import (
+    FeatureStage,
+    Handoff,
+    ServePipeline,
+    dispatch_read,
+)
+
+__all__ = [
+    "FeatureStage",
+    "Handoff",
+    "ServePipeline",
+    "dispatch_read",
+]

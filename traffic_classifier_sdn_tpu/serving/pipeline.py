@@ -1,0 +1,506 @@
+"""Bounded two-deep software pipeline for the serve loop.
+
+Two stages over one bounded handoff:
+
+- the **host stage** (the caller's thread — the main thread in
+  ``cli.py``) polls telemetry, parses it, scatters the update batch
+  into the device flow table, and *dispatches* the tick's read side
+  (features → predict → ranked render gather). JAX dispatch is
+  asynchronous, so dispatching costs host microseconds; the host never
+  waits for device results.
+- the **device stage** (one worker thread) blocks on the dispatched
+  arrays, converts the O(rows) results to host tuples, and renders.
+  For host-native kernels (``TCSDN_FOREST_KERNEL=native``,
+  ``TCSDN_KNN_TOPK=native``) there is nothing async to wait on, so the
+  worker runs the C++ predict itself — the entry points drop the GIL
+  and are mutex-guarded (native/flow_engine.cpp, PR 2), so host/compute
+  overlap is real there too.
+
+Backpressure is explicit and bounded: the handoff holds at most
+``depth`` (1–2) staged ticks. When the device stage falls behind, a new
+tick *coalesces* into the newest staged one (the stale render is
+superseded — its telemetry is already in the flow table, only its
+un-printed frame is dropped) rather than queueing unboundedly; the
+``ticks_coalesced`` counter and ``queue_depth`` gauge make the overload
+visible, and ``stage_overlap_s`` (observed per device-stage job) proves
+the overlap on the same ``stage_*_p50/p99`` histograms the span tracer
+already feeds.
+
+Output equivalence: with the device stage keeping up (no coalescing),
+the pipelined loop renders byte-identical PrettyTable rows to the
+serial loop — the read side of tick N is dispatched *at* tick N (so it
+sees exactly tick N's table), ``n_flows`` is captured at dispatch, and
+idle eviction is deferred to pipeline-idle moments so a ranked slot's
+host metadata cannot be released between dispatch and render
+(tests/test_pipeline.py pins this for the device-kernel, host-native,
+full-table, and sharded paths).
+
+Fault sites ``pipeline.handoff`` and ``pipeline.coalesce`` thread the
+chaos matrix through the new concurrency seams (utils/faults.SITES).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from collections.abc import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flow_table as ft
+from ..utils import faults
+
+
+class Handoff:
+    """The bounded staging handoff between the two stages: a rotating
+    set of at most ``depth`` slots guarded by one condition variable.
+
+    ``put`` never blocks and never grows the queue past ``depth`` —
+    when full, the new item coalesces into the newest staged slot
+    (``merge(staged, new)``, default: replace) and the coalesce counter
+    advances. ``get``/``done`` are the consumer half; ``join`` waits
+    for empty-and-idle (the drain barrier a clean shutdown needs)."""
+
+    def __init__(self, depth: int = 2,
+                 merge: Callable | None = None):
+        if depth < 1:
+            raise ValueError("handoff depth must be >= 1")
+        self.depth = depth
+        self._merge = merge
+        self._lock = threading.Condition()
+        self._slots: deque = deque()
+        self._inflight = 0
+        self._coalesced = 0
+        self._closed = False
+
+    def put(self, item) -> bool:
+        """Stage one item; True if queued, False if it coalesced into
+        the newest staged slot (backpressure)."""
+        with self._lock:
+            faults.fault_point("pipeline.handoff")
+            if self._closed:
+                raise RuntimeError("handoff is closed")
+            if len(self._slots) < self.depth:
+                self._slots.append(item)
+                self._lock.notify_all()
+                return True
+            faults.fault_point("pipeline.coalesce")
+            staged = self._slots[-1]
+            self._slots[-1] = (
+                self._merge(staged, item) if self._merge is not None
+                else item
+            )
+            self._coalesced += 1
+            return False
+
+    def get(self, timeout: float | None = None):
+        """Next staged item (oldest first), blocking up to ``timeout``;
+        None on timeout or when closed with nothing staged."""
+        with self._lock:
+            while not self._slots and not self._closed:
+                if not self._lock.wait(timeout):
+                    return None
+            if not self._slots:
+                return None  # closed and drained
+            item = self._slots.popleft()
+            self._inflight += 1
+            self._lock.notify_all()
+            return item
+
+    def done(self) -> None:
+        """Consumer: the last ``get`` item is fully processed."""
+        with self._lock:
+            if self._inflight > 0:
+                self._inflight -= 1
+            self._lock.notify_all()
+
+    def join(self, timeout: float | None = None) -> bool:
+        """Wait until nothing is staged or in flight; False on timeout."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        with self._lock:
+            while self._slots or self._inflight:
+                if deadline is None:
+                    self._lock.wait()
+                    continue
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._lock.wait(left):
+                    return False
+            return True
+
+    def close(self) -> None:
+        """No further puts; staged items still drain through ``get``."""
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    def abort(self) -> None:
+        """Drop everything and close — the device stage died, or the
+        host is bailing out on an exception; ``join`` must not hang on
+        work that will never be consumed."""
+        with self._lock:
+            self._slots.clear()
+            self._inflight = 0
+            self._closed = True
+            self._lock.notify_all()
+
+    @property
+    def queued(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    @property
+    def coalesced(self) -> int:
+        with self._lock:
+            return self._coalesced
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._slots and not self._inflight
+
+
+class _HostBusy:
+    """Context manager marking one host-stage busy interval — the
+    overlap accounting's producer half (see ServePipeline)."""
+
+    __slots__ = ("_pipe", "_t0")
+
+    def __init__(self, pipe: "ServePipeline"):
+        self._pipe = pipe
+
+    def __enter__(self):
+        self._t0 = self._pipe._clock()
+        with self._pipe._lock:
+            self._pipe._host_open = self._t0
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = self._pipe._clock()
+        with self._pipe._lock:
+            self._pipe._host_iv.append((self._t0, t1))
+            self._pipe._host_busy_s += t1 - self._t0
+            self._pipe._host_open = None
+        return False
+
+
+class ServePipeline:
+    """The two-stage pipeline: a ``Handoff`` plus one device-stage
+    worker thread running ``consume(item)`` per staged item, with
+    exception propagation back to the host stage and exact
+    host/device overlap accounting.
+
+    The host stage wraps its per-tick work in ``host_stage()`` and
+    stages render jobs with ``submit``; a device-stage failure is
+    re-raised in the host thread at the next ``submit``/``drain``/
+    ``raise_if_failed`` so the serve loop's crash forensics (the obs
+    post-mortem dump) see the original exception."""
+
+    def __init__(self, consume: Callable, *, depth: int = 2,
+                 metrics=None, merge: Callable | None = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self._consume = consume
+        self._metrics = metrics
+        self._clock = clock
+        self._handoff = Handoff(depth=depth, merge=merge)
+        self._lock = threading.Lock()
+        self._exc: BaseException | None = None
+        # recent host busy intervals (bounded): the device stage
+        # intersects its own busy window with these to observe
+        # stage_overlap_s exactly — device jobs are serial, so each
+        # host interval is counted against at most one device window
+        self._host_iv: deque = deque(maxlen=256)
+        self._host_open: float | None = None
+        self._host_busy_s = 0.0
+        self._device_busy_s = 0.0
+        self._overlap_s = 0.0
+        self._thread = threading.Thread(
+            target=self._run, name="tcsdn-device-stage", daemon=True
+        )
+
+    # -- host stage --------------------------------------------------------
+    def start(self) -> "ServePipeline":
+        self._thread.start()
+        return self
+
+    def host_stage(self) -> _HostBusy:
+        return _HostBusy(self)
+
+    def submit(self, item) -> bool:
+        """Stage one device-stage job; True if queued, False if it
+        coalesced. Raises the device stage's exception if it died."""
+        self.raise_if_failed()
+        try:
+            queued = self._handoff.put(item)
+        except RuntimeError:
+            # closed under us — the device stage died between checks
+            self.raise_if_failed()
+            raise
+        if self._metrics is not None:
+            self._metrics.set("queue_depth", self._handoff.queued)
+            if not queued:
+                self._metrics.inc("ticks_coalesced")
+        return queued
+
+    def raise_if_failed(self) -> None:
+        with self._lock:
+            exc = self._exc
+        if exc is not None:
+            raise exc
+
+    def failed(self) -> bool:
+        with self._lock:
+            return self._exc is not None
+
+    def idle(self) -> bool:
+        """Nothing staged and nothing in flight — the host may run work
+        (idle eviction) whose host-side bookkeeping a concurrent render
+        would observe."""
+        return self._handoff.idle
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Wait for every staged job to finish; re-raise a device-stage
+        failure. False on timeout."""
+        ok = self._handoff.join(timeout)
+        self.raise_if_failed()
+        return ok
+
+    def shutdown(self, drain: bool = True,
+                 timeout: float = 10.0) -> None:
+        """Stop the device stage. ``drain=True`` lets staged jobs
+        finish first (clean end of stream); ``drain=False`` drops them
+        (error paths). Never raises — call ``raise_if_failed`` after a
+        drain when failures must surface."""
+        if drain and not self.failed():
+            self._handoff.join(timeout)
+            self._handoff.close()
+        else:
+            self._handoff.abort()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def stats(self) -> dict:
+        with self._lock:
+            host_busy = self._host_busy_s
+            device_busy = self._device_busy_s
+            overlap = self._overlap_s
+        return {
+            "host_busy_s": host_busy,
+            "device_busy_s": device_busy,
+            "overlap_s": overlap,
+            "ticks_coalesced": self._handoff.coalesced,
+        }
+
+    # -- device stage ------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            item = self._handoff.get(timeout=0.2)
+            if item is None:
+                if self._handoff.closed:
+                    return
+                continue
+            t0 = self._clock()
+            try:
+                self._consume(item)
+            except BaseException as e:  # noqa: BLE001 — repropagated to host
+                with self._lock:
+                    self._exc = e
+                self._handoff.done()
+                self._handoff.abort()
+                return
+            self._handoff.done()
+            self._account(t0, self._clock())
+
+    def _account(self, t0: float, t1: float) -> None:
+        overlap = 0.0
+        with self._lock:
+            self._device_busy_s += t1 - t0
+            for a, b in self._host_iv:
+                lo = a if a > t0 else t0
+                hi = b if b < t1 else t1
+                if hi > lo:
+                    overlap += hi - lo
+            if self._host_open is not None and t1 > self._host_open:
+                # the host stage is busy RIGHT NOW — its open interval
+                # won't be recorded until it exits, but the device job
+                # overlapping it must still count
+                lo = self._host_open if self._host_open > t0 else t0
+                if t1 > lo:
+                    overlap += t1 - lo
+            self._overlap_s += overlap
+        if self._metrics is not None:
+            self._metrics.observe("stage_overlap_s", overlap)
+            self._metrics.set("queue_depth", self._handoff.queued)
+
+
+# ---------------------------------------------------------------------------
+# Donated double-buffers for the feature matrix
+# ---------------------------------------------------------------------------
+
+# The feature projection with its output pinned to a donated buffer:
+# (capacity, 12) f32 in → (capacity, 12) f32 out lets XLA alias the
+# donated input for the result, so the per-render-tick feature matrix
+# stops allocating fresh HBM (50 MB/tick at capacity 2²⁰) and instead
+# rotates through two persistent buffers.
+_FEATURES_INTO = jax.jit(
+    lambda buf, table: ft.features12(table), donate_argnums=0
+)
+
+
+class FeatureStage:
+    """Two rotating donated device buffers pinning the serving feature
+    matrix. ``features(table)`` computes this tick's (capacity, 12)
+    matrix *into* the older buffer (donated — XLA reuses its storage)
+    while the newer one may still feed the previous tick's in-flight
+    predict; JAX's dependency tracking orders the aliasing write after
+    every dispatched reader."""
+
+    def __init__(self, capacity: int):
+        self._bufs = [
+            jnp.zeros((capacity, ft.NUM_FEATURES), jnp.float32)
+            for _ in range(2)
+        ]
+        self._turn = 0
+
+    def features(self, table: ft.FlowTable) -> jax.Array:
+        i = self._turn
+        self._turn = 1 - i
+        out = _FEATURES_INTO(self._bufs[i], table)
+        self._bufs[i] = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Dispatched read-side objects (host stage dispatches, device stage syncs)
+# ---------------------------------------------------------------------------
+
+
+class RankedRead:
+    """Tick-N ranked read side, dispatched but not yet synced: the
+    device arrays of ``flow_table.top_active_render`` plus the
+    dispatch-time flow count. ``rows()`` (device stage) blocks and
+    builds the ``(slot, label, fwd_active, rev_active)`` list — exactly
+    ``FlowStateEngine.render_sample``'s output."""
+
+    __slots__ = ("_outs", "n_flows")
+
+    def __init__(self, outs, n_flows: int):
+        self._outs = outs
+        self.n_flows = n_flows
+
+    def rows(self) -> list[tuple]:
+        idx, valid, lab, fa, ra = (np.asarray(o) for o in self._outs)
+        return [
+            (int(s), int(c), bool(f), bool(r))
+            for s, v, c, f, r in zip(idx, valid, lab, fa, ra)
+            if v
+        ]
+
+
+class NativeRankedRead:
+    """Host-native variant: the worker thread runs the C++ predict
+    itself (the GIL-dropping, mutex-guarded entry points make the
+    overlap real), then joins the full-table labels with the
+    tick-N ranked flags dispatched by the host stage."""
+
+    __slots__ = ("_X", "_flags", "_predict", "_params", "n_flows")
+
+    def __init__(self, X, flags, predict, params, n_flows: int):
+        self._X = X
+        self._flags = flags
+        self._predict = predict
+        self._params = params
+        self.n_flows = n_flows
+
+    def rows(self) -> list[tuple]:
+        labels = np.asarray(self._predict(self._params, self._X))
+        idx, valid, fa, ra = (np.asarray(o) for o in self._flags)
+        return [
+            (int(s), int(labels[int(s)]), bool(f), bool(r))
+            for s, v, f, r in zip(idx, valid, fa, ra)
+            if v
+        ]
+
+
+class FullRead:
+    """Unbounded (``--table-rows 0``) read side: the whole label vector
+    plus per-direction active flags and a dispatch-time snapshot of the
+    slot→(src, dst) metadata (the full render is O(N) by definition, so
+    the snapshot does not change its complexity). The active slices are
+    fresh derived arrays, so the donated table update of a later tick
+    cannot invalidate them."""
+
+    __slots__ = ("_X", "_labels", "_fa", "_ra", "_meta", "_predict",
+                 "_params", "n_flows")
+
+    def __init__(self, X, labels, fa, ra, meta, predict, params,
+                 n_flows: int):
+        self._X = X
+        self._labels = labels
+        self._fa = fa
+        self._ra = ra
+        self._meta = meta
+        self._predict = predict
+        self._params = params
+        self.n_flows = n_flows
+
+    def rows(self) -> list[tuple]:
+        if self._labels is None:
+            labels = np.asarray(self._predict(self._params, self._X))
+        else:
+            labels = np.asarray(self._labels)
+        fa = np.asarray(self._fa)
+        ra = np.asarray(self._ra)
+        return [
+            (slot, src, dst, int(labels[slot]), bool(fa[slot]),
+             bool(ra[slot]))
+            for slot, (src, dst) in sorted(self._meta.items())
+        ]
+
+
+def dispatch_read(engine, predict, params, table_rows: int,
+                  feature_stage: FeatureStage | None = None):
+    """Dispatch one render tick's whole read side against the engine's
+    CURRENT (tick-N) table and return the un-synced read object —
+    the host-stage half of the pipeline's render path, shared by
+    ``cli.py`` and ``tools/bench_serve.py``.
+
+    Everything the device stage will touch is either a dispatched
+    device computation (fixed at dispatch: later scatters update new
+    buffers) or a host value captured here (``n_flows``); slot
+    metadata for ranked rows is resolved by the device stage per slot
+    — safe because ranked slots are in-use at tick N and the serve
+    loop defers eviction while renders are in flight."""
+    host_native = getattr(predict, "host_native", False)
+    floor = np.int32(engine.tick_floor)
+    n_flows = engine.num_flows()
+    if table_rows > 0:
+        n = min(table_rows, engine.table.capacity)
+        if host_native:
+            X = engine.features()
+            flags = ft.top_active_flags(engine.table, n, floor)
+            return NativeRankedRead(X, flags, predict, params, n_flows)
+        X = (
+            feature_stage.features(engine.table)
+            if feature_stage is not None else engine.features()
+        )
+        labels = predict(params, X)
+        outs = ft.top_active_render(engine.table, labels, n, floor)
+        return RankedRead(outs, n_flows)
+    X = engine.features()
+    labels = None if host_native else predict(params, X)
+    # [:-1] slices are fresh derived arrays — donation-safe snapshots
+    fa = engine.table.fwd.active[:-1]
+    ra = engine.table.rev.active[:-1]
+    meta = dict(engine.slot_metadata())
+    return FullRead(X, labels, fa, ra, meta, predict, params, n_flows)

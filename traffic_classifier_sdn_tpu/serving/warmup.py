@@ -1,0 +1,152 @@
+"""AOT warmup: compile the serving fns at startup, not at tick one.
+
+Without this, the first serve tick pays every jit compile in line —
+multi-second for the 2²⁰-row forest GEMM — which shows up as a
+first-tick ``tick``-span p99 orders of magnitude above steady state,
+and again on every restart. ``warmup_serving`` AOT-lowers and primes
+the exact jitted callables the serve loop uses (the batcher's donated
+``apply_wire_jit`` per power-of-two bucket shape, the donated
+feature-stage projection, the jitted predict, the ranked render
+gather, the eviction kernels) against zero-filled inputs of the real
+serving shapes, so the first tick runs hot.
+
+``enable_compilation_cache`` wires ``--compilation-cache-dir`` to
+JAX's persistent compilation cache: the warmup's compiles land on
+disk, and a restarted serve — including a checkpoint-rollback restart
+(PR 1) — replays them as cache hits instead of recompiling. AOT
+``.lower(...).compile()`` alone does not prime jax's in-process
+call-path cache on this jax version, so each warm also makes one
+priming call (against scratch state for donated fns — donation
+consumes the input, and the serve loop's live table must never be
+warmup fodder).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flow_table as ft
+from .pipeline import _FEATURES_INTO
+
+
+def enable_compilation_cache(path: str) -> None:
+    """Point JAX's persistent compilation cache at ``path`` and drop
+    the persistence gates: the default min-compile-time threshold
+    would skip exactly the small-bucket programs a restart re-pays."""
+    jax.config.update("jax_compilation_cache_dir", path)
+    for knob, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except AttributeError:  # older jax without the knob
+            pass
+    try:
+        from jax._src import compilation_cache as _cc
+
+        # a process that already compiled anything has the cache module
+        # initialized (possibly as disabled) — re-point it or the new
+        # dir silently never sees a write
+        _cc.reset_cache()
+    except (ImportError, AttributeError):  # private API moved — degrade
+        pass
+
+
+def _warm_jitted(fn, *args) -> None:
+    """AOT-lower + compile (feeds the persistent cache), then one
+    priming call (feeds the in-process cache). ``fn`` must be pure or
+    called on scratch state the caller owns."""
+    fn.lower(*args).compile()
+    jax.block_until_ready(fn(*args))
+
+
+def warmup_serving(engine, predict, params, *, table_rows: int,
+                   idle_timeout: int | None = None) -> dict:
+    """Precompile the serve loop's device programs for ``engine``'s
+    shapes. Returns ``{"warmed": [...], "seconds": float}``.
+
+    Single-device engines get the full treatment. The mesh-sharded
+    engine's read side is warmed through one inert
+    ``tick_read_dispatch`` (its apply path compiles per bucket on
+    first flush — those programs are per-shard-shaped and cheap next
+    to the read side's full-shard predict)."""
+    t0 = time.perf_counter()
+    warmed: list[str] = []
+    host_native = getattr(predict, "host_native", False)
+
+    if not hasattr(engine, "table"):  # sharded spine
+        outs = engine.tick_read_dispatch(now=0)
+        jax.block_until_ready(outs)
+        warmed.append("sharded.tick_read")
+        return {"warmed": warmed, "seconds": time.perf_counter() - t0}
+
+    from ..ingest import batcher as batcher_mod
+
+    capacity = engine.table.capacity
+    scratch = ft.make_table(capacity)
+
+    # -- scatter: one compile per bucket shape (compact wire) -------------
+    # Warm every bucket a tick at this capacity can plausibly fill
+    # (≤ two records per tracked flow per tick); larger buckets — and
+    # the rare (B, 6) full-width wire — still compile lazily.
+    limit = batcher_mod.bucket_size(
+        min(2 * capacity, engine.buckets[-1]), engine.buckets
+    )
+    for b in engine.buckets:
+        if b > limit:
+            break
+        wire = np.zeros((b, 4), np.uint32)
+        wire[:, 0] = np.uint32(capacity)  # all-padding rows: a clean no-op
+        batcher_mod.apply_wire_jit.lower(scratch, wire).compile()
+        # the priming call donates its input table; chain the returned
+        # scratch so one table's worth of HBM covers every bucket
+        scratch = batcher_mod.apply_wire_jit(scratch, wire)
+        warmed.append(f"apply_wire[{b}]")
+    jax.block_until_ready(scratch)
+
+    # -- features: the donated double-buffer projection (pipelined) and
+    # the eager projection (serial / host-native / full-table paths,
+    # which compile a dozen small kernels on first touch otherwise)
+    buf = jnp.zeros((capacity, ft.NUM_FEATURES), jnp.float32)
+    _FEATURES_INTO.lower(buf, scratch).compile()
+    X = _FEATURES_INTO(buf, scratch)
+    jax.block_until_ready(ft.features12(scratch))
+    warmed.append("features_into")
+
+    # -- predict -----------------------------------------------------------
+    if host_native:
+        # nothing jitted to compile, but the call loads the C++ library
+        # and faults its pages in — the native first-tick stall
+        labels = jnp.asarray(predict(params, X))
+        warmed.append("predict[native]")
+    else:
+        _warm_jitted(predict, params, X)
+        labels = predict(params, X)
+        warmed.append("predict")
+
+    # -- ranked render gather ---------------------------------------------
+    floor = np.int32(0)
+    if table_rows > 0:
+        n = min(table_rows, capacity)
+        if host_native:
+            _warm_jitted(ft.top_active_flags, scratch, n, floor)
+            warmed.append("top_active_flags")
+        _warm_jitted(ft.top_active_render, scratch, labels, n, floor)
+        warmed.append("top_active_render")
+
+    # -- eviction ----------------------------------------------------------
+    if idle_timeout:
+        _warm_jitted(ft.stale_bits, scratch, np.int32(0),
+                     np.int32(idle_timeout))
+        smallest = engine.buckets[0]
+        pad = np.full(smallest, capacity, np.int32)
+        _warm_jitted(ft.clear_slots, scratch, pad)
+        warmed.append("evict")
+
+    return {"warmed": warmed, "seconds": time.perf_counter() - t0}

@@ -73,6 +73,18 @@ SITES: dict[str, str] = {
         "native/engine.available() — the C++ engine is unavailable "
         "(build/dlopen failure)"
     ),
+    "pipeline.handoff": (
+        "serving/pipeline.Handoff.put — the host→device stage handoff "
+        "itself fails mid-tick (a fire == the staging seam dies while "
+        "the serve loop is pipelined; the host stage must surface it, "
+        "not wedge behind a dead device stage)"
+    ),
+    "pipeline.coalesce": (
+        "serving/pipeline.Handoff.put, coalesce branch — fires only "
+        "under backpressure, when a full queue merges the new tick into "
+        "the staged one (chaos must cover the overload path, not just "
+        "the steady-state handoff)"
+    ),
 }
 
 
