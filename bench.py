@@ -373,11 +373,17 @@ def measure(batches: list[int]) -> None:
             if _arts:
                 with open(_arts[-1]) as fh:
                     _chip = json.load(fh)
-                line["chip_artifact"] = (
-                    "docs/artifacts/" + _os.path.basename(_arts[-1])
-                )
-                line["chip_flows_per_sec"] = _chip.get("value")
-                line["chip_vs_baseline"] = _chip.get("vs_baseline")
+                # builder-attested chip numbers are NOT this run's
+                # measurements — nested under their own key so the
+                # official CPU record's top level carries only what this
+                # host actually measured (VERDICT r5 weak #7)
+                line["builder_attested"] = {
+                    "artifact": (
+                        "docs/artifacts/" + _os.path.basename(_arts[-1])
+                    ),
+                    "chip_flows_per_sec": _chip.get("value"),
+                    "chip_vs_baseline": _chip.get("vs_baseline"),
+                }
         except Exception:  # noqa: BLE001 — pointer is best-effort
             pass
 

@@ -524,6 +524,217 @@ def test_pipeline_coalesce_fault_fires_only_under_backpressure():
     assert h.get(timeout=0) == "t0"  # the staged tick survived intact
 
 
+def _degrade_checkpoint(tmp_path):
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (2, 12)),
+        "var": rng.gamma(2.0, 50.0, (2, 12)) + 1.0,
+        "class_prior": np.full(2, 0.5),
+    })
+    path = str(tmp_path / "gnb_ckpt")
+    ck.save_model(path, "gnb", params, classes=("ping", "voice"))
+    return path
+
+
+def _degrade_serve(ckpt, extra, max_ticks=160):
+    import contextlib
+    import io
+
+    from traffic_classifier_sdn_tpu import cli
+
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        cli.main([
+            "gaussiannb", "--native-checkpoint", ckpt,
+            "--source", "synthetic", "--synthetic-flows", "16",
+            "--capacity", "64", "--print-every", "2",
+            "--max-ticks", str(max_ticks), "--idle-timeout", "0",
+            "--table-rows", "8", "--pipeline", "off",
+        ] + extra)
+    return out.getvalue(), err.getvalue()
+
+
+def test_degrade_dispatch_stall_full_ladder_recovers(tmp_path):
+    """THE acceptance scenario (fixed seed): with degrade.dispatch_stall
+    armed, the serve loop produces EVERY render tick within 2x the
+    configured deadline on the fallback rung; once the site disarms,
+    the probe path re-promotes the device kernel — and the whole
+    trajectory is visible in /metrics (degrade_state back to 0,
+    transitions counted) and the flight recorder (the --obs-dir dump
+    carries the transition + probe events and the fault firings)."""
+    from traffic_classifier_sdn_tpu.utils.metrics import global_metrics
+
+    ckpt = _degrade_checkpoint(tmp_path)
+    obs_dir = str(tmp_path / "obs")
+    deadline = 1.0
+    # the stall fires on the first device dispatch (the trip) and on
+    # the next two probes, then disarms — recovery needs 3 more probes
+    plan = faults.FaultPlan(
+        [faults.FaultRule("degrade.dispatch_stall", times=3)], SEED
+    )
+    t0 = time.monotonic()
+    with faults.installed(plan):
+        out, err = _degrade_serve(ckpt, [
+            "--degrade", "auto",
+            "--device-deadline", str(deadline),
+            "--probe-every", "0.002", "--probe-successes", "3",
+            "--obs-dir", obs_dir, "--obs-dump-on-exit",
+        ], max_ticks=160)
+    assert [s for s, _ in plan.fires] == ["degrade.dispatch_stall"] * 3
+
+    # every render tick was produced: 160 ticks / print-every 2
+    assert out.count("Flow ID") == 80
+    # ...and within budget: the simulated stall consumes no wall clock,
+    # so EVERY tick (not just the tripping one) beats 2x the deadline —
+    # the per-tick latency histogram the span tracer feeds proves it
+    ticks = global_metrics.histograms["stage_tick_s"]
+    assert ticks.count >= 160
+    assert max(ticks._samples) < 2 * deadline
+    assert time.monotonic() - t0 < 160 * 2 * deadline
+
+    # the ladder walked the whole diagram and re-promoted
+    degrade_lines = [l for l in err.splitlines() if "DEGRADE" in l]
+    assert "DEGRADE: HEALTHY -> DEGRADED (deadline)" in degrade_lines[0]
+    assert any("PROBING -> HEALTHY (promoted)" in l
+               for l in degrade_lines)
+    assert global_metrics.gauges["degrade_state"] == 0
+    assert global_metrics.counters["degrade_transitions"] >= 4
+    assert global_metrics.counters["probe_failures"] == 2
+
+    # flight recorder: transitions, probes, and the fault firings all
+    # landed in the post-mortem dump
+    dumps = [f for f in os.listdir(obs_dir) if f.endswith(".jsonl")]
+    assert dumps
+    import json
+
+    events = [
+        json.loads(l)
+        for f in dumps
+        for l in open(os.path.join(obs_dir, f), encoding="utf-8")
+    ]
+    kinds = {e["kind"] for e in events}
+    assert {"degrade.transition", "degrade.probe", "fault.fire"} <= kinds
+    promoted = [
+        e for e in events
+        if e["kind"] == "degrade.transition" and e.get("to") == "HEALTHY"
+    ]
+    assert promoted and promoted[-1]["reason"] == "promoted"
+    stall_fires = [
+        e for e in events
+        if e["kind"] == "fault.fire"
+        and e.get("site") == "degrade.dispatch_stall"
+    ]
+    assert len(stall_fires) == 3
+
+
+def test_degrade_dispatch_error_demotes_and_fault_never_escapes(tmp_path):
+    """degrade.dispatch_error simulates an ERRORING dispatch: the
+    FaultInjected must be ABSORBED by the ladder (the serve completes),
+    driving the error edge of HEALTHY→DEGRADED, and the tick's labels
+    come from the fallback."""
+    from traffic_classifier_sdn_tpu.utils.metrics import global_metrics
+
+    ckpt = _degrade_checkpoint(tmp_path)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("degrade.dispatch_error", times=None)], SEED
+    )
+    with faults.installed(plan):
+        out, err = _degrade_serve(ckpt, [
+            "--degrade", "auto", "--probe-every", "30",
+        ], max_ticks=20)
+    assert plan.fires and all(
+        s == "degrade.dispatch_error" for s, _ in plan.fires
+    )
+    assert out.count("Flow ID") == 10  # every render tick produced
+    assert any("HEALTHY -> DEGRADED (error:FaultInjected)" in l
+               for l in err.splitlines())
+    assert global_metrics.gauges["degrade_state"] in (1.0, 3.0)
+
+
+def test_degrade_probe_fault_resets_chain_and_backs_off():
+    """degrade.probe fires fail the recovery probe itself: the
+    consecutive-success counter resets, probe_failures counts, and the
+    ladder stays demoted until the site disarms."""
+    import random as random_mod
+
+    from traffic_classifier_sdn_tpu.serving.degrade import (
+        DEGRADED,
+        HEALTHY,
+        DegradeLadder,
+    )
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    clock = [0.0]
+    calls = {"n": 0}
+
+    def device(p, X):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("trip")
+        return np.full(int(X.shape[0]), 3, np.int32)
+
+    class FB:
+        kind = "test"
+
+        def predict(self, X):
+            return np.full(int(X.shape[0]), 3, np.int32)
+
+    m = Metrics()
+    lad = DegradeLadder(
+        device, FB(), deadline=0.0, probe_every=0.5,
+        probe_successes=2, metrics=m, clock=lambda: clock[0],
+        rng=random_mod.Random(SEED),
+    )
+    X = np.zeros((8, 12), np.float32)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("degrade.probe", after=1, times=1)], SEED
+    )
+    try:
+        with faults.installed(plan):
+            lad(None, X)  # trip
+            assert lad.state == DEGRADED
+            clock[0] = lad._next_probe_at + 0.01
+            lad(None, X)  # probe hit 1: clean (rule starts after 1)
+            assert lad.status()["probe_successes"] == 1
+            clock[0] = lad._next_probe_at + 0.01
+            lad(None, X)  # probe hit 2: FIRES -> chain reset + backoff
+            assert plan.fires == [("degrade.probe", 2)]
+            assert lad.status()["probe_successes"] == 0
+            assert lad.status()["backoff_level"] == 1
+            assert m.counters["probe_failures"] == 1
+            # disarmed: the chain rebuilds and promotes
+            for _ in range(2):
+                clock[0] = lad._next_probe_at + 0.01
+                lad(None, X)
+        assert lad.state == HEALTHY
+    finally:
+        lad.close()
+
+
+def test_degrade_dispatch_error_probabilistic_any_seed_always_renders(
+    tmp_path,
+):
+    """Probability-scheduled dispatch errors (any TCSDN_CHAOS_SEED):
+    whatever subset of device calls fail, the serve NEVER crashes and
+    every render tick produces a frame — the whole point of the
+    ladder."""
+    ckpt = _degrade_checkpoint(tmp_path)
+    plan = faults.FaultPlan(
+        [faults.FaultRule(
+            "degrade.dispatch_error", p=0.5, times=None,
+        )], SEED
+    )
+    with faults.installed(plan):
+        out, _ = _degrade_serve(ckpt, [
+            "--degrade", "auto", "--probe-every", "0.001",
+            "--probe-successes", "1",
+        ], max_ticks=40)
+    assert out.count("Flow ID") == 20
+
+
 def test_pipeline_handoff_probabilistic_any_seed_serve_survivable():
     """Probability-scheduled handoff failures (any TCSDN_CHAOS_SEED):
     every fire surfaces as FaultInjected at submit — never a hang, never

@@ -1,0 +1,659 @@
+"""Degradation ladder + device watchdog (serving/degrade.py).
+
+The load-bearing guarantees, each pinned here:
+
+- a REALLY wedged device dispatch (a sleeping predict, not a simulated
+  fault) is abandoned at the watchdog deadline and the tick still
+  produces labels from the fallback within 2x the deadline;
+- the state machine walks HEALTHY → DEGRADED → BROKEN → PROBING →
+  HEALTHY exactly as documented, with last-known-good labels and the
+  STALE render verdict on the BROKEN rung;
+- the probe backoff is exponential with full jitter and the schedule
+  is EXACT under an injected clock + seeded rng (mirroring the
+  SupervisedCollector backoff tests), and a failed probe resets the
+  consecutive-success counter;
+- a parity-mismatching probe (device answers in time but disagrees
+  with the live fallback) counts as failed — wrong-but-fast never
+  re-promotes;
+- ``models.resolve_fallback`` returns a working host fallback per
+  family, marked with its kind;
+- the CLI's ``--degrade auto`` no-fault output is byte-identical to
+  ``--degrade off`` (serial and pipelined), and /healthz reports
+  200-but-degraded with the ladder rung.
+"""
+
+import io
+import contextlib
+import json
+import os
+import random
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu import cli
+from traffic_classifier_sdn_tpu.serving.degrade import (
+    BROKEN,
+    DEGRADED,
+    HEALTHY,
+    PROBING,
+    DeadlineExceeded,
+    DegradeLadder,
+    DeviceWatchdog,
+)
+from traffic_classifier_sdn_tpu.utils.metrics import Metrics, global_metrics
+
+
+class _Fallback:
+    def __init__(self, fn, kind="test-fallback"):
+        self._fn = fn
+        self.kind = kind
+        self.calls = 0
+
+    def predict(self, X):
+        self.calls += 1
+        return self._fn(X)
+
+
+def _labels(value, n=8):
+    return np.full(n, value, np.int32)
+
+
+def _ladder(device, fallback=None, **kw):
+    kw.setdefault("deadline", 0.2)
+    kw.setdefault("first_deadline", 0.2)
+    kw.setdefault("probe_every", 1.0)
+    kw.setdefault("probe_successes", 2)
+    kw.setdefault("rng", random.Random(0))
+    return DegradeLadder(device, fallback, **kw)
+
+
+X8 = np.zeros((8, 12), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# DeviceWatchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_runs_and_returns():
+    wd = DeviceWatchdog()
+    try:
+        assert wd.call(lambda: 42, deadline=5.0) == 42
+        assert wd.abandoned == 0
+    finally:
+        wd.close()
+
+
+def test_watchdog_propagates_exception():
+    wd = DeviceWatchdog()
+    try:
+        boom = ValueError("device died")
+        with pytest.raises(ValueError) as ei:
+            wd.call(lambda: (_ for _ in ()).throw(boom), deadline=5.0)
+        assert ei.value is boom  # the original, not a wrapper
+    finally:
+        wd.close()
+
+
+def test_watchdog_abandons_wedged_call_within_budget():
+    """A dispatch that sleeps far past the deadline is abandoned at the
+    deadline (call returns within 2x) and the NEXT call still works on
+    a fresh worker — the wedged thread never blocks the ladder."""
+    wedge = threading.Event()
+    wd = DeviceWatchdog()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            wd.call(lambda: wedge.wait(timeout=30), deadline=0.2)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.4  # 2x the deadline, the acceptance budget
+        assert wd.abandoned == 1
+        # fresh worker: the watchdog still serves while the old thread
+        # is parked inside its wait
+        assert wd.call(lambda: "alive", deadline=5.0) == "alive"
+    finally:
+        wedge.set()
+        wd.close()
+
+
+def test_boot_wedged_device_pays_grace_once_not_per_probe():
+    """A device wedged FROM BOOT (no successful dispatch ever): the
+    first-attempt grace deadline is paid once — every later probe costs
+    one ordinary deadline, so a sick chip cannot stall serving for the
+    grace window on every probe forever."""
+    wedge = threading.Event()
+
+    def wedged(p, X):
+        wedge.wait(timeout=30)
+        return _labels(9)
+
+    clock = [0.0]
+    fb = _Fallback(lambda X: _labels(5))
+    lad = _ladder(wedged, fb, deadline=0.05, first_deadline=0.4,
+                  probe_every=1.0, clock=lambda: clock[0])
+    try:
+        t0 = time.monotonic()
+        lad(None, X8)  # boot dispatch: trips after the 0.4s grace
+        first_cost = time.monotonic() - t0
+        assert first_cost >= 0.35
+        assert lad.state == DEGRADED
+        clock[0] = lad._next_probe_at + 0.01
+        t0 = time.monotonic()
+        lad(None, X8)  # probe against the still-wedged device
+        probe_cost = time.monotonic() - t0
+        assert probe_cost < 0.3  # ~one 0.05s deadline, never the grace
+        assert lad.status()["probe_successes"] == 0
+    finally:
+        wedge.set()
+        lad.close()
+
+
+def test_watchdog_discards_late_result_from_abandoned_worker():
+    release = threading.Event()
+    done = threading.Event()
+    wd = DeviceWatchdog()
+    try:
+        def slow():
+            release.wait(timeout=30)
+            done.set()
+            return "late"
+
+        with pytest.raises(DeadlineExceeded):
+            wd.call(slow, deadline=0.1)
+        release.set()
+        assert done.wait(timeout=5)
+        # the late result must not satisfy a NEW call
+        assert wd.call(lambda: "fresh", deadline=5.0) == "fresh"
+    finally:
+        release.set()
+        wd.close()
+
+
+# ---------------------------------------------------------------------------
+# Ladder: trip + fallback + stale
+# ---------------------------------------------------------------------------
+
+
+def test_healthy_passthrough_is_the_device_labels():
+    lad = _ladder(lambda p, X: _labels(3))
+    try:
+        out = lad(None, X8)
+        np.testing.assert_array_equal(out, _labels(3))
+        assert lad.state == HEALTHY
+        assert not lad.render_stale
+    finally:
+        lad.close()
+
+
+def test_real_stall_demotes_and_tick_stays_within_budget():
+    """The r04 scenario in miniature: the device predict WEDGES (real
+    sleep, no simulated fault); the tick still produces the fallback's
+    labels within 2x the deadline, and the ladder is DEGRADED."""
+    wedge = threading.Event()
+
+    def wedged(p, X):
+        wedge.wait(timeout=30)
+        return _labels(9)
+
+    fb = _Fallback(lambda X: _labels(5))
+    lad = _ladder(wedged, fb, deadline=0.2, first_deadline=0.2)
+    try:
+        t0 = time.monotonic()
+        out = lad(None, X8)
+        assert time.monotonic() - t0 < 0.4  # 2x deadline
+        np.testing.assert_array_equal(out, _labels(5))
+        assert lad.state == DEGRADED
+        assert not lad.render_stale  # fallback labels are live
+    finally:
+        wedge.set()
+        lad.close()
+
+
+def test_error_trip_demotes():
+    def err(p, X):
+        raise RuntimeError("XLA runtime error")
+
+    fb = _Fallback(lambda X: _labels(5))
+    lad = _ladder(err, fb)
+    try:
+        out = lad(None, X8)
+        np.testing.assert_array_equal(out, _labels(5))
+        assert lad.state == DEGRADED
+    finally:
+        lad.close()
+
+
+def test_fallback_failure_goes_broken_serves_stale_then_recovers():
+    """DEGRADED → BROKEN on fallback error; BROKEN serves the
+    last-known-good labels with the STALE verdict; a recovering
+    fallback self-heals back to DEGRADED."""
+    calls = {"n": 0}
+
+    def flaky_fallback(X):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise OSError("fallback lib unloadable")
+        return _labels(5)
+
+    def err(p, X):
+        raise RuntimeError("device down")
+
+    clock = [100.0]
+    lad = _ladder(err, _Fallback(flaky_fallback),
+                  clock=lambda: clock[0])
+    try:
+        out = lad(None, X8)  # trip + fallback ok
+        np.testing.assert_array_equal(out, _labels(5))
+        assert lad.state == DEGRADED
+        out = lad(None, X8)  # fallback raises -> BROKEN + stale
+        assert lad.state == BROKEN
+        assert lad.render_stale
+        np.testing.assert_array_equal(out, _labels(5))  # last-known-good
+        out = lad(None, X8)  # fallback back -> DEGRADED, live again
+        assert lad.state == DEGRADED
+        assert not lad.render_stale
+        np.testing.assert_array_equal(out, _labels(5))
+    finally:
+        lad.close()
+
+
+def test_broken_with_no_fallback_serves_zeros_before_first_labels():
+    def err(p, X):
+        raise RuntimeError("device down")
+
+    lad = _ladder(err, None)
+    try:
+        out = lad(None, X8)
+        assert lad.state == BROKEN
+        assert lad.render_stale
+        np.testing.assert_array_equal(out, np.zeros(8, np.int32))
+    finally:
+        lad.close()
+
+
+def test_fallback_breaking_mid_probe_chain_is_recorded():
+    """A rung change while a promotion chain is active (public state
+    stays PROBING) must still surface: the transition event and the
+    status rung flip to BROKEN — the serve is rendering STALE labels
+    and hiding that edge would hide the alertable condition."""
+    from traffic_classifier_sdn_tpu.obs import FlightRecorder
+
+    clock = [0.0]
+    calls = {"n": 0}
+
+    def device(p, X):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("trip")
+        return _labels(3, int(X.shape[0]))
+
+    fb_calls = {"n": 0}
+
+    def flaky_fb(X):
+        fb_calls["n"] += 1
+        if fb_calls["n"] >= 3:
+            raise OSError("fallback died mid-chain")
+        return _labels(3, int(X.shape[0]))
+
+    rec = FlightRecorder()
+    lad = _ladder(device, _Fallback(flaky_fb), probe_every=0.5,
+                  probe_successes=3, clock=lambda: clock[0],
+                  recorder=rec)
+    try:
+        lad(None, X8)  # trip -> DEGRADED
+        clock[0] = lad._next_probe_at + 0.01
+        lad(None, X8)  # probe 1 clean -> PROBING chain active
+        assert lad.state == PROBING
+        clock[0] = lad._next_probe_at + 0.01
+        lad(None, X8)  # fallback raises mid-chain -> rung BROKEN
+        assert lad.status()["rung"] == BROKEN
+        assert lad.render_stale
+        events = [
+            (e.get("frm"), e.get("to"))
+            for e in rec.tail()
+            if e["kind"] == "degrade.transition"
+        ]
+        assert (DEGRADED, BROKEN) in events  # the mid-chain edge
+    finally:
+        lad.close()
+
+
+def test_wedged_feature_fetch_goes_broken_and_is_backoff_gated():
+    """Materializing X from a wedged device is itself a device sync:
+    the fetch runs under the watchdog, a wedge serves stale labels
+    (BROKEN) within one deadline, and re-fetch attempts follow the
+    probe schedule instead of stalling every tick."""
+    wedge = threading.Event()
+
+    class WedgedX:
+        shape = (8, 12)
+
+        def __getitem__(self, item):
+            return self
+
+        def __array__(self, dtype=None):
+            wedge.wait(timeout=30)
+            return np.zeros(self.shape, np.float32)
+
+    def err(p, X):
+        raise RuntimeError("device down")
+
+    clock = [0.0]
+    fb = _Fallback(lambda X: _labels(5, 8))
+    lad = _ladder(err, fb, deadline=0.1, first_deadline=0.1,
+                  probe_every=5.0, clock=lambda: clock[0])
+    try:
+        X = WedgedX()
+        t0 = time.monotonic()
+        out = lad(None, X)  # trip, then the fetch itself wedges
+        assert time.monotonic() - t0 < 0.5  # bounded by the deadlines
+        assert lad.status()["rung"] == BROKEN
+        assert lad.render_stale
+        np.testing.assert_array_equal(out, np.zeros(8, np.int32))
+        t0 = time.monotonic()
+        lad(None, X)  # re-fetch is gated on the probe schedule
+        assert time.monotonic() - t0 < 0.05
+    finally:
+        wedge.set()
+        lad.close()
+
+
+# ---------------------------------------------------------------------------
+# Probing, backoff math, promotion (satellite: injectable-clock tests)
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedDevice:
+    """Device predict whose per-call behavior is scripted: 'ok' returns
+    labels, 'err' raises — the clock-driven probe tests' seam."""
+
+    def __init__(self, script, labels_value=3):
+        self.script = list(script)
+        self.labels_value = labels_value
+        self.calls = 0
+
+    def __call__(self, p, X):
+        self.calls += 1
+        step = self.script.pop(0) if self.script else "ok"
+        if step == "err":
+            raise RuntimeError("still sick")
+        return np.full(int(X.shape[0]), self.labels_value, np.int32)
+
+
+def test_probe_backoff_schedule_exact_with_injected_clock_and_rng():
+    """Pin the exact jittered schedule (mirrors the SupervisedCollector
+    backoff tests): entering DEGRADED schedules the first probe ONE
+    base interval out with no jitter; failed probe n re-schedules after
+    ``uniform(0, min(cap, probe_every · 2^n))`` drawn from the seeded
+    rng; and a failed probe resets the consecutive-success counter."""
+    clock = [1000.0]
+    seed = 7
+    dev = _ScriptedDevice(["err", "err", "ok", "err"])
+    fb = _Fallback(lambda X: _labels(3))  # parity-compatible with dev
+    lad = _ladder(dev, fb, probe_every=0.5, probe_successes=2,
+                  backoff_cap=64.0, clock=lambda: clock[0],
+                  rng=random.Random(seed))
+    try:
+        lad(None, X8)  # 'err' -> DEGRADED; first probe due at +0.5
+        assert lad.state == DEGRADED
+        assert lad._next_probe_at == 1000.5
+
+        # replay the ladder's rng draws for the expected jitter values
+        expected_rng = random.Random(seed)
+
+        clock[0] = 1000.6
+        lad(None, X8)  # probe #1 runs: 'err' -> failed, level 1
+        d1 = expected_rng.uniform(0.0, min(64.0, 0.5 * 2.0))
+        assert lad._next_probe_at == pytest.approx(1000.6 + d1)
+        assert lad.state == DEGRADED
+        assert lad.status()["probe_successes"] == 0
+
+        clock[0] = lad._next_probe_at + 0.01
+        t_probe2 = clock[0]
+        lad(None, X8)  # probe #2: 'ok' -> chain 1/2, PROBING persists
+        assert lad.state == PROBING
+        assert lad.status()["probe_successes"] == 1
+        # clean-but-incomplete probes pace at the base interval, no
+        # jitter (nothing failed)
+        assert lad._next_probe_at == pytest.approx(t_probe2 + 0.5)
+
+        clock[0] = lad._next_probe_at + 0.01
+        lad(None, X8)  # probe #3: 'err' -> COUNTER RESET, level 2
+        assert lad.status()["probe_successes"] == 0
+        d2 = expected_rng.uniform(0.0, min(64.0, 0.5 * 4.0))
+        assert lad._next_probe_at == pytest.approx(clock[0] + d2)
+        assert lad.state == DEGRADED
+    finally:
+        lad.close()
+
+
+def test_promotion_after_n_consecutive_clean_probes():
+    clock = [0.0]
+    dev = _ScriptedDevice(["err"])  # one trip, then clean forever
+    fb = _Fallback(lambda X: _labels(3))
+    m = Metrics()
+    lad = _ladder(dev, fb, probe_every=0.5, probe_successes=3,
+                  clock=lambda: clock[0], metrics=m)
+    try:
+        lad(None, X8)  # trip
+        for _ in range(3):
+            clock[0] = lad._next_probe_at + 0.01
+            lad(None, X8)
+        assert lad.state == HEALTHY
+        assert m.gauges["degrade_state"] == 0
+        # healthy again: the device labels flow straight through
+        np.testing.assert_array_equal(lad(None, X8), _labels(3))
+    finally:
+        lad.close()
+
+
+def test_parity_mismatching_probe_counts_as_failed():
+    """The device answers in time but DISAGREES with the live fallback:
+    promoting would swap correct labels for wrong ones."""
+    clock = [0.0]
+    dev = _ScriptedDevice(["err"], labels_value=9)  # device says 9...
+    fb = _Fallback(lambda X: _labels(3))  # ...the live fallback says 3
+    m = Metrics()
+    lad = _ladder(dev, fb, probe_every=0.5, probe_successes=1,
+                  clock=lambda: clock[0], metrics=m)
+    try:
+        lad(None, X8)  # trip
+        for _ in range(3):
+            clock[0] = lad._next_probe_at + 0.01
+            lad(None, X8)
+        assert lad.state == DEGRADED  # never promoted
+        assert m.counters["probe_failures"] >= 3
+    finally:
+        lad.close()
+
+
+def test_probe_from_broken_needs_no_parity_reference():
+    """From BROKEN the 'active fallback' is last-known-good — there is
+    no live reference, so a clean in-deadline probe counts on its own
+    and the ladder can promote straight out of BROKEN."""
+    clock = [0.0]
+    dev = _ScriptedDevice(["err"])  # one trip, then clean
+    lad = _ladder(dev, None, probe_every=0.5, probe_successes=1,
+                  clock=lambda: clock[0])
+    try:
+        lad(None, X8)  # trip -> no fallback -> BROKEN
+        assert lad.state == BROKEN
+        clock[0] = lad._next_probe_at + 0.01
+        lad(None, X8)
+        assert lad.state == HEALTHY
+    finally:
+        lad.close()
+
+
+# ---------------------------------------------------------------------------
+# Fallback resolution per family
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_fallback_eager_cpu_families_match_canonical():
+    import jax.numpy as jnp
+
+    from traffic_classifier_sdn_tpu.models import (
+        MODEL_MODULES,
+        resolve_fallback,
+    )
+
+    rng = np.random.RandomState(0)
+    X = (rng.rand(32, 12) * 100).astype(np.float32)
+    cases = {}
+    cases["gnb"] = MODEL_MODULES["gnb"].from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (3, 12)),
+        "var": rng.gamma(2.0, 50.0, (3, 12)) + 1.0,
+        "class_prior": np.full(3, 1 / 3),
+    })
+    cases["logreg"] = MODEL_MODULES["logreg"].from_numpy({
+        "coef": rng.randn(3, 12), "intercept": rng.randn(3),
+    })
+    for name, params in cases.items():
+        fb = resolve_fallback(name, params)
+        assert fb is not None and fb.kind == "eager-cpu"
+        want = np.asarray(
+            MODEL_MODULES[name].predict(params, jnp.asarray(X))
+        )
+        np.testing.assert_array_equal(np.asarray(fb.predict(X)), want)
+
+
+def test_resolve_fallback_forest_prefers_native():
+    import jax.numpy as jnp
+
+    from traffic_classifier_sdn_tpu.models import resolve_fallback
+    from traffic_classifier_sdn_tpu.models import forest as forest_mod
+    from traffic_classifier_sdn_tpu.native import forest as native_forest
+    from traffic_classifier_sdn_tpu.train import forest as train_forest
+
+    rng = np.random.RandomState(1)
+    X = (rng.rand(64, 12) * 100).astype(np.float32)
+    y = rng.randint(0, 3, 64)
+    params = train_forest.fit(
+        jnp.asarray(X), jnp.asarray(y), 3, n_trees=4, max_depth=4
+    )
+    fb = resolve_fallback("forest", params)
+    assert fb is not None
+    if native_forest.available():
+        assert fb.kind == "native-forest"
+    else:
+        assert fb.kind == "eager-cpu"
+    want = np.asarray(forest_mod.predict(params, jnp.asarray(X)))
+    np.testing.assert_array_equal(np.asarray(fb.predict(X)), want)
+
+
+# ---------------------------------------------------------------------------
+# CLI: byte-identity, /healthz degraded, ladder flags
+# ---------------------------------------------------------------------------
+
+
+def _native_checkpoint(tmp_path):
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (2, 12)),
+        "var": rng.gamma(2.0, 50.0, (2, 12)) + 1.0,
+        "class_prior": np.full(2, 0.5),
+    })
+    path = str(tmp_path / "gnb_ckpt")
+    ck.save_model(path, "gnb", params, classes=("ping", "voice"))
+    return path
+
+
+def _serve(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), \
+            contextlib.redirect_stderr(io.StringIO()):
+        cli.main(argv)
+    return buf.getvalue()
+
+
+def _common(ckpt):
+    return [
+        "gaussiannb", "--native-checkpoint", ckpt,
+        "--source", "synthetic", "--synthetic-flows", "16",
+        "--capacity", "64", "--print-every", "2", "--max-ticks", "6",
+        "--idle-timeout", "0", "--table-rows", "8",
+    ]
+
+
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+def test_degrade_auto_no_fault_output_byte_identical(tmp_path, pipeline):
+    """The acceptance bar: with no faults, the ladder-wrapped serve
+    renders byte-identical stdout to the bare predict path — the
+    watchdog route changes WHERE the labels sync, never their values
+    or the rendered frame."""
+    common = _common(_native_checkpoint(tmp_path)) + [
+        "--pipeline", pipeline,
+    ]
+    off = _serve(common + ["--degrade", "off"])
+    auto = _serve(common + ["--degrade", "auto"])
+    assert "Flow ID" in off
+    assert auto == off
+
+
+def test_healthz_reports_200_but_degraded(tmp_path):
+    """While the ladder is on a fallback rung, /healthz stays 200 (the
+    serve answers every tick — restarting it into the same sick device
+    helps nobody) but carries the rung for alerting."""
+    from traffic_classifier_sdn_tpu.utils import faults
+
+    import socket
+
+    ckpt = _native_checkpoint(tmp_path)
+    result: dict = {}
+    with socket.socket() as s:  # a port 0 flag value means "disabled"
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    def grab_healthz():
+        # poll until the ladder has tripped (the first render tick) and
+        # /healthz reflects it; keep the last response either way
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2
+                ) as r:
+                    result["status"] = r.status
+                    result["body"] = json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                result["status"] = e.code
+                result["body"] = json.loads(e.read())
+            except OSError:
+                time.sleep(0.02)
+                continue
+            result["done"] = True
+            if result["body"].get("degraded"):
+                return
+            time.sleep(0.02)
+
+    t = threading.Thread(target=grab_healthz, daemon=True)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("degrade.dispatch_stall", times=None)], 0
+    )
+    with faults.installed(plan):
+        with contextlib.redirect_stdout(io.StringIO()), \
+                contextlib.redirect_stderr(io.StringIO()):
+            t.start()
+            cli.main(_common(ckpt) + [
+                "--degrade", "auto", "--obs-port", str(port),
+                "--max-ticks", "600", "--print-every", "2",
+                "--probe-every", "30",
+            ])
+    t.join(timeout=5)
+    assert result.get("done"), "healthz was never scraped"
+    assert result["status"] == 200  # 200-but-degraded
+    assert result["body"]["degraded"] is True
+    assert result["body"]["degrade"]["state"] in (DEGRADED, PROBING)
+
+
+def test_degrade_off_has_no_ladder_metrics(tmp_path):
+    _serve(_common(_native_checkpoint(tmp_path)) + ["--degrade", "off"])
+    assert "degrade_state" not in global_metrics.gauges

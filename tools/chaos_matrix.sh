@@ -42,6 +42,7 @@ GROUPS_KEYS=(
   "supervisor:spawn_failure"
   "native:native_load or native_checkpoint"
   "pipeline:pipeline_handoff or pipeline_coalesce"
+  "degrade:degrade_dispatch or degrade_probe"
 )
 
 fail=0

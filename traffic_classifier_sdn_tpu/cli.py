@@ -266,6 +266,36 @@ def _build_parser() -> argparse.ArgumentParser:
         "poll → parse → scatter → predict → render chain",
     )
     p.add_argument(
+        "--degrade", choices=("auto", "off"), default="auto",
+        help="degradation ladder (serving/degrade.py): wrap the device "
+        "predict in a watchdog and demote to a host fallback (native "
+        "C++ forest/KNN, eager-CPU jax otherwise) instead of wedging "
+        "when the device stalls or errors; a shadow-batch probe path "
+        "re-promotes after recovery. 'auto' enables it for device "
+        "kernels on the single-device serve (sharded and host-native "
+        "serves have no device rung to demote from); 'off' restores "
+        "the bare predict path",
+    )
+    p.add_argument(
+        "--device-deadline", type=float, default=2.0, metavar="SECS",
+        help="watchdog deadline per device-stage dispatch (default 2.0; "
+        "0 disables the deadline — erroring dispatches still demote, "
+        "wedged ones block). The first dispatch gets 10x (min 60 s): "
+        "it legitimately carries jit compile time",
+    )
+    p.add_argument(
+        "--probe-every", type=float, default=5.0, metavar="SECS",
+        help="base interval between recovery probes while degraded "
+        "(default 5.0); failed probes back off exponentially from this "
+        "base with full jitter",
+    )
+    p.add_argument(
+        "--probe-successes", type=int, default=3, metavar="N",
+        help="consecutive clean shadow-batch probes required to "
+        "re-promote the device kernel (default 3); any failed probe "
+        "resets the chain",
+    )
+    p.add_argument(
         "--warmup", action="store_true",
         help="AOT-compile the serving programs at startup "
         "(serving/warmup.py: donated scatter per batch bucket, feature "
@@ -460,6 +490,32 @@ def _run_classify(args) -> None:
     else:
         engine = FlowStateEngine(args.capacity, native=use_native)
 
+    # Degradation ladder (serving/degrade.py): wraps the device predict
+    # so a wedged/erroring dispatch demotes to a host fallback instead
+    # of taking the serve loop down. Built BEFORE warmup so warmup
+    # routes through it (the ladder is host_native → warmup also primes
+    # top_active_flags, the ranked-read program its serving path uses,
+    # and the first device call's compile consumes the ladder's
+    # first-call grace deadline, not a serving tick's budget). 'auto'
+    # skips the serves with no device rung to demote from: sharded
+    # (the sharded engine owns its predict dispatch) and already
+    # host-native kernels.
+    degrade = None
+    if (args.degrade != "off" and not sharded
+            and not getattr(predict, "host_native", False)):
+        from .models import resolve_fallback
+        from .serving.degrade import DegradeLadder
+
+        fallback = resolve_fallback(name, model.params)
+        degrade = DegradeLadder(
+            predict, fallback,
+            deadline=args.device_deadline,
+            probe_every=args.probe_every,
+            probe_successes=args.probe_successes,
+            metrics=m, recorder=recorder,
+        )
+        predict = degrade
+
     # persistent-cache wiring must precede warmup so its compiles land
     # on disk; it also helps un-warmed serves — lazy compiles persist,
     # and the NEXT restart (including a checkpoint-rollback restart)
@@ -495,6 +551,10 @@ def _run_classify(args) -> None:
                 args.obs_checkpoint_stale_after or None
             ),
         )
+        if degrade is not None:
+            # /healthz reports 200-but-degraded with the ladder rung —
+            # a degraded serve still answers every tick
+            health.set_degrade(degrade.status)
         server = ExpositionServer(
             m, recorder=recorder, health=health, port=args.obs_port,
             host=args.obs_host,
@@ -535,7 +595,7 @@ def _run_classify(args) -> None:
             _serve_loop(args, engine, model, predict, serve_params, m,
                         sharded, use_native, dropped_seen=0,
                         tracer=tracer, recorder=recorder, health=health,
-                        probe_out=probe_out)
+                        probe_out=probe_out, degrade=degrade)
     except BaseException as e:
         # the crash-forensics moment: record the terminal exception and
         # freeze the ring — safely outside any signal-handler frame.
@@ -568,6 +628,8 @@ def _run_classify(args) -> None:
     finally:
         if server is not None:
             server.stop()
+        if degrade is not None:
+            degrade.close()
         if sigterm_hooked:
             signal.signal(signal.SIGTERM, prev_sigterm)
         # the checkpoint must survive EVERY exit, including Ctrl-C on a
@@ -661,7 +723,7 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
 
 def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 use_native, dropped_seen, tracer, recorder=None,
-                health=None, probe_out=None) -> None:
+                health=None, probe_out=None, degrade=None) -> None:
     from .utils.profiling import trace
 
     # Pipelined serving (serving/pipeline.py): the host stage (this
@@ -766,6 +828,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 serve_params, m, tracer, pipe,
                                 feature_stage, sharded,
                                 evict_state=evict_state,
+                                degrade=degrade,
                             )
                         elif sharded:
                             # the sharded tick's whole read side
@@ -799,6 +862,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 _print_table(
                                     engine, model, predict,
                                     serve_params, args, tracer,
+                                    degrade=degrade,
                                 )
                     if (args.serve_checkpoint_every
                             and ticks % args.serve_checkpoint_every == 0):
@@ -829,7 +893,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
 
 def _dispatch_render(args, engine, model, predict, serve_params, m,
                      tracer, pipe, feature_stage, sharded,
-                     evict_state=None) -> None:
+                     evict_state=None, degrade=None) -> None:
     """Host-stage half of one pipelined render tick: dispatch the read
     side against THIS tick's table and stage the device-stage job.
     Output is byte-identical to the serial render of the same tick —
@@ -908,16 +972,31 @@ def _dispatch_render(args, engine, model, predict, serve_params, m,
         with tracer.span("stage.device"):
             with m.time("predict_s"), tracer.span("predict"):
                 rows = read.rows()
+            # the stale verdict must postdate the predict attempt: a
+            # ladder trip DURING rows() marks THIS tick's render
+            stale = degrade is not None and degrade.render_stale
             with tracer.span("render"):
                 if args.table_rows > 0:
-                    _print_ranked(engine, model, rows, read.n_flows)
+                    _print_ranked(engine, model, rows, read.n_flows,
+                                  stale=stale)
                 else:
-                    _print_full(model, rows)
+                    _print_full(model, rows, stale=stale)
 
     pipe.submit(job)
 
 
-def _print_full(model, rows) -> None:
+def _stale_fields(fields, rows, stale):
+    """Append the explicit ``Label State = STALE`` column when the
+    degrade ladder is serving last-known-good labels (BROKEN rung) —
+    the no-fault table stays byte-identical because the column only
+    exists while labels actually are stale."""
+    if not stale:
+        return fields, rows
+    return (tuple(fields) + ("Label State",),
+            [tuple(r) + ("STALE",) for r in rows])
+
+
+def _print_full(model, rows, stale=False) -> None:
     """Render the unbounded (``--table-rows 0``) table from a
     ``serving.pipeline.FullRead`` row list — the device-stage
     counterpart of ``_print_table``'s full branch."""
@@ -932,11 +1011,12 @@ def _print_full(model, rows) -> None:
         )
         for slot, src, dst, c, f, r in rows
     ]
-    print(render_table(CLASSIFIER_FIELDS, out), flush=True)
+    fields, out = _stale_fields(CLASSIFIER_FIELDS, out, stale)
+    print(render_table(fields, out), flush=True)
 
 
 def _print_table(engine, model, predict, serve_params, args,
-                 tracer) -> None:
+                 tracer, degrade=None) -> None:
     import jax
 
     from .utils.table import CLASSIFIER_FIELDS, render_table, status_str
@@ -949,8 +1029,12 @@ def _print_table(engine, model, predict, serve_params, args,
     with tracer.span("predict"):
         labels = predict(serve_params, X)  # stays device-resident
         # the dispatch is async; block here so the predict span carries
-        # the device compute instead of smearing it into render
+        # the device compute instead of smearing it into render (the
+        # degrade ladder returns host arrays — a no-op pass-through)
         jax.block_until_ready(labels)
+    # the stale verdict postdates the predict attempt: a ladder trip
+    # during THIS call marks this tick's render
+    stale = degrade is not None and degrade.render_stale
     # Classification is batched over the WHOLE table on device; the table
     # *render* samples at most --table-rows flows (the reference prints
     # everything because it tracks dozens, traffic_classifier.py:99-118 —
@@ -971,7 +1055,7 @@ def _print_table(engine, model, predict, serve_params, args,
         with tracer.span("render"):
             _print_ranked(
                 engine, model, engine.render_sample(labels, limit),
-                n_flows,
+                n_flows, stale=stale,
             )
         return
     with tracer.span("render"):
@@ -990,17 +1074,19 @@ def _print_table(engine, model, predict, serve_params, args,
                     status_str(bool(rev_active[slot])),
                 )
             )
-        print(render_table(CLASSIFIER_FIELDS, rows), flush=True)
+        fields, rows = _stale_fields(CLASSIFIER_FIELDS, rows, stale)
+        print(render_table(fields, rows), flush=True)
 
 
-def _print_ranked(engine, model, ranked, n_flows) -> None:
+def _print_ranked(engine, model, ranked, n_flows, stale=False) -> None:
     """Render activity-ranked ``(slot, label, fwd, rev)`` rows — the shared
     table surface for the single-device and mesh-sharded serve loops."""
     sample = engine.slot_metadata(slots=[s for s, *_ in ranked])
-    _print_ranked_resolved(model, ranked, sample, n_flows)
+    _print_ranked_resolved(model, ranked, sample, n_flows, stale=stale)
 
 
-def _print_ranked_resolved(model, ranked, sample, n_flows) -> None:
+def _print_ranked_resolved(model, ranked, sample, n_flows,
+                           stale=False) -> None:
     """``_print_ranked`` with the slot→(src, dst) sample already
     resolved — the pipelined sharded eviction path resolves it on the
     host stage (the lookup must precede any slot reuse)."""
@@ -1017,7 +1103,8 @@ def _print_ranked_resolved(model, ranked, sample, n_flows) -> None:
             names[c] if c < len(names) else "?",
             status_str(fa), status_str(ra),
         ))
-    print(render_table(CLASSIFIER_FIELDS, rows), flush=True)
+    fields, rows = _stale_fields(CLASSIFIER_FIELDS, rows, stale)
+    print(render_table(fields, rows), flush=True)
     if n_flows > len(rows):
         print(f"... showing {len(rows)} of {n_flows} tracked flows",
               flush=True)

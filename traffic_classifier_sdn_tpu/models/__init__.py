@@ -241,6 +241,95 @@ def jit_serving_fn(serve_fn: Callable) -> Callable:
     return jax.jit(serve_fn)
 
 
+@dataclass(frozen=True)
+class ServingFallback:
+    """A degraded-rung predict: ``predict(X) -> labels`` as a plain host
+    call (params baked in — the ladder has no second params slot), plus
+    the kind string the flight recorder / /healthz report."""
+
+    predict: Callable
+    kind: str
+
+
+def resolve_fallback(name: str, params) -> ServingFallback | None:
+    """The degradation ladder's per-family fallback (serving/degrade.py):
+    what still classifies when the device kernel is wedged or erroring.
+
+    - forest / knn → the host-native C++ evaluators
+      (native/forest_eval.cpp, native/knn_eval.cpp) under the same
+      ``host_native`` contract as the ``TCSDN_*=native`` serving
+      kernels — plain host calls, never jitted;
+    - everything else (gnb, logreg, svc, kmeans) — and forest/knn on
+      hosts whose C++ engine won't build — an eager-CPU jax predict
+      with params pre-staged on the CPU backend, so a sick accelerator
+      is never re-entered. SVC/KNN use their row-chunked forms (the
+      full (N, S) intermediate would blow host RAM at capacity 2²⁰).
+
+    The residual dependency is honest and documented
+    (docs/ROBUSTNESS.md): the feature matrix itself still comes from
+    the device flow table, so a TOTAL device loss (not the observed
+    mid-kernel wedge class) also stalls feature reads — that failure
+    needs the process-level ladder (checkpoint restore on a new host),
+    not this in-process one."""
+    import numpy as np
+
+    if name == "forest":
+        from ..native import forest as native_forest
+
+        if native_forest.available():
+            from ..core.features import NUM_FEATURES
+
+            node_arrays = {
+                k: np.asarray(getattr(params, k))
+                for k in ("left", "right", "feature", "threshold",
+                          "values")
+            }
+            nf = native_forest.NativeForest(
+                dict(node_arrays, n_features=NUM_FEATURES)
+            )
+            return ServingFallback(
+                lambda X: nf.predict(np.asarray(X, np.float32)),
+                "native-forest",
+            )
+    if name == "knn":
+        from ..native import knn as native_knn
+
+        if native_knn.available():
+            hk = native_knn.NativeKnn({
+                "fit_X": np.asarray(params.fit_X),
+                "y": np.asarray(params.fit_y),
+                "n_neighbors": params.n_neighbors,
+                "classes": np.arange(params.n_classes),
+            })
+            return ServingFallback(
+                lambda X: hk.predict(np.asarray(X, np.float32)),
+                "native-knn",
+            )
+
+    import jax
+    import jax.numpy as jnp_mod
+
+    mod = MODEL_MODULES[name]
+    cpu_devices = jax.devices("cpu")
+    if not cpu_devices:
+        return None
+    cpu = cpu_devices[0]
+    cpu_params = jax.device_put(params, cpu)
+    chunked = getattr(mod, "predict_chunked", None)
+
+    def eager_cpu(X):
+        # np.asarray first: a device array operand must cross to host
+        # HERE (one sync against the feature producer), not be consumed
+        # by a CPU-placed computation that would keep a handle into the
+        # sick backend
+        with jax.default_device(cpu):
+            Xc = jnp_mod.asarray(np.asarray(X), jnp_mod.float32)
+            fn = chunked if chunked is not None else mod.predict
+            return np.asarray(fn(cpu_params, Xc))
+
+    return ServingFallback(eager_cpu, "eager-cpu")
+
+
 def make_loaded_model(name: str, params, classes) -> LoadedModel:
     """Assemble a LoadedModel — shared by the sklearn-pickle importer and
     the native checkpoint loader (io/checkpoint.load_model)."""

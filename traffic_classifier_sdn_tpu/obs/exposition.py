@@ -120,6 +120,17 @@ class HealthState:
         self._last_checkpoint_at: float | None = None
         self._ticks = 0
         self._probe = None
+        self._degrade = None
+
+    def set_degrade(self, status_fn) -> None:
+        """``status_fn() -> dict`` (serving/degrade.DegradeLadder.status):
+        the degradation ladder's self-report. Folded into /healthz as
+        200-but-degraded — a degraded serve still produces every tick,
+        so it must NOT probe-fail and get restarted into the same sick
+        device; the ``degraded`` flag plus the ladder rung tell the
+        operator (and the alerting rule) what actually needs attention."""
+        with self._lock:
+            self._degrade = status_fn
 
     def set_collector_probe(self, probe) -> None:
         """``probe() -> bool | None`` (None = no collector, e.g. replay
@@ -144,6 +155,7 @@ class HealthState:
             last_ckpt = self._last_checkpoint_at
             ticks = self._ticks
             probe = self._probe
+            degrade = self._degrade
             started = self._started_at
         tick_age = now - (last_tick if last_tick is not None else started)
         stale = tick_age > self.max_tick_age_s
@@ -186,6 +198,16 @@ class HealthState:
         }
         if probe_error is not None:
             report["collector_probe_error"] = probe_error
+        if degrade is not None:
+            try:
+                dstatus = degrade()
+            except Exception as e:  # noqa: BLE001 — health must not crash
+                dstatus = {"state": "unknown", "error": str(e)}
+            report["degrade"] = dstatus
+            # 200-but-degraded: the serve still answers every tick, so
+            # it stays "healthy" for the restart-probe — the rung is
+            # the alerting signal, not a reason to kill the process
+            report["degraded"] = dstatus.get("state") != "HEALTHY"
         return healthy, report
 
 

@@ -19,7 +19,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..models import forest
 from ..ops import tree_eval
-from .mesh import STATE_AXIS
+from .mesh import STATE_AXIS, shard_map
 
 
 def pad_trees(d: dict, n_shards: int) -> dict:
@@ -64,7 +64,7 @@ def sharded_predict(mesh, params: forest.Params, n_real_trees: int | None = None
         total = lax.psum(local_sum, STATE_AXIS)
         return jnp.argmax(total / n_real, axis=-1).astype(jnp.int32)
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(
@@ -134,7 +134,7 @@ def gemm_sharded_predict(
         total = lax.psum(local_sum, STATE_AXIS)
         return jnp.argmax(total, axis=-1).astype(jnp.int32)
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_gemm,
         mesh=mesh,
         in_specs=(

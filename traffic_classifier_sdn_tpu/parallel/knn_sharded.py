@@ -20,7 +20,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..models import knn
-from .mesh import STATE_AXIS
+from .mesh import STATE_AXIS, axis_size, shard_map
 
 
 def pad_corpus(d: dict, n_shards: int) -> dict:
@@ -121,7 +121,7 @@ def _build(mesh, params: knn.Params, pad_mask, local_fn):
         P(STATE_AXIS),  # half_sq_norms (+inf at padding)
         P(),  # X replicated
     )
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=in_specs,
@@ -240,7 +240,7 @@ def _ring_merge(held, k: int, packable: bool):
     software-pipelined (merge the previous hop's block while the next
     transfer flies). One home for the loop — the XLA local stage
     (``ring_predict``) and the fused local stage share it."""
-    n_dev = lax.axis_size(STATE_AXIS)
+    n_dev = axis_size(STATE_AXIS)
     if n_dev == 1:
         return held
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
@@ -301,7 +301,7 @@ def ring_predict(mesh, params: knn.Params, pad_mask=None):
 
     def local_ring(fit_X, fit_y, half_norms, X):
         val, lab, gidx = _local_topk(fit_X, fit_y, half_norms, X, k)
-        if lax.axis_size(STATE_AXIS) == 1:
+        if axis_size(STATE_AXIS) == 1:
             return _vote(lab, n_classes)
         held = _make_held(val, lab, gidx, n_classes, packable)
         final = _ring_merge(held, k, packable)
@@ -380,7 +380,7 @@ def fused_predict(
         lab = fity_l[idx].astype(jnp.int32)
         if merge == "all_gather":
             return _gather_merge_vote(val, lab, k, n_classes)
-        if lax.axis_size(STATE_AXIS) == 1:
+        if axis_size(STATE_AXIS) == 1:
             return _vote(lab, n_classes)
         me = lax.axis_index(STATE_AXIS)
         gidx = (idx + me * per).astype(jnp.int32)
@@ -391,7 +391,7 @@ def fused_predict(
             held = _tournament_merge(held, k, packable, D)
         return _vote(_held_labels(held, n_classes, packable), n_classes)
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_fused,
         mesh=mesh,
         in_specs=(

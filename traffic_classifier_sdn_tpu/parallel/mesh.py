@@ -25,6 +25,60 @@ DATA_AXIS = "data"
 STATE_AXIS = "state"
 
 
+# -- jax version compat ------------------------------------------------------
+# ``shard_map`` reached the top-level jax namespace (with ``check_vma``)
+# only in newer jax; the 0.4.x line in this image ships it as
+# ``jax.experimental.shard_map.shard_map`` with the older ``check_rep``
+# spelling of the same knob. One resolver here so every sharded module
+# (table/knn/forest/svc_sharded, train/distributed) runs on both.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        """``jax.shard_map``-compatible wrapper over the experimental
+        module: ``check_vma`` (new name) maps onto ``check_rep``."""
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map_exp(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+
+def donate_argnums_if_safe(*argnums: int) -> dict:
+    """``{"donate_argnums": argnums}`` when buffer donation through
+    shard_map is trustworthy on this jax, ``{}`` otherwise.
+
+    On the 0.4.x line (the experimental-shard_map fallback above),
+    donating a shard_map operand intermittently corrupts the process
+    heap — glibc ``corrupted double-linked list`` aborts once allocator
+    state is complex enough, reproduced under the full test suite and
+    gone with donation disabled; single-run tests pass either way,
+    which is exactly what a double-free looks like. The jax line that
+    ships ``jax.shard_map`` natively donates fine. Callers splat this
+    into ``jax.jit`` so the old-jax path trades the in-place HBM
+    update for a heap that stays intact."""
+    if hasattr(jax, "shard_map"):
+        return {"donate_argnums": argnums}
+    return {}
+
+
+def axis_size(name: str) -> int:
+    """Static size of mesh axis ``name`` inside a shard_map body.
+
+    Newer jax spells this ``jax.lax.axis_size``; on the 0.4.x line the
+    axis environment's frame lookup returns the same static int — both
+    are trace-time constants, so ``if axis_size(...) == 1`` branches
+    stay Python-level."""
+    if hasattr(jax.lax, "axis_size"):
+        return int(jax.lax.axis_size(name))
+    import jax.core as _core
+
+    return int(_core.axis_frame(name))
+
+
 def make_mesh(
     n_data: int | None = None, n_state: int = 1, devices=None
 ) -> Mesh:

@@ -24,7 +24,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..models import svc
-from .mesh import STATE_AXIS
+from .mesh import STATE_AXIS, shard_map
 
 _HI = lax.Precision.HIGHEST
 
@@ -87,7 +87,7 @@ def sharded_predict(mesh, params: svc.Params, precise: bool = False):
         D = lax.psum(part, STATE_AXIS) + intercept[None, :]
         return _ovo_vote_argmax(D, vote_i, vote_j, n_classes)
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_decision,
         mesh=mesh,
         in_specs=in_specs,
@@ -155,7 +155,7 @@ def fused_predict(
         Dv = lax.psum(part, STATE_AXIS) + intercept[None, :]
         return _ovo_vote_argmax(Dv, vote_i, vote_j, n_classes)
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_fused,
         mesh=mesh,
         in_specs=(

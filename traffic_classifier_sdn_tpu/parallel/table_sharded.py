@@ -33,7 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import flow_table as ft
 from ..ingest.batcher import DEFAULT_BUCKETS, HostSpine, bucket_size
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, donate_argnums_if_safe, shard_map
 
 
 def _n_shards(mesh) -> int:
@@ -64,14 +64,14 @@ def make_apply(mesh):
     router pads every shard's sub-batch to one common bucket size (jit
     compiles one variant per width)."""
 
-    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(jax.jit, **donate_argnums_if_safe(0))
     def apply(tables, wire):
         def local(t, w):
             t1 = jax.tree.map(lambda a: a[0], t)
             out = ft.apply_wire(t1, w[0])
             return jax.tree.map(lambda a: a[None], out)
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=P(DATA_AXIS),
@@ -107,7 +107,7 @@ def make_tick_outputs(mesh, predict_fn, n_rows: int):
         )
         # check_vma off: the varying-axis checker cannot see that an
         # all_gather over the only mesh axis leaves every output replicated
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS)),
@@ -122,14 +122,14 @@ def make_clear(mesh):
     """jit'd (tables, slots) → tables: per-shard ``clear_slots``; ``slots``
     is (n_shards, E) LOCAL slot ids padded with local_capacity."""
 
-    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(jax.jit, **donate_argnums_if_safe(0))
     def clear(tables, slots):
         def local(t, s):
             t1 = jax.tree.map(lambda a: a[0], t)
             out = ft.clear_slots(t1, s[0])
             return jax.tree.map(lambda a: a[None], out)
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=P(DATA_AXIS),

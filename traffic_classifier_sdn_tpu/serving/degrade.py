@@ -1,0 +1,590 @@
+"""Serve through device failure: watchdog, degradation ladder, and
+self-healing kernel promotion.
+
+The failure this subsystem exists for is the r04 chip-day wedge
+(docs/artifacts/tpu_outage_r04.log): a device dispatch hung mid-kernel
+and the serve process sat dead for 11 hours, because the predict path
+had exactly one rung — the device kernel — and a wedged XLA dispatch
+blocks its calling thread forever. ``tools/tpu_day.sh`` mitigates that
+only *outside* the process; this module is the in-process answer. A
+tick is ALWAYS produced within budget, on the best rung currently
+working:
+
+    HEALTHY ──deadline/error──► DEGRADED ──fallback error──► BROKEN
+       ▲                            │                           │
+       │                            └────────── probe due ──────┘
+       └──── N consecutive clean probes ──── PROBING ◄──────────┘
+                                            (probe failed: back to the
+                                             prior rung, backoff grows)
+
+- **HEALTHY** — the device-kernel predict, dispatched through a
+  ``DeviceWatchdog``: a guarded worker thread with a wall-clock
+  deadline (CLI ``--device-deadline``, measured on the same
+  ``time.perf_counter``-family monotonic clock the ``stage.device``
+  span uses). A dispatch that exceeds the deadline is ABANDONED — the
+  wedged thread keeps blocking, its eventual result is discarded, and
+  the ladder demotes — so a wedged chip costs one deadline, not the
+  process. The first device call gets a grace deadline
+  (``first_deadline``, default max(60 s, 10×deadline)) because it
+  legitimately carries jit compile time.
+- **DEGRADED** — the per-family host fallback resolved by
+  ``models.resolve_fallback``: the host-native C++ evaluators for
+  forest/KNN (``native/forest_eval.cpp`` / ``native/knn_eval.cpp``,
+  the same ``host_native`` contract the serving kernels use), an
+  eager-CPU jax predict pinned to the CPU backend for everything else
+  (GNB, logreg, SVC, k-means — and forest/KNN on hosts without g++).
+- **BROKEN** — the fallback itself failed (or none resolves): the
+  last-known-good label vector is served, and the rendered table
+  carries an explicit ``Label State = STALE`` column so nobody
+  mistakes a frozen classification for a live one. The fallback is
+  re-tried every tick, so a transient fallback failure self-heals to
+  DEGRADED.
+- **PROBING** — recovery: once a probe is due, the device path is
+  re-run on a shadow batch AFTER the tick's fallback labels are
+  computed, and its labels are compared against the active fallback's
+  for parity (from BROKEN there is no live reference, so a clean
+  in-deadline probe counts on its own). The probe runs synchronously
+  on the predict thread, so a probing tick against a still-wedged
+  device costs at most fallback + one deadline — i.e. the tick stays
+  within the documented 2×-deadline budget, and probes are
+  backoff-gated so the cost cannot recur every tick. The shadow batch
+  defaults to the FULL feature matrix (``probe_rows=0``): probing the
+  exact serving shape reuses the already-compiled device program, so a
+  recovered device can never trip its first probe on a fresh
+  shadow-shape compile. Re-promotion to HEALTHY needs
+  ``probe_successes`` CONSECUTIVE clean probes; any failed probe
+  resets the chain and re-enters exponential backoff with full jitter
+  (``uniform(0, min(cap, probe_every · 2^level))`` — the
+  SupervisedCollector ladder's shape, with jitter because a fleet of
+  serving processes must not re-probe a recovering chip in lockstep).
+
+The ladder object IS the serving predict callable: it is marked
+``host_native`` (a plain host call — callers must never jit or
+shard_map it; see models.jit_serving_fn), so both the serial and the
+pipelined serve loops route it through their existing host-call
+branches and the watchdog/fallback work lands on the pipeline's
+device-stage worker, overlapped with host ingest. On the no-fault path
+it returns exactly ``np.asarray(device_predict(params, X))`` — the
+same values the un-wrapped kernel produces, which is what keeps
+``--degrade auto`` byte-identical to ``--degrade off``
+(tests/test_degrade.py pins it).
+
+Chaos: ``degrade.dispatch_stall`` (simulated wedge → deadline trip),
+``degrade.dispatch_error`` (simulated XLA error → error trip) and
+``degrade.probe`` (failed recovery probe) are registered fault sites —
+unlike the durability sites, the first two are ABSORBED by the ladder
+(that is the guarantee under test), never propagated. Every transition
+is recorded in the flight recorder (``degrade.transition`` /
+``degrade.probe`` events), gauged in ``/metrics`` (``degrade_state``,
+``degrade_transitions``, ``probe_failures``) and reported by
+``/healthz`` as 200-but-degraded with the current rung.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import threading
+import time
+
+import numpy as np
+
+from ..utils import faults
+
+HEALTHY = "HEALTHY"
+DEGRADED = "DEGRADED"
+BROKEN = "BROKEN"
+PROBING = "PROBING"
+
+# the degrade_state gauge encoding (docs/OBSERVABILITY.md)
+STATE_GAUGE = {HEALTHY: 0, DEGRADED: 1, BROKEN: 2, PROBING: 3}
+
+
+class DeadlineExceeded(RuntimeError):
+    """A device-stage dispatch ran past its watchdog deadline."""
+
+
+class DeviceWatchdog:
+    """Deadline-guarded executor for device-stage dispatches.
+
+    One worker thread runs submitted calls; ``call(fn, deadline)``
+    waits at most ``deadline`` seconds for the result. On expiry the
+    call — and the worker, which may be wedged inside an XLA dispatch
+    that will never return — is ABANDONED: the next ``call`` spawns a
+    fresh worker, and the abandoned thread discards its late result
+    (if any ever comes) and exits. Abandoned threads are bounded by
+    trip count, and trips are backoff-gated by the ladder, so a
+    permanently wedged device leaks a handful of parked threads, not
+    an unbounded pile.
+
+    Single-consumer contract: ``call`` is invoked from one thread at a
+    time (the serve loop's predict path — the pipeline's device-stage
+    worker or the serial loop's main thread).
+    """
+
+    def __init__(self, name: str = "tcsdn-device-watchdog"):
+        self._name = name
+        self._lock = threading.Condition()
+        self._worker: threading.Thread | None = None
+        self._job: tuple[int, object] | None = None
+        self._results: dict[int, tuple[str, object]] = {}
+        self._seq = 0
+        self._abandoned = 0
+        self._closed = False
+
+    def call(self, fn, deadline: float | None = None):
+        """Run ``fn()`` on the worker; raise ``DeadlineExceeded`` if no
+        result lands within ``deadline`` seconds (None = wait forever).
+        ``fn``'s own exception re-raises here unchanged."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("watchdog is closed")
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._run, name=self._name, daemon=True
+                )
+                self._worker.start()
+            self._seq += 1
+            seq = self._seq
+            self._job = (seq, fn)
+            self._lock.notify_all()
+            t_end = (
+                None if deadline is None
+                else time.monotonic() + deadline
+            )
+            while seq not in self._results:
+                left = (
+                    None if t_end is None
+                    else t_end - time.monotonic()
+                )
+                if left is not None and left <= 0:
+                    break
+                self._lock.wait(left)
+            if seq not in self._results:
+                # expired: abandon the (possibly wedged) worker — a new
+                # one is spawned on the next call; if the job was never
+                # even picked up, retract it
+                self._abandoned += 1
+                self._worker = None
+                if self._job is not None and self._job[0] == seq:
+                    self._job = None
+                self._lock.notify_all()
+                raise DeadlineExceeded(
+                    f"device dispatch exceeded its {deadline:.3f}s "
+                    f"watchdog deadline"
+                )
+            kind, value = self._results.pop(seq)
+        if kind == "err":
+            raise value  # type: ignore[misc]
+        return value
+
+    @property
+    def abandoned(self) -> int:
+        """Dispatches abandoned at their deadline (lifetime)."""
+        with self._lock:
+            return self._abandoned
+
+    def close(self, timeout: float = 2.0) -> None:
+        """Stop the current worker (abandoned ones die on their own)."""
+        with self._lock:
+            self._closed = True
+            worker = self._worker
+            self._worker = None
+            self._lock.notify_all()
+        if worker is not None and worker.is_alive():
+            worker.join(timeout)
+
+    def _run(self) -> None:
+        me = threading.current_thread()
+        while True:
+            with self._lock:
+                while (
+                    self._worker is me and self._job is None
+                    and not self._closed
+                ):
+                    self._lock.wait()
+                if self._worker is not me or self._closed:
+                    return
+                seq, fn = self._job
+                self._job = None
+            try:
+                result = ("ok", fn())  # type: ignore[operator]
+            except BaseException as e:  # noqa: BLE001 — re-raised in call()
+                result = ("err", e)
+            with self._lock:
+                if self._worker is not me:
+                    return  # abandoned mid-call: discard the late result
+                self._results[seq] = result
+                self._lock.notify_all()
+
+
+class DegradeLadder:
+    """The health-state machine wrapped around the serving predict path.
+
+    Callable with the serving ``(params, X) -> labels`` signature and
+    marked ``host_native`` so the existing serve-loop branches route it
+    as a plain host call (see module docstring for the ladder itself).
+
+    ``clock`` (monotonic seconds) and ``rng`` (a ``random.Random``) are
+    injectable so tests pin the exact jittered backoff schedule without
+    sleeping; the watchdog deadline itself is real wall-clock (a wedge
+    is a real-time phenomenon).
+    """
+
+    host_native = True  # contract: never jit/shard_map this callable
+
+    def __init__(self, device_predict, fallback=None, *,
+                 deadline: float = 2.0,
+                 first_deadline: float | None = None,
+                 probe_every: float = 5.0,
+                 probe_successes: int = 3,
+                 probe_rows: int = 0,
+                 backoff_cap: float = 300.0,
+                 metrics=None, recorder=None,
+                 clock=time.monotonic,
+                 rng: random.Random | None = None,
+                 watchdog: DeviceWatchdog | None = None):
+        self._device_predict = device_predict
+        self._fallback = fallback
+        self.deadline = float(deadline)
+        if first_deadline is None:
+            # the first device call legitimately carries jit compile
+            # time (seconds at 2²⁰ rows) — tripping on it would demote
+            # every cold start
+            first_deadline = (
+                max(60.0, 10.0 * self.deadline)
+                if self.deadline > 0 else 0.0
+            )
+        self.first_deadline = float(first_deadline)
+        self.probe_every = float(probe_every)
+        self.probe_successes = int(probe_successes)
+        self.probe_rows = int(probe_rows)
+        self.backoff_cap = float(backoff_cap)
+        self._metrics = metrics
+        self._recorder = recorder
+        self._clock = clock
+        self._rng = rng if rng is not None else random.Random()
+        self._watchdog = (
+            watchdog if watchdog is not None else DeviceWatchdog()
+        )
+        self._lock = threading.Lock()
+        self._rung = HEALTHY
+        self._probing = False
+        self._device_tried = False  # first-ATTEMPT grace consumed
+        self._fetch_wedged = False  # last host feature fetch timed out
+        self._probe_ok = 0  # consecutive clean probes
+        self._backoff_level = 0
+        self._next_probe_at = 0.0
+        self._last_labels: np.ndarray | None = None
+        self._last_stale = False
+        if metrics is not None:
+            metrics.set("degrade_state", STATE_GAUGE[HEALTHY])
+
+    # -- public surface ----------------------------------------------------
+    @property
+    def state(self) -> str:
+        """PROBING means a promotion CHAIN is in progress (first probe
+        ran clean, more are scheduled) — not that a probe is executing
+        this instant; between chain probes the serve runs on the prior
+        rung. A failed probe drops back to that rung (recorded), so
+        watch ``degrade.probe`` events — emitted per probe with
+        ``ok``/``successes`` — for the fine-grained trajectory."""
+        with self._lock:
+            return PROBING if self._probing else self._rung
+
+    @property
+    def render_stale(self) -> bool:
+        """True when the labels most recently served are last-known-good
+        (the BROKEN rung) — the render adds the STALE column."""
+        with self._lock:
+            return self._last_stale
+
+    def status(self) -> dict:
+        """The /healthz self-report (obs.HealthState.set_degrade)."""
+        with self._lock:
+            state = PROBING if self._probing else self._rung
+            return {
+                "state": state,
+                "rung": self._rung,
+                "gauge": STATE_GAUGE[state],
+                "probe_successes": self._probe_ok,
+                "backoff_level": self._backoff_level,
+                "fallback": (
+                    self._fallback.kind
+                    if self._fallback is not None else None
+                ),
+                "watchdog_abandoned": self._watchdog.abandoned,
+            }
+
+    def close(self) -> None:
+        self._watchdog.close()
+
+    def __call__(self, params, X):
+        if self.state == HEALTHY:
+            try:
+                labels = self._device_call(params, X)
+            except DeadlineExceeded:
+                self._trip("deadline")
+            except Exception as e:  # noqa: BLE001 — XLA runtime / injected
+                self._trip(f"error:{type(e).__name__}")
+            else:
+                self._remember(labels, stale=False)
+                return labels
+        # Degraded rungs work on HOST features — but materializing X is
+        # itself a device sync that can queue behind the wedged kernel,
+        # so the fetch runs under the watchdog too. A wedged fetch goes
+        # BROKEN (stale labels need no X) and is retried on the probe
+        # schedule, not every tick, so a fully wedged device costs one
+        # deadline per backoff window, not per tick.
+        now = self._clock()
+        with self._lock:
+            skip_fetch = self._fetch_wedged and now < self._next_probe_at
+        X_host = None if skip_fetch else self._fetch_host(X)
+        if X_host is None:
+            if not skip_fetch:
+                with self._lock:
+                    self._fetch_wedged = True
+                    if self._rung != BROKEN:
+                        self._set_locked(
+                            rung=BROKEN, reason="feature-fetch-failed"
+                        )
+                    else:
+                        self._probe_failed_locked("feature-fetch-failed")
+            return self._stale_labels(int(X.shape[0]))
+        with self._lock:
+            self._fetch_wedged = False
+        labels, stale = self._fallback_labels(X_host)
+        self._maybe_probe(params, X_host, None if stale else labels)
+        return labels
+
+    def _fetch_host(self, X) -> np.ndarray | None:
+        """X as a host array, deadline-guarded; None on wedge/error."""
+        if isinstance(X, np.ndarray):
+            return X
+        if self.deadline > 0:
+            try:
+                return self._watchdog.call(
+                    lambda: np.asarray(X), self.deadline
+                )
+            except DeadlineExceeded:
+                return None
+            except Exception:  # noqa: BLE001 — a sick device throws wide
+                return None
+        try:
+            return np.asarray(X)
+        except Exception:  # noqa: BLE001
+            return None
+
+    # -- device path -------------------------------------------------------
+    def _device_call(self, params, X) -> np.ndarray:
+        try:
+            faults.fault_point("degrade.dispatch_stall")
+        except faults.FaultInjected as e:
+            # chaos cannot deterministically wedge a thread, so the
+            # site converts into exactly what the watchdog reports at
+            # the deadline — the stall edge, minus the wall-clock wait
+            raise DeadlineExceeded(
+                "injected dispatch stall (degrade.dispatch_stall)"
+            ) from e
+
+        def run():
+            faults.fault_point("degrade.dispatch_error")
+            return np.asarray(self._device_predict(params, X))
+
+        # the grace deadline covers the first ATTEMPT only (that is
+        # where the jit compile lives); a device wedged from boot must
+        # not re-pay 60 s on every probe — once any dispatch has been
+        # tried, compile time is either paid or moot, and probes cost
+        # one ordinary deadline
+        with self._lock:
+            first = not self._device_tried
+            self._device_tried = True
+        deadline = self.first_deadline if first else self.deadline
+        if deadline > 0:
+            out = self._watchdog.call(run, deadline)
+        else:
+            out = run()  # deadline 0: error-only detection, no watchdog
+        return out
+
+    def _trip(self, reason: str) -> None:
+        with self._lock:
+            self._set_locked(rung=DEGRADED, reason=reason)
+
+    # -- fallback / stale rungs --------------------------------------------
+    def _fallback_labels(self, X) -> tuple[np.ndarray, bool]:
+        """(labels, stale): the fallback's labels, or last-known-good.
+        The fallback is re-tried even from BROKEN, so a transient
+        fallback failure self-heals back to DEGRADED."""
+        fb = self._fallback
+        if fb is not None:
+            try:
+                labels = np.asarray(fb.predict(X))
+            except Exception as e:  # noqa: BLE001 — any rung may break
+                with self._lock:
+                    if self._rung != BROKEN:
+                        self._set_locked(
+                            rung=BROKEN,
+                            reason=f"fallback-error:{type(e).__name__}",
+                        )
+            else:
+                with self._lock:
+                    if self._rung == BROKEN:
+                        self._set_locked(
+                            rung=DEGRADED, reason="fallback-recovered"
+                        )
+                self._remember(labels, stale=False)
+                return labels, False
+        else:
+            with self._lock:
+                if self._rung != BROKEN:
+                    self._set_locked(rung=BROKEN, reason="no-fallback")
+        return self._stale_labels(int(X.shape[0])), True
+
+    def _stale_labels(self, n: int) -> np.ndarray:
+        """Last-known-good labels sized to ``n`` rows (zeros before the
+        first good predict); marks the render STALE."""
+        with self._lock:
+            cached = self._last_labels
+            self._last_stale = True
+        if cached is None:
+            return np.zeros(n, np.int32)
+        if cached.shape[0] >= n:
+            return cached[:n]
+        out = np.zeros(n, np.int32)
+        out[: cached.shape[0]] = cached
+        return out
+
+    def _remember(self, labels, stale: bool) -> None:
+        arr = np.asarray(labels)
+        with self._lock:
+            self._last_labels = arr
+            self._last_stale = stale
+
+    # -- probing / promotion -----------------------------------------------
+    def _maybe_probe(self, params, X, parity_labels) -> None:
+        now = self._clock()
+        with self._lock:
+            if self._rung == HEALTHY:
+                return
+            if now < self._next_probe_at:
+                return
+            self._set_locked(probing=True, reason="probe-due")
+        ok, detail = self._run_probe(params, X, parity_labels)
+        with self._lock:
+            now = self._clock()
+            if ok:
+                self._probe_ok += 1
+                if self._probe_ok >= self.probe_successes:
+                    self._backoff_level = 0
+                    self._probe_ok = 0
+                    self._set_locked(
+                        rung=HEALTHY, probing=False,
+                        reason="promoted",
+                    )
+                    delay = None
+                else:
+                    # clean but chain incomplete: keep probing at the
+                    # base cadence (no jitter — nothing failed)
+                    delay = self.probe_every
+                    self._next_probe_at = now + delay
+            else:
+                delay = self._probe_failed_locked(detail)
+                self._set_locked(
+                    probing=False, reason=f"probe-failed:{detail}"
+                )
+            successes = self._probe_ok
+        if self._recorder is not None:
+            self._recorder.record(
+                "degrade.probe", ok=ok, detail=detail,
+                successes=successes, next_delay_s=delay,
+            )
+
+    def _probe_failed_locked(self, detail: str) -> float:
+        """Failed-probe bookkeeping (callers hold ``self._lock``):
+        reset the success chain, count the failure, and return the
+        full-jitter exponential delay applied to ``_next_probe_at``."""
+        if self._metrics is not None:
+            self._metrics.inc("probe_failures")
+        self._probe_ok = 0
+        self._backoff_level += 1
+        window = min(
+            self.backoff_cap,
+            self.probe_every * (2.0 ** self._backoff_level),
+        )
+        # full jitter: uniform over the whole window so a fleet of
+        # recovering serves cannot re-probe in lockstep
+        delay = self._rng.uniform(0.0, window)
+        self._next_probe_at = self._clock() + delay
+        return delay
+
+    def _run_probe(self, params, X, parity_labels) -> tuple[bool, str]:
+        """One shadow-batch device probe; (clean, detail).
+
+        ``probe_rows <= 0`` (the default) probes the FULL feature
+        matrix: the exact serving shape, so the probe reuses the
+        already-compiled device program and a recovered device cannot
+        trip its first probe on a fresh shadow-shape compile."""
+        try:
+            faults.fault_point("degrade.probe")
+            if self.probe_rows > 0:
+                n = min(self.probe_rows, int(X.shape[0]))
+                got = self._device_call(params, X[:n])
+            else:
+                got = self._device_call(params, X)
+        except faults.FaultInjected:
+            return False, "injected"
+        except DeadlineExceeded:
+            return False, "deadline"
+        except Exception as e:  # noqa: BLE001 — a sick device throws wide
+            return False, f"error:{type(e).__name__}"
+        if parity_labels is not None:
+            want = np.asarray(parity_labels)[: got.shape[0]]
+            if got.shape[0] != want.shape[0] or not np.array_equal(
+                got, want
+            ):
+                # the device answers in time but DISAGREES with the
+                # live fallback — promoting would swap correct labels
+                # for wrong ones; count it as a failed probe
+                return False, "parity-mismatch"
+        return True, "clean"
+
+    # -- bookkeeping (callers hold self._lock) ------------------------------
+    def _set_locked(self, rung: str | None = None,
+                    probing: bool | None = None,
+                    reason: str = "") -> None:
+        old = PROBING if self._probing else self._rung
+        old_rung = self._rung
+        if rung is not None:
+            self._rung = rung
+            if rung != HEALTHY and old == HEALTHY:
+                # entering the ladder: first probe after one base
+                # interval, fresh success chain
+                self._probe_ok = 0
+                self._backoff_level = 0
+                self._next_probe_at = self._clock() + self.probe_every
+        if probing is not None:
+            self._probing = probing
+        new = PROBING if self._probing else self._rung
+        if new == old:
+            # a RUNG change under an active promotion chain (public
+            # state stays PROBING) must still be visible: a fallback
+            # that breaks mid-chain flips the serve to STALE labels,
+            # and swallowing that edge would hide exactly the
+            # condition operators alert on
+            if self._rung == old_rung:
+                return
+            old, new = old_rung, self._rung
+        if self._metrics is not None:
+            self._metrics.inc("degrade_transitions")
+            self._metrics.set(
+                "degrade_state",
+                STATE_GAUGE[PROBING if self._probing else self._rung],
+            )
+        if self._recorder is not None:
+            self._recorder.record(
+                "degrade.transition", frm=old, to=new, reason=reason
+            )
+        print(
+            f"DEGRADE: {old} -> {new} ({reason})", file=sys.stderr,
+            flush=True,
+        )

@@ -37,6 +37,18 @@ full-table, and sharded paths).
 
 Fault sites ``pipeline.handoff`` and ``pipeline.coalesce`` thread the
 chaos matrix through the new concurrency seams (utils/faults.SITES).
+
+Failure propagation at the device stage (serving/degrade.py): a raw
+device kernel that wedges mid-dispatch would block the device-stage
+worker forever — ``ServePipeline`` propagates device-stage EXCEPTIONS
+back to the host stage, but a wedge raises nothing to propagate. The
+degradation ladder closes that hole from inside the job: it is marked
+``host_native``, so ``dispatch_read`` routes it through the host-call
+read objects below, and the ladder's ``DeviceWatchdog`` bounds the
+device sync with a wall-clock deadline ON the worker. A deadline trip
+becomes a rung demotion (the job completes with fallback labels), not
+a dead worker; genuine ladder-external failures still take the
+existing ``raise_if_failed`` exception path.
 """
 
 from __future__ import annotations

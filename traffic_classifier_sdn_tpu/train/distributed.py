@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models import gnb as gnb_model, kmeans as kmeans_model
-from ..parallel.mesh import batch_sharded
+from ..parallel.mesh import batch_sharded, shard_map
 from . import gnb as gnb_train, kmeans as kmeans_train
 
 
@@ -164,7 +164,7 @@ def fit_forest(mesh, X, y, n_classes: int, *, n_trees: int = 100,
     # check_vma left ON: every output flows through a per-level psum, so
     # VMA inference proves the P() (replicated) out_specs — a dropped
     # psum in _build_tree becomes a trace-time error, not divergent trees
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_fit,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
@@ -222,7 +222,7 @@ def fit_svc(mesh, X, y, n_classes: int, *, C: float = 1.0,
     def local_solve(K, idx, t, Cbox):
         return jax.lax.map(lambda args: solve(K, *args), (idx, t, Cbox))
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_solve,
         mesh=mesh,
         in_specs=(P(), P(STATE_AXIS), P(STATE_AXIS), P(STATE_AXIS)),

@@ -85,6 +85,27 @@ SITES: dict[str, str] = {
         "the staged one (chaos must cover the overload path, not just "
         "the steady-state handoff)"
     ),
+    "degrade.dispatch_stall": (
+        "serving/degrade.DegradeLadder device path — a fire simulates a "
+        "WEDGED device dispatch (the r04 chip-day failure mode): the "
+        "ladder converts it into a watchdog deadline trip, so unlike "
+        "the other sites the FaultInjected never escapes — the ladder "
+        "must absorb it and demote to the fallback rung"
+    ),
+    "degrade.dispatch_error": (
+        "serving/degrade.DegradeLadder device path — a fire simulates "
+        "an ERRORING device dispatch (XLA runtime error mid-kernel); "
+        "absorbed by the ladder like dispatch_stall, driving the "
+        "error-trip edge of HEALTHY→DEGRADED instead of the deadline "
+        "edge"
+    ),
+    "degrade.probe": (
+        "serving/degrade.DegradeLadder probe path — the shadow-batch "
+        "re-probe itself fails: consumes the probe attempt, resets the "
+        "consecutive-success counter, and grows the full-jitter "
+        "backoff (chaos must cover the failed-recovery path, not just "
+        "the clean re-promotion)"
+    ),
 }
 
 
