@@ -735,6 +735,246 @@ def test_degrade_dispatch_error_probabilistic_any_seed_always_renders(
     assert out.count("Flow ID") == 20
 
 
+# ---------------------------------------------------------------------------
+# drift.* — the drift→retrain→promote loop's seams (serving/drift.py)
+# ---------------------------------------------------------------------------
+
+
+def _drift_teacher(params, X):
+    return (np.asarray(X)[:, 0] > 500.0).astype(np.int32)
+
+
+def _drift_batch(lo, hi, n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 12), np.float32)
+    X[: n // 2, 0] = lo * (1 + 0.01 * rng.rand(n // 2))
+    X[n // 2:, 0] = hi * (1 + 0.01 * rng.rand(n - n // 2))
+    X[:, 1] = 1.0
+    return X
+
+
+def _drift_harness(tmp_path, metrics=None, **kw):
+    from traffic_classifier_sdn_tpu.models import gnb
+    from traffic_classifier_sdn_tpu.serving.drift import (
+        DriftController,
+        DriftGate,
+    )
+
+    boot = gnb.from_numpy({
+        "theta": np.asarray([[10.0] * 12, [1000.0] * 12], np.float64),
+        "var": np.ones((2, 12), np.float64),
+        "class_prior": np.full(2, 0.5),
+    })
+    gate = DriftGate(_drift_teacher)
+    kw.setdefault("window", 3)
+    kw.setdefault("threshold", 3.0)
+    kw.setdefault("trips", 2)
+    kw.setdefault("calibration_windows", 2)
+    kw.setdefault("probe_successes", 2)
+    kw.setdefault("min_retrain_rows", 16)
+    ctl = DriftController(
+        gate, family="gnb", classes=("ping", "voice"),
+        directory=str(tmp_path / "drift"), metrics=metrics,
+        boot_params=boot, **kw,
+    )
+    return gate, ctl
+
+
+def _drift_tick(gate, ctl, i, shifted):
+    lo, hi = (100.0, 10000.0) if shifted else (10.0, 1000.0)
+    labels = gate(None, _drift_batch(lo, hi, seed=i))
+    ctl.poll()
+    return labels
+
+
+def _wait_drift_retrain(ctl, timeout=90.0):
+    from traffic_classifier_sdn_tpu.serving import retrain as rt
+
+    deadline = time.monotonic() + timeout
+    while ctl._retrainer.poll() == rt.RUNNING:
+        if time.monotonic() > deadline:
+            pytest.fail("background retrain never finished")
+        time.sleep(0.05)
+
+
+def test_drift_window_fault_drops_observation_never_the_serve(tmp_path):
+    """drift.window fires must be ABSORBED: the observation is dropped
+    and counted, the tick's labels flow, and once the site disarms the
+    monitor keeps calibrating/scoring from where it left off."""
+    from traffic_classifier_sdn_tpu.serving.drift import STEADY
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    gate, ctl = _drift_harness(tmp_path, metrics=m)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("drift.window", after=2, times=3)], SEED
+    )
+    try:
+        with faults.installed(plan):
+            for i in range(1, 19):
+                labels = _drift_tick(gate, ctl, i, shifted=False)
+                assert labels.shape == (16,)  # every tick answered
+        assert len(plan.fires) == 3
+        assert m.counters["drift_window_errors"] == 3
+        # 18 observations minus 3 dropped = 15 → 5 windows of 3
+        assert m.counters["drift_windows"] == 5
+        assert ctl.state == STEADY
+    finally:
+        ctl.close()
+
+
+def test_retrain_fit_fault_fails_run_old_model_serves_then_recovers(
+    tmp_path,
+):
+    """retrain.fit dies mid-fit: the run is marked failed, the serve
+    keeps the old model on every tick, and — the stream still drifting
+    — a later trip retrains successfully and promotes."""
+    from traffic_classifier_sdn_tpu.serving.drift import (
+        PROMOTED,
+        RETRAINING,
+    )
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    gate, ctl = _drift_harness(tmp_path, metrics=m)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("retrain.fit", times=1)], SEED
+    )
+    try:
+        with faults.installed(plan):
+            i = 0
+            while ctl.state != PROMOTED and i < 300:
+                i += 1
+                labels = _drift_tick(gate, ctl, i, shifted=i > 12)
+                assert labels.shape == (16,)
+                if ctl.state == RETRAINING:
+                    _wait_drift_retrain(ctl)
+        assert plan.fires == [("retrain.fit", 1)]
+        assert m.counters["retrain_failures"] == 1
+        assert m.counters["retrain_runs"] >= 2  # the retry succeeded
+        assert m.counters["promotions"] == 1
+        assert ctl.state == PROMOTED
+    finally:
+        ctl.close()
+
+
+def test_promote_swap_fault_rolls_back_via_resolve_latest(tmp_path):
+    """promote.swap fires at the hot swap: the candidate is discarded,
+    the rotation's resolve_latest hands back the boot seed, and the old
+    model's labels keep flowing on every tick."""
+    from traffic_classifier_sdn_tpu.serving import retrain as rt
+    from traffic_classifier_sdn_tpu.serving.drift import (
+        RETRAINING,
+        ROLLED_BACK,
+    )
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    gate, ctl = _drift_harness(tmp_path, metrics=m)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("promote.swap", times=None)], SEED
+    )
+    try:
+        with faults.installed(plan):
+            i = 0
+            while ctl.state != ROLLED_BACK and i < 300:
+                i += 1
+                labels = _drift_tick(gate, ctl, i, shifted=i > 12)
+                assert labels.shape == (16,)
+                if ctl.state == RETRAINING:
+                    _wait_drift_retrain(ctl)
+        assert plan.fires
+        assert m.counters["rollbacks"] == 1
+        drift_dir = str(tmp_path / "drift")
+        assert rt.resolve_latest(drift_dir) == rt.candidate_path(
+            drift_dir, 0
+        )
+        X = _drift_batch(100.0, 10000.0, seed=777)
+        np.testing.assert_array_equal(
+            np.asarray(gate(None, X)), _drift_teacher(None, X)
+        )
+    finally:
+        ctl.close()
+
+
+def test_promote_rollback_fault_keeps_the_live_pair_serving(tmp_path):
+    """promote.rollback fires INSIDE the rollback: the reload is
+    skipped, the gate keeps the pair it already holds (the old model —
+    the swap never landed), and serving continues uninterrupted."""
+    from traffic_classifier_sdn_tpu.serving.drift import (
+        RETRAINING,
+        ROLLED_BACK,
+    )
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    gate, ctl = _drift_harness(tmp_path, metrics=m)
+    plan = faults.FaultPlan([
+        faults.FaultRule("promote.swap", times=None),
+        faults.FaultRule("promote.rollback", times=None),
+    ], SEED)
+    try:
+        with faults.installed(plan):
+            i = 0
+            while ctl.state != ROLLED_BACK and i < 300:
+                i += 1
+                labels = _drift_tick(gate, ctl, i, shifted=i > 12)
+                assert labels.shape == (16,)
+                if ctl.state == RETRAINING:
+                    _wait_drift_retrain(ctl)
+        fired = {s for s, _ in plan.fires}
+        assert fired == {"promote.swap", "promote.rollback"}
+        assert m.counters["rollbacks"] == 1
+        # neither swap nor rollback-reload landed: the gate still
+        # forwards the caller's pair — the boot teacher
+        assert not gate.swapped
+        X = _drift_batch(100.0, 10000.0, seed=778)
+        np.testing.assert_array_equal(
+            np.asarray(gate(None, X)), _drift_teacher(None, X)
+        )
+    finally:
+        ctl.close()
+
+
+def test_drift_loop_probabilistic_any_seed_always_serves(tmp_path):
+    """Probability-scheduled failures at ALL FOUR drift seams (any
+    TCSDN_CHAOS_SEED): whatever subset fires, the loop never raises
+    into the serve path, every tick produces labels, and the state
+    machine stays on the documented states — the whole point of the
+    self-updating loop being self-contained."""
+    from traffic_classifier_sdn_tpu.serving.drift import (
+        CANDIDATE,
+        DRIFTING,
+        PROMOTED,
+        RETRAINING,
+        ROLLED_BACK,
+        STEADY,
+    )
+
+    gate, ctl = _drift_harness(tmp_path)
+    valid = {STEADY, DRIFTING, RETRAINING, CANDIDATE, PROMOTED,
+             ROLLED_BACK}
+    plan = faults.FaultPlan([
+        faults.FaultRule("drift.window", p=0.2, times=None),
+        faults.FaultRule("retrain.fit", p=0.5, times=None),
+        faults.FaultRule("promote.swap", p=0.5, times=None),
+        faults.FaultRule("promote.rollback", p=0.5, times=None),
+    ], SEED)
+    deadline = time.monotonic() + 120
+    try:
+        with faults.installed(plan):
+            for i in range(1, 121):
+                if time.monotonic() > deadline:
+                    break
+                labels = _drift_tick(gate, ctl, i, shifted=i > 12)
+                assert labels.shape == (16,)  # the serve never misses
+                assert ctl.state in valid
+                if ctl.state == RETRAINING:
+                    _wait_drift_retrain(ctl)
+    finally:
+        ctl.close()
+
+
 def test_pipeline_handoff_probabilistic_any_seed_serve_survivable():
     """Probability-scheduled handoff failures (any TCSDN_CHAOS_SEED):
     every fire surfaces as FaultInjected at submit — never a hang, never

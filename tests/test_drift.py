@@ -1,0 +1,861 @@
+"""The drift→retrain→promote loop (serving/drift.py, serving/retrain.py).
+
+The load-bearing guarantees, each pinned here:
+
+- the DriftMonitor calibrates a reference from its first windows,
+  scores per-window EWMA z-shift + class-mix shift, and trips on
+  exactly K consecutive over-threshold windows — the replay scenario
+  drives it from ``ingest/replay.py`` with a mid-stream distribution
+  shift and asserts the exact tick window of the trip (injectable
+  counts, no sleeps);
+- the DriftGate is a byte-transparent passthrough until the first
+  promotion (the CLI's ``--drift auto`` no-fault output is
+  byte-identical to ``--drift off``, serial and pipelined) and an
+  atomic hot-swap point after it;
+- the full loop: injected distribution shift → drift trip → background
+  retrain through ``train/distributed.py`` → candidate staged through
+  the atomic model-checkpoint path → parity-gated promotion; and the
+  chaos variant (fault armed at ``promote.swap``) rolls back via
+  ``serving/retrain.resolve_latest`` with the old model still serving
+  every tick;
+- a background fit that outlives ``retrain_deadline`` is ABANDONED on
+  the injectable clock — late results are discarded, the loop resumes;
+- the serving checkpoint (FORMAT_VERSION 3) round-trips the
+  ``feature_reference`` block and still loads v2 checkpoints (no
+  block → the monitor re-calibrates);
+- /healthz exposes ``model_age_s`` anchored on the last promotion (or
+  the boot load before any), so "healthy but ancient" is visible.
+"""
+
+import contextlib
+import io
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu import cli
+from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+from traffic_classifier_sdn_tpu.ingest.protocol import (
+    TelemetryRecord,
+    format_line,
+)
+from traffic_classifier_sdn_tpu.ingest.replay import iter_capture
+from traffic_classifier_sdn_tpu.io import serving_checkpoint as sc
+from traffic_classifier_sdn_tpu.models import gnb
+from traffic_classifier_sdn_tpu.serving import retrain
+from traffic_classifier_sdn_tpu.serving.drift import (
+    CANDIDATE,
+    DRIFTING,
+    PROMOTED,
+    RETRAINING,
+    ROLLED_BACK,
+    STEADY,
+    DriftController,
+    DriftGate,
+    DriftMonitor,
+)
+from traffic_classifier_sdn_tpu.utils import faults
+from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+
+# ---------------------------------------------------------------------------
+# harness: a 2-class teacher over a 12-feature stream
+# ---------------------------------------------------------------------------
+
+
+def _teacher(params, X):
+    """The 'live model': labels by thresholding feature 0 — class 0
+    below 500, class 1 above. Stands in for the boot serving predict."""
+    return (np.asarray(X)[:, 0] > 500.0).astype(np.int32)
+
+
+def _batch(lo, hi, n=16, seed=0):
+    """One observed feature batch: half the rows around ``lo``, half
+    around ``hi`` (±1% jitter) — two separable classes."""
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 12), np.float32)
+    X[: n // 2, 0] = lo * (1 + 0.01 * rng.rand(n // 2))
+    X[n // 2:, 0] = hi * (1 + 0.01 * rng.rand(n - n // 2))
+    X[:, 1] = 1.0  # a constant column keeps every row "active"
+    return X
+
+
+def _boot_params():
+    return gnb.from_numpy({
+        "theta": np.asarray(
+            [[10.0] * 12, [1000.0] * 12], dtype=np.float64
+        ),
+        "var": np.ones((2, 12), np.float64),
+        "class_prior": np.full(2, 0.5),
+    })
+
+
+def _controller(tmp_path, gate, metrics=None, **kw):
+    kw.setdefault("window", 3)
+    kw.setdefault("threshold", 3.0)
+    kw.setdefault("trips", 2)
+    kw.setdefault("calibration_windows", 2)
+    kw.setdefault("probe_successes", 2)
+    kw.setdefault("min_retrain_rows", 16)
+    kw.setdefault("boot_params", _boot_params())
+    return DriftController(
+        gate, family="gnb", classes=("ping", "voice"),
+        directory=str(tmp_path / "drift"), metrics=metrics, **kw,
+    )
+
+
+def _drive(gate, ctl, i, shifted):
+    """One render tick: predict through the gate, poll the loop."""
+    lo, hi = (100.0, 10000.0) if shifted else (10.0, 1000.0)
+    labels = gate(None, _batch(lo, hi, seed=i))
+    ctl.poll()
+    return labels
+
+
+def _wait_retrain(ctl, timeout=90.0):
+    """Bounded wait for the background fit — the test throttles its own
+    tick rate the way a real 1 Hz poll cadence would."""
+    deadline = time.monotonic() + timeout
+    while ctl._retrainer.poll() == retrain.RUNNING:
+        if time.monotonic() > deadline:
+            pytest.fail("background retrain never finished")
+        time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# DriftMonitor
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_calibrates_then_scores_stationary_low():
+    mon = DriftMonitor(window=2, threshold=3.0, trips=2,
+                       calibration_windows=2)
+    reports = []
+    for i in range(1, 13):
+        X = _batch(10.0, 1000.0, seed=i)
+        r = mon.observe(X, _teacher(None, X))
+        if r is not None:
+            reports.append(r)
+    assert len(reports) == 6  # 12 observations / window 2
+    assert [r["calibrating"] for r in reports[:2]] == [True, True]
+    assert mon.calibrated
+    # stationary stream: scored windows stay far under threshold
+    for r in reports[2:]:
+        assert not r["over"]
+        assert r["score"] < 1.0
+    assert mon.over_streak == 0
+
+
+def test_monitor_trip_fires_at_exact_window_from_replay(tmp_path):
+    """The deterministic replay scenario: a recorded capture whose
+    byte rates jump ×50 at a known tick, played through the real
+    ingest spine (ingest/replay.iter_capture → FlowStateEngine →
+    features12). The monitor must trip at EXACTLY the computed window
+    — calibration windows, then the post-shift windows needed for K
+    consecutive over-threshold scores — and not one window earlier."""
+    n_flows, shift_tick, n_ticks = 8, 21, 40
+    path = str(tmp_path / "shift.capture")
+    with open(path, "wb") as f:
+        cum = np.zeros(n_flows, np.int64)
+        for t in range(1, n_ticks + 1):
+            rate = 100 if t < shift_tick else 5000
+            for i in range(n_flows):
+                cum[i] += rate * (i + 1)
+                f.write(format_line(TelemetryRecord(
+                    time=t, datapath="1", in_port="1",
+                    eth_src=f"f{i:02d}", eth_dst="gw", out_port="2",
+                    packets=int(cum[i] // 100), bytes=int(cum[i]),
+                )))
+
+    window, trips, calibration = 4, 2, 2
+    mon = DriftMonitor(window=window, threshold=4.0, trips=trips,
+                       calibration_windows=calibration)
+    engine = FlowStateEngine(capacity=32)
+    trip_windows = []
+    tick = 0
+    for batch in iter_capture(path):
+        tick += 1
+        engine.mark_tick()
+        engine.ingest(batch)
+        engine.step()
+        X = np.asarray(engine.features())
+        mask = X.any(axis=1)
+        labels = np.zeros(int(mask.sum()), np.int32)
+        report = mon.observe(X[mask], labels)
+        if report is not None and report["tripped"]:
+            trip_windows.append(report["window"])
+    # windows close at ticks 4, 8, ...; the shift lands at tick 21, so
+    # window 6 (ticks 21-24) is the first over-threshold one and window
+    # 7 (= calibration 2 + 3 clean + trips 2) carries the trip
+    first_shift_window = (shift_tick - 1) // window + 1
+    expected_trip = first_shift_window + trips - 1
+    assert trip_windows
+    assert trip_windows[0] == expected_trip
+    assert mon.windows == n_ticks // window
+
+
+def test_monitor_reservoir_is_bounded():
+    mon = DriftMonitor(window=4, reservoir_rows=64)
+    for i in range(32):
+        X = _batch(10.0, 1000.0, n=16, seed=i)
+        mon.observe(X, _teacher(None, X))
+    X, y = mon.reservoir_window()
+    assert X.shape[0] <= 64 + 16  # cap plus at most one chunk overhang
+    assert X.shape[0] == y.shape[0]
+
+
+def test_monitor_seeded_reference_skips_calibration():
+    a = DriftMonitor(window=2, calibration_windows=1)
+    for i in range(4):
+        X = _batch(10.0, 1000.0, seed=i)
+        a.observe(X, _teacher(None, X))
+    ref = a.reference_arrays()
+    assert ref is not None and set(ref) >= {
+        "mean", "std", "class_freq", "count"
+    }
+    b = DriftMonitor(window=2, threshold=3.0, trips=1, reference=ref)
+    assert b.calibrated
+    X = _batch(100.0, 10000.0, seed=9)  # shifted from the seeded ref
+    b.observe(X, _teacher(None, X))
+    r = b.observe(X, _teacher(None, X))
+    assert r is not None and r["tripped"]  # no calibration window burned
+
+
+def test_monitor_class_mix_inversion_trips_at_default_threshold():
+    """The class-mix signal must be able to trip on its own: identical
+    feature distributions, but the label mix inverts — the default
+    class_tolerance (0.2) scores a full inversion at 5.0, above the
+    default threshold 4.0 (a tolerance >= 1/threshold would make this
+    detection channel mathematically inert)."""
+    mon = DriftMonitor(window=2, trips=2, calibration_windows=1)
+    X = np.ones((16, 12), np.float32) * 7.0  # features never move
+    for _ in range(2):  # calibration: every row labeled class 0
+        mon.observe(X, np.zeros(16, np.int32))
+    tripped = []
+    for _ in range(8):  # the mix inverts: every row labeled class 1
+        r = mon.observe(X, np.ones(16, np.int32))
+        if r is not None:
+            tripped.append(r["tripped"])
+            assert r["score"] == pytest.approx(5.0)  # 1.0 / 0.2
+    assert tripped == [False, True, True, True]  # K=2 windows, then
+
+
+def test_monitor_empty_windows_never_score_or_trip():
+    mon = DriftMonitor(window=2, threshold=0.0, trips=1,
+                       calibration_windows=1)
+    empty = np.zeros((0, 12), np.float32)
+    for _ in range(8):
+        r = mon.observe(empty, np.zeros(0, np.int32))
+        if r is not None:
+            assert r["empty"] and not r["tripped"]
+    assert not mon.calibrated
+
+
+# ---------------------------------------------------------------------------
+# DriftGate
+# ---------------------------------------------------------------------------
+
+
+def test_gate_is_transparent_until_installed():
+    gate = DriftGate(_teacher)
+    assert gate.host_native is False
+    X = _batch(10.0, 1000.0)
+    out = gate("caller-params", X)
+    np.testing.assert_array_equal(out, _teacher(None, X))
+    X2, labels = gate.take_capture()
+    assert X2 is X
+    np.testing.assert_array_equal(labels, out)
+    assert gate.take_capture() is None  # consumed
+    assert not gate.swapped
+
+
+def test_gate_install_swaps_pair_and_ignores_caller_params():
+    gate = DriftGate(_teacher)
+    gate.install(lambda p, X: np.full(int(X.shape[0]), p, np.int32), 7)
+    out = gate("stale-caller-params", _batch(10.0, 1000.0, n=4))
+    np.testing.assert_array_equal(out, np.full(4, 7, np.int32))
+    assert gate.swapped
+
+
+def test_gate_propagates_host_native_flag():
+    def hn(params, X):
+        return np.zeros(int(X.shape[0]), np.int32)
+
+    hn.host_native = True
+    assert DriftGate(hn).host_native is True
+
+
+def test_gate_ladder_view_follows_promotions():
+    """With --degrade and --drift both on, the render STALE column and
+    /healthz consult the ladder through the gate: after a promotion
+    rebuilds the ladder around the new kernel, the view must report the
+    LIVE ladder's state, not the retired boot object's."""
+    from traffic_classifier_sdn_tpu.serving.drift import GateLadderView
+
+    class FakeLadder:
+        def __init__(self, name, stale):
+            self.name = name
+            self.render_stale = stale
+            self.closed = False
+
+        def status(self):
+            return {"state": self.name}
+
+        def close(self):
+            self.closed = True
+
+    boot = FakeLadder("BOOT", stale=False)
+    gate = DriftGate(boot)
+    view = GateLadderView(gate, boot)
+    assert view.render_stale is False
+    assert view.status() == {"state": "BOOT"}
+    promoted = FakeLadder("PROMOTED", stale=True)
+    prev = gate.install(promoted, None)
+    assert prev is boot
+    assert view.render_stale is True
+    assert view.status() == {"state": "PROMOTED"}
+    view.close()
+    assert promoted.closed and boot.closed
+
+
+# ---------------------------------------------------------------------------
+# BackgroundRetrainer: abandon discipline
+# ---------------------------------------------------------------------------
+
+
+def test_retrainer_runs_and_take_consumes():
+    r = retrain.BackgroundRetrainer()
+    r.submit(lambda ok: 42)
+    deadline = time.monotonic() + 10
+    while r.poll() == retrain.RUNNING and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert r.poll() == retrain.DONE
+    state, result, error = r.take()
+    assert (state, result, error) == (retrain.DONE, 42, None)
+    assert r.poll() == retrain.IDLE
+
+
+def test_retrainer_abandon_discards_late_result():
+    release = threading.Event()
+    r = retrain.BackgroundRetrainer()
+    r.submit(lambda ok: release.wait(timeout=30) and "late")
+    assert r.poll() == retrain.RUNNING
+    r.abandon()
+    assert r.poll() == retrain.IDLE
+    release.set()
+    time.sleep(0.1)  # let the abandoned worker publish into the void
+    assert r.poll() == retrain.IDLE  # the late result was discarded
+    r.submit(lambda ok: "fresh")
+    deadline = time.monotonic() + 10
+    while r.poll() == retrain.RUNNING and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert r.take()[1] == "fresh"
+
+
+def test_retrainer_is_current_goes_false_on_abandon():
+    """The job's publication guard: an abandoned generation must see
+    is_current() == False BEFORE it commits side effects (the candidate
+    save) — no never-probed stray can land in the rotation."""
+    release = threading.Event()
+    seen = {}
+
+    def job(is_current):
+        seen["before"] = is_current()
+        release.wait(timeout=30)
+        seen["after"] = is_current()
+        return "anything"
+
+    r = retrain.BackgroundRetrainer()
+    r.submit(job)
+    deadline = time.monotonic() + 10
+    while "before" not in seen and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert seen.get("before") is True
+    r.abandon()
+    release.set()
+    deadline = time.monotonic() + 10
+    while "after" not in seen and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert seen.get("after") is False
+
+
+def test_controller_abandons_retrain_on_deadline(tmp_path, monkeypatch):
+    """A fit that outlives --retrain-deadline is abandoned on the
+    INJECTED clock — no sleeps, exact schedule — and the loop resumes
+    watching on the old model."""
+    release = threading.Event()
+    started = threading.Event()
+
+    def wedged_fit(family, X, y, n_classes, **kw):
+        started.set()
+        release.wait(timeout=30)
+        raise RuntimeError("never reached before abandon")
+
+    monkeypatch.setattr(retrain, "fit_family", wedged_fit)
+    clock = [1000.0]
+    m = Metrics()
+    gate = DriftGate(_teacher)
+    ctl = _controller(tmp_path, gate, metrics=m,
+                      retrain_deadline=50.0, clock=lambda: clock[0])
+    try:
+        i = 0
+        while ctl.state != RETRAINING and i < 40:
+            i += 1
+            _drive(gate, ctl, i, shifted=i > 6)
+        assert ctl.state == RETRAINING
+        assert started.wait(timeout=10)
+        # within the deadline: still retraining
+        clock[0] += 49.0
+        _drive(gate, ctl, i + 1, shifted=True)
+        assert ctl.state == RETRAINING
+        # past the deadline: abandoned, back to watching
+        clock[0] += 2.0
+        _drive(gate, ctl, i + 2, shifted=True)
+        assert ctl.state in (STEADY, DRIFTING, RETRAINING)
+        assert ctl.status()["retrain_failures"] == 1
+        assert not gate.swapped  # the old model kept serving
+    finally:
+        release.set()
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# candidate rotation
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_rejects_mismatched_reference_at_construction():
+    """A persisted reference from a different model layout must fail
+    loudly at startup — never as a broadcast error mid-window-close on
+    the serve path."""
+    good = DriftMonitor(window=2, calibration_windows=1)
+    for i in range(2):
+        X = _batch(10.0, 1000.0, seed=i)
+        good.observe(X, _teacher(None, X))
+    ref = good.reference_arrays()
+    ref["class_freq"] = np.asarray([0.2, 0.3, 0.5], np.float64)  # 3 != 2
+    with pytest.raises(ValueError, match="class_freq"):
+        DriftMonitor(reference=ref)
+
+
+def test_rejected_candidate_retires_its_predict(tmp_path):
+    """A rejected candidate's predict is retired with it: when the CLI
+    composes the drift loop with the degradation ladder, each candidate
+    owns a rebuilt ladder (watchdog thread included) — dropping it
+    without close() would leak one parked thread per rejection."""
+    closed = []
+
+    class DisagreeingPredict:
+        """Callable candidate that never matches the live labels."""
+
+        def __call__(self, params, X):
+            return np.full(int(np.asarray(X).shape[0]), 9, np.int32)
+
+        def close(self):
+            closed.append(True)
+
+    gate = DriftGate(_teacher)
+    ctl = _controller(
+        tmp_path, gate,
+        build_serving=lambda params: (DisagreeingPredict(), None),
+        candidate_max_failures=1,
+    )
+    try:
+        i = 0
+        seen_candidate = False
+        while i < 200:
+            i += 1
+            _drive(gate, ctl, i, shifted=i > 12)
+            if ctl.state == RETRAINING:
+                _wait_retrain(ctl)
+            seen_candidate = seen_candidate or ctl.state == CANDIDATE
+            if closed:
+                break
+        assert seen_candidate
+        assert closed  # the rejected candidate's predict was retired
+        assert not gate.swapped  # wrong-but-fresh never promoted
+    finally:
+        ctl.close()
+
+
+def test_probe_consumes_shadow_no_promotion_on_stale_data(tmp_path):
+    """Each parity probe consumes its shadow batch: with the stream
+    gone idle after a candidate stages (only empty windows), the same
+    stale batch must not be re-counted toward 'N consecutive clean
+    probes' — and the O(capacity) shadow is released, not pinned."""
+    gate = DriftGate(_teacher)
+    ctl = _controller(tmp_path, gate, probe_successes=3)
+    empty = np.zeros((16, 12), np.float32)  # all rows inactive
+    try:
+        i = 0
+        while ctl.state != CANDIDATE and i < 200:
+            i += 1
+            _drive(gate, ctl, i, shifted=i > 12)
+            if ctl.state == RETRAINING:
+                _wait_retrain(ctl)
+        assert ctl.state == CANDIDATE
+        # idle stream: windows keep closing empty; at most the one
+        # already-captured shadow can be probed, never re-counted
+        for j in range(12):
+            gate(None, empty)
+            ctl.poll()
+        assert ctl.state == CANDIDATE  # never promoted on stale data
+        assert ctl.status()["probe_successes"] <= 1
+        assert ctl._last_shadow is None  # consumed, not pinned
+    finally:
+        ctl.close()
+
+
+def test_mode_matched_parity_accepts_permuted_labels(tmp_path):
+    """The kmeans mode: a refit clustering's ids are a permutation of
+    the live model's labels. Exact parity would reject every candidate
+    forever; mode-matched parity maps labels by per-cluster majority
+    first, so a consistent relabeling promotes."""
+    closed = []
+
+    class PermutedPredict:
+        """Candidate emitting exactly 1 - teacher(X): a perfect but
+        relabeled clustering of the same data."""
+
+        def __call__(self, params, X):
+            return (1 - _teacher(None, X)).astype(np.int32)
+
+        def close(self):
+            closed.append(True)
+
+    gate = DriftGate(_teacher)
+    ctl = _controller(
+        tmp_path, gate,
+        build_serving=lambda params: (PermutedPredict(), None),
+        parity_mode="mode-matched",
+    )
+    try:
+        i = 0
+        while ctl.state != PROMOTED and i < 200:
+            i += 1
+            _drive(gate, ctl, i, shifted=i > 12)
+            if ctl.state == RETRAINING:
+                _wait_retrain(ctl)
+        assert ctl.state == PROMOTED
+        assert gate.swapped
+        assert not closed  # the LIVE candidate was not retired
+    finally:
+        ctl.close()
+
+
+def test_restarted_controller_keeps_prior_promotions_on_rollback(
+    tmp_path,
+):
+    """A RESTARTED serve pointed at an existing drift-dir must treat
+    prior runs' promoted checkpoints as legitimate restore targets: a
+    rollback discards only strays ABOVE the newest loadable member at
+    boot, never the promotion history."""
+    d = str(tmp_path / "drift")
+    for s in range(3):  # a prior run's boot seed + two promotions
+        retrain.save_candidate(d, s, "gnb", _boot_params(),
+                               ("ping", "voice"))
+    gate = DriftGate(_teacher)
+    ctl = _controller(tmp_path, gate)  # restart into the same dir
+    try:
+        assert ctl._promoted_seq == 2  # adopted from the rotation
+        # a post-boot stray (this run's failed candidate)
+        stray = retrain.save_candidate(d, 7, "gnb", _boot_params(),
+                                       ("ping", "voice"))
+        ctl._rollback(stray, None, "test")
+        kept = [s for s, _ in retrain.list_candidates(d)]
+        assert kept == [2, 1, 0]  # history intact, stray gone
+        assert retrain.resolve_latest(d) == retrain.candidate_path(d, 2)
+        assert not gate.swapped  # the live pair was never touched
+    finally:
+        ctl.close()
+
+
+def test_resolve_latest_skips_unloadable_candidate(tmp_path):
+    d = str(tmp_path / "rot")
+    p0 = retrain.save_candidate(d, 0, "gnb", _boot_params(),
+                                ("ping", "voice"))
+    p1 = retrain.save_candidate(d, 1, "gnb", _boot_params(),
+                                ("ping", "voice"))
+    assert retrain.resolve_latest(d) == p1
+    os.unlink(os.path.join(p1, "manifest.json"))  # garbage newest
+    assert retrain.resolve_latest(d) == p0
+    retrain.discard_candidate(p0)
+    assert retrain.resolve_latest(d) is None
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end loop
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_shift_trips_retrains_and_promotes(tmp_path):
+    """THE acceptance scenario: injected distribution shift → drift
+    trip → background retrain (train/distributed.py on the recent
+    labeled window) → candidate staged through the atomic model
+    checkpoint path → parity-gated promotion. After the swap the gate
+    serves the retrained checkpoint and the monitor's reference is
+    re-based onto the retrain window."""
+    m = Metrics()
+    gate = DriftGate(_teacher)
+    ctl = _controller(tmp_path, gate, metrics=m)
+    seen = []
+    try:
+        i = 0
+        while ctl.state != PROMOTED and i < 200:
+            i += 1
+            labels = _drive(gate, ctl, i, shifted=i > 12)
+            assert labels.shape == (16,)  # every tick produced labels
+            if not seen or seen[-1] != ctl.state:
+                seen.append(ctl.state)
+            if ctl.state == RETRAINING:
+                _wait_retrain(ctl)
+        assert seen == [STEADY, DRIFTING, RETRAINING, CANDIDATE,
+                        PROMOTED]
+        assert m.counters["retrain_runs"] == 1
+        assert m.counters["promotions"] == 1
+        assert "rollbacks" not in m.counters
+        assert gate.swapped
+        # the candidate landed in the rotation behind the boot seed
+        members = [s for s, _ in retrain.list_candidates(
+            str(tmp_path / "drift")
+        )]
+        assert 0 in members and max(members) >= 1
+        # the promoted model agrees with the live labels on shifted
+        # traffic (it was fit on exactly that window)
+        X = _batch(100.0, 10000.0, seed=9999)
+        np.testing.assert_array_equal(
+            np.asarray(gate(None, X)), _teacher(None, X)
+        )
+        # reference re-based: the shifted stream now scores low
+        for j in range(12):
+            _drive(gate, ctl, 1000 + j, shifted=True)
+        assert ctl.state == STEADY
+        assert ctl.status()["score"] < 1.0
+    finally:
+        ctl.close()
+
+
+def test_e2e_promote_swap_fault_rolls_back_old_model_serves(tmp_path):
+    """The chaos variant: with a fault armed at ``promote.swap``, the
+    promotion rolls back via serving/retrain.resolve_latest — the bad
+    candidate is discarded, the boot seed is re-installed, and the OLD
+    model's labels keep flowing on every tick."""
+    m = Metrics()
+    gate = DriftGate(_teacher)
+    ctl = _controller(tmp_path, gate, metrics=m)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("promote.swap", times=None)], 0
+    )
+    try:
+        with faults.installed(plan):
+            i = 0
+            while ctl.state != ROLLED_BACK and i < 200:
+                i += 1
+                labels = _drive(gate, ctl, i, shifted=i > 12)
+                assert labels.shape == (16,)  # never missed a tick
+                if ctl.state == RETRAINING:
+                    _wait_retrain(ctl)
+        assert plan.fires
+        assert m.counters["rollbacks"] == 1
+        assert m.counters.get("promotions", 0) == 0
+        drift_dir = str(tmp_path / "drift")
+        # the bad candidate was discarded: the rotation resolves to the
+        # boot seed
+        assert retrain.resolve_latest(drift_dir) == \
+            retrain.candidate_path(drift_dir, 0)
+        # the old model still serves: the re-installed pair is the boot
+        # checkpoint, so labels match the teacher exactly
+        X = _batch(100.0, 10000.0, seed=4242)
+        np.testing.assert_array_equal(
+            np.asarray(gate(None, X)), _teacher(None, X)
+        )
+    finally:
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI: byte-identity + smoke
+# ---------------------------------------------------------------------------
+
+
+def _native_checkpoint(tmp_path):
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (2, 12)),
+        "var": rng.gamma(2.0, 50.0, (2, 12)) + 1.0,
+        "class_prior": np.full(2, 0.5),
+    })
+    path = str(tmp_path / "gnb_ckpt")
+    ck.save_model(path, "gnb", params, classes=("ping", "voice"))
+    return path
+
+
+def _serve(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), \
+            contextlib.redirect_stderr(io.StringIO()):
+        cli.main(argv)
+    return buf.getvalue()
+
+
+def _common(ckpt):
+    return [
+        "gaussiannb", "--native-checkpoint", ckpt,
+        "--source", "synthetic", "--synthetic-flows", "16",
+        "--capacity", "64", "--print-every", "2", "--max-ticks", "8",
+        "--idle-timeout", "0", "--table-rows", "8",
+    ]
+
+
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+def test_drift_auto_no_fault_output_byte_identical(tmp_path, pipeline):
+    """The no-fault guarantee: with --drift auto and no drift, serve
+    output is byte-identical to --drift off — serial and pipelined."""
+    common = _common(_native_checkpoint(tmp_path)) + [
+        "--pipeline", pipeline,
+    ]
+    off = _serve(common + ["--drift", "off"])
+    auto = _serve(common + [
+        "--drift", "auto", "--drift-dir",
+        str(tmp_path / f"drift-{pipeline}"),
+    ])
+    assert "Flow ID" in off
+    assert auto == off
+    # the drift loop actually ran: the boot model seeded the rotation
+    assert retrain.resolve_latest(
+        str(tmp_path / f"drift-{pipeline}")
+    ) is not None
+
+
+def test_drift_auto_requires_drift_dir(tmp_path):
+    with pytest.raises(SystemExit, match="drift-dir"):
+        cli.main(_common(_native_checkpoint(tmp_path)) + [
+            "--drift", "auto",
+        ])
+
+
+def test_cli_drift_windows_observed(tmp_path):
+    """The serve loop feeds the monitor: a stationary synthetic serve
+    closes windows (drift_windows counts) and stays STEADY."""
+    from traffic_classifier_sdn_tpu.utils.metrics import global_metrics
+
+    _serve(_common(_native_checkpoint(tmp_path)) + [
+        "--drift", "auto", "--drift-dir", str(tmp_path / "d"),
+        "--drift-window", "2", "--drift-threshold", "50",
+        "--max-ticks", "12",
+    ])
+    assert global_metrics.counters.get("drift_windows", 0) >= 2
+    assert global_metrics.gauges.get("drift_state") == 0  # STEADY
+
+
+# ---------------------------------------------------------------------------
+# serving checkpoint: the feature_reference block (format v3)
+# ---------------------------------------------------------------------------
+
+
+def _tick(engine, t, n):
+    engine.mark_tick()
+    engine.ingest([
+        TelemetryRecord(
+            time=t, datapath="1", in_port="1", eth_src=f"f{i:02d}",
+            eth_dst="gw", out_port="2", packets=7 * t + i,
+            bytes=1000 * t + 13 * i,
+        )
+        for i in range(n)
+    ])
+    engine.step()
+
+
+def test_checkpoint_feature_reference_roundtrip(tmp_path):
+    path = str(tmp_path / "s.npz")
+    eng = FlowStateEngine(capacity=16)
+    _tick(eng, 1, 4)
+    ref = {
+        "mean": np.arange(12, dtype=np.float64),
+        "std": np.ones(12, np.float64),
+        "class_freq": np.asarray([0.25, 0.75], np.float64),
+        "count": np.float64(128.0),
+    }
+    sc.save(eng, path, feature_reference=ref)
+    restored = sc.restore(path)
+    got = restored.feature_reference
+    assert got is not None
+    for key, value in ref.items():
+        np.testing.assert_array_equal(got[key], value)
+    # and it survives a monitor round-trip (the CLI's restore path)
+    mon = DriftMonitor(reference=got)
+    assert mon.calibrated
+
+
+def test_checkpoint_without_reference_restores_none(tmp_path):
+    path = str(tmp_path / "s.npz")
+    eng = FlowStateEngine(capacity=16)
+    _tick(eng, 1, 4)
+    sc.save(eng, path)
+    assert sc.restore(path).feature_reference is None
+
+
+def test_v2_checkpoint_still_loads_without_reference(tmp_path):
+    """Backward compat: a pre-drift (v2) checkpoint — no
+    feature_reference block — restores cleanly and reports no
+    reference (the monitor then re-calibrates)."""
+    path = str(tmp_path / "v2.npz")
+    eng = FlowStateEngine(capacity=16)
+    _tick(eng, 1, 4)
+    sc.save(eng, path)
+    z = dict(np.load(path))
+    z["format_version"] = np.int64(2)
+    del z["crc32"]
+    z["crc32"] = np.uint32(sc._content_crc(z))
+    np.savez_compressed(path, **z)
+    restored = sc.restore(path)
+    assert restored.num_flows() == 4
+    assert restored.feature_reference is None
+
+
+# ---------------------------------------------------------------------------
+# /healthz: model staleness
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_model_age_anchors_on_promotion():
+    from traffic_classifier_sdn_tpu.obs import HealthState
+
+    clock = [100.0]
+    h = HealthState(clock=lambda: clock[0])
+    _, report = h.check()
+    assert report["model_age_s"] is None  # no model registered
+    h.model_loaded()
+    clock[0] = 160.0
+    _, report = h.check()
+    assert report["model_age_s"] == pytest.approx(60.0)
+    assert report["model_promoted_age_s"] is None  # ancient, honestly
+    h.model_promoted()
+    clock[0] = 175.0
+    _, report = h.check()
+    # the age re-anchors on the promotion: freshly promoted, visibly
+    assert report["model_age_s"] == pytest.approx(15.0)
+    assert report["model_promoted_age_s"] == pytest.approx(15.0)
+
+
+def test_healthz_carries_drift_status(tmp_path):
+    from traffic_classifier_sdn_tpu.obs import HealthState
+
+    h = HealthState()
+    gate = DriftGate(_teacher)
+    ctl = _controller(tmp_path, gate)
+    try:
+        h.model_loaded()
+        h.set_drift(ctl.status)
+        ctl.set_health(h)
+        _, report = h.check()
+        assert report["drift"]["state"] == STEADY
+        assert report["drift"]["promotions"] == 0
+        assert report["drift"]["swapped"] is False
+    finally:
+        ctl.close()

@@ -422,15 +422,22 @@ def test_serving_package_is_clean():
     again: its DeviceWatchdog worker thread and the ladder's shared
     state machine must hold lock-discipline, and the
     degrade.dispatch_stall/dispatch_error/probe seams must audit
-    against the fault-site registry."""
+    against the fault-site registry. The drift loop raises it once
+    more: serving/retrain.py's background fit thread publishes a
+    candidate checkpoint path to the serve thread, and
+    serving/drift.py's controller state is read from the exposition
+    thread — both must hold lock-discipline, and the drift.window/
+    retrain.fit/promote.swap/promote.rollback seams must audit against
+    the registry."""
     findings = lint_paths([os.path.join(PACKAGE_DIR, "serving")])
     assert findings == [], "\n".join(f.render() for f in findings)
-    # the degrade module alone must also scan clean (a scoped report
-    # names the file directly when the watchdog pattern regresses)
-    findings = lint_paths(
-        [os.path.join(PACKAGE_DIR, "serving", "degrade.py")]
-    )
-    assert findings == [], "\n".join(f.render() for f in findings)
+    # scoped scans so a violation names the file directly when the
+    # watchdog / retrainer-publication patterns regress
+    for mod in ("degrade.py", "drift.py", "retrain.py"):
+        findings = lint_paths(
+            [os.path.join(PACKAGE_DIR, "serving", mod)]
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
 
 
 # the degrade watchdog's shape: a worker thread executing handed-off
@@ -505,6 +512,75 @@ def test_lock_discipline_covers_watchdog_state_machine(tmp_path):
 def test_lock_discipline_clean_watchdog_state_machine(tmp_path):
     assert run_rule(tmp_path, LockDisciplineRule,
                     LOCK_WATCHDOG_NEGATIVE) == []
+
+
+# the drift retrainer's shape: a background fit thread publishing its
+# result — the candidate checkpoint path — back to the serve thread
+# that polls for it. Written WITHOUT the lock it is exactly the
+# publication race lock-discipline must catch: the worker stores the
+# path/state while the serve thread's poll()/take() read and retract
+# them, and a torn read hands the serve thread a half-published
+# candidate.
+LOCK_RETRAIN_POSITIVE = """
+    import threading
+
+    class BadRetrainer:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = "idle"
+            self._candidate_path = None
+
+        def submit(self, fn):
+            self._state = "running"
+            threading.Thread(target=self._run, args=(fn,)).start()
+
+        def _run(self, fn):
+            path = fn()
+            self._candidate_path = path
+            self._state = "done"
+
+        def poll(self):
+            return (self._state, self._candidate_path)
+"""
+
+LOCK_RETRAIN_NEGATIVE = """
+    import threading
+
+    class Retrainer:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = "idle"
+            self._candidate_path = None
+
+        def submit(self, fn):
+            with self._lock:
+                self._state = "running"
+            threading.Thread(target=self._run, args=(fn,)).start()
+
+        def _run(self, fn):
+            path = fn()
+            with self._lock:
+                self._candidate_path = path
+                self._state = "done"
+
+        def poll(self):
+            with self._lock:
+                return (self._state, self._candidate_path)
+"""
+
+
+def test_lock_discipline_covers_retrainer_publication(tmp_path):
+    findings = run_rule(tmp_path, LockDisciplineRule,
+                        LOCK_RETRAIN_POSITIVE)
+    flagged = {f.message.split("'")[1] for f in findings}
+    # the fit thread stores both the candidate path and the state flag;
+    # submit()/poll() touch them without the lock — all flagged
+    assert {"self._candidate_path", "self._state"} <= flagged
+
+
+def test_lock_discipline_clean_retrainer_publication(tmp_path):
+    assert run_rule(tmp_path, LockDisciplineRule,
+                    LOCK_RETRAIN_NEGATIVE) == []
 
 
 # ---------------------------------------------------------------------------

@@ -268,6 +268,72 @@ def test_save_rotating_sweeps_orphaned_temps(tmp_path):
     assert sorted(os.listdir(d)) == ["ckpt-000000002.npz"]
 
 
+def test_rotation_serializes_concurrent_save_and_prune(tmp_path):
+    """The rotation race the drift retrainer exposed: two in-process
+    writers rotating the same directory could interleave — writer B's
+    sweep_stale_tmp collecting writer A's in-flight temp as an
+    'orphan', or B's keep-N prune (listed pre-commit) unlinking A's
+    just-committed member. The per-directory rotation lock serializes
+    whole passes: while A is mid-save, B's pass (sweep + save + prune)
+    must BLOCK, and both checkpoints must commit."""
+    import threading
+
+    d = str(tmp_path / "rot")
+    eng_a = FlowStateEngine(capacity=16)
+    _tick(eng_a, 1, 4)
+    eng_b = FlowStateEngine(capacity=16)
+    _tick(eng_b, 1, 4)
+
+    in_save = threading.Event()
+    release = threading.Event()
+    real_save = sc.save
+
+    def slow_save(engine, path, feature_reference=None):
+        # only writer A (tick 5) pauses mid-rotation; writer B's save
+        # runs untouched so the test can't deadlock on the patch
+        if path.endswith("ckpt-000000005.npz"):
+            in_save.set()
+            assert release.wait(timeout=30)
+        return real_save(engine, path, feature_reference)
+
+    done_b = threading.Event()
+    results = {}
+
+    def writer_a():
+        results["a"] = sc.save_rotating(eng_a, d, tick=5, keep=2)
+
+    def writer_b():
+        results["b"] = sc.save_rotating(eng_b, d, tick=6, keep=2)
+        done_b.set()
+
+    orig = sc.save
+    sc.save = slow_save
+    try:
+        ta = threading.Thread(target=writer_a, daemon=True)
+        ta.start()
+        assert in_save.wait(timeout=30)  # A is mid-rotation
+        tb = threading.Thread(target=writer_b, daemon=True)
+        tb.start()
+        # B must be BLOCKED on the rotation lock while A is mid-save —
+        # without the lock it would race straight through (and its
+        # sweep would have collected A's temp)
+        assert not done_b.wait(timeout=0.3)
+        release.set()
+        ta.join(timeout=30)
+        assert done_b.wait(timeout=30)
+        tb.join(timeout=30)
+    finally:
+        sc.save = orig
+        release.set()
+    # both passes committed; the interleaving lost nothing
+    assert sorted(os.listdir(d)) == [
+        "ckpt-000000005.npz", "ckpt-000000006.npz"
+    ]
+    assert sc.resolve_latest(d) == sc.checkpoint_path(d, 6)
+    sc.validate(results["a"][0])
+    sc.validate(results["b"][0])
+
+
 def test_v1_checkpoint_reports_old_format_not_corruption(tmp_path):
     """A genuine pre-checksum (v1) file has no crc32 entry; it must be
     diagnosed as old-format, not accused of corruption."""

@@ -43,6 +43,7 @@ GROUPS_KEYS=(
   "native:native_load or native_checkpoint"
   "pipeline:pipeline_handoff or pipeline_coalesce"
   "degrade:degrade_dispatch or degrade_probe"
+  "drift:drift_window or retrain_fit or promote_swap or promote_rollback or drift_loop"
 )
 
 fail=0

@@ -296,6 +296,69 @@ def _build_parser() -> argparse.ArgumentParser:
         "resets the chain",
     )
     p.add_argument(
+        "--drift", choices=("auto", "off"), default="off",
+        help="online drift loop (serving/drift.py): monitor the live "
+        "feature stream against a training-time reference, retrain in "
+        "the background on sustained divergence, and hot-promote the "
+        "fresh checkpoint through a parity-gated probe — wrong-but-"
+        "fresh never promotes, a bad promotion rolls back. 'auto' "
+        "enables it for single-device serves (sharded serves are "
+        "skipped); with no drift the output is byte-identical to "
+        "'off'. Requires --drift-dir",
+    )
+    p.add_argument(
+        "--drift-dir", default=None, metavar="DIR",
+        help="candidate checkpoint rotation for the drift loop: the "
+        "boot model is seeded here (staged-commit save), retrained "
+        "candidates land as model-<seq> members, and rollback resolves "
+        "the newest member that still loads",
+    )
+    p.add_argument(
+        "--drift-window", type=int, default=8, metavar="N",
+        help="observations (render ticks) per drift window (default 8)",
+    )
+    p.add_argument(
+        "--drift-threshold", type=float, default=4.0, metavar="Z",
+        help="drift score a window must exceed to count as divergent: "
+        "max over features of the EWMA z-shift vs the reference "
+        "(default 4.0)",
+    )
+    p.add_argument(
+        "--drift-trips", type=int, default=3, metavar="K",
+        help="consecutive over-threshold windows before the retrain "
+        "trips (default 3; one noisy window never retrains)",
+    )
+    p.add_argument(
+        "--drift-class-tolerance", type=float, default=0.2,
+        metavar="FRAC",
+        help="class-mix sensitivity: a window's max per-class "
+        "frequency delta vs the reference is divided by this before "
+        "comparing to --drift-threshold (default 0.2, so a full "
+        "label-mix inversion scores 5.0 — above the default "
+        "threshold; values >= 1/threshold make class-mix drift "
+        "undetectable)",
+    )
+    p.add_argument(
+        "--drift-probe-successes", type=int, default=3, metavar="N",
+        help="consecutive clean parity probes a candidate checkpoint "
+        "needs before hot promotion (default 3)",
+    )
+    p.add_argument(
+        "--drift-parity", type=float, default=1.0, metavar="FRAC",
+        help="minimum probe agreement between the candidate's labels "
+        "and the live model's on the shadow batch for a probe to "
+        "count as clean (default 1.0 — exact parity; loosen for "
+        "families whose refit legitimately disagrees near decision "
+        "boundaries). kmeans compares mode-matched (cluster ids are a "
+        "permutation), so the default applies there too",
+    )
+    p.add_argument(
+        "--retrain-deadline", type=float, default=300.0, metavar="SECS",
+        help="abandon a background retrain that outlives this many "
+        "seconds (default 300; the serve keeps the old model and the "
+        "loop resumes watching)",
+    )
+    p.add_argument(
         "--warmup", action="store_true",
         help="AOT-compile the serving programs at startup "
         "(serving/warmup.py: donated scatter per batch bucket, feature "
@@ -418,6 +481,11 @@ def _run_classify(args) -> None:
         sys.exit("--serve-checkpoint-every needs --serve-checkpoint-dir")
     if args.obs_dump_on_exit and not args.obs_dir:
         sys.exit("--obs-dump-on-exit needs --obs-dir (the dump target)")
+    if args.drift != "off" and not sharded and not args.drift_dir:
+        sys.exit(
+            "--drift auto needs --drift-dir (the candidate checkpoint "
+            "rotation and rollback target)"
+        )
 
     name = SUBCOMMAND_ALIASES[args.subcommand]
     if args.native_checkpoint:
@@ -539,6 +607,81 @@ def _run_classify(args) -> None:
             file=sys.stderr,
         )
 
+    # Drift loop (serving/drift.py): wraps the (possibly ladder-
+    # guarded) predict in a DriftGate — a transparent passthrough until
+    # the first promotion, the hot-swap point after it. Built AFTER
+    # warmup so warmup primes the BOOT model's programs (a candidate's
+    # serving program compiles during its parity probes — the exact
+    # serving shape — so the first post-swap tick is already warm).
+    # 'auto' skips sharded serves: the sharded engine binds its predict
+    # at construction, so there is no single swap point to promote into.
+    drift = None
+    degrade_surface = degrade  # what the render/healthz paths consult
+    if args.drift != "off" and not sharded:
+        from .serving.drift import (
+            DriftController,
+            DriftGate,
+            GateLadderView,
+        )
+
+        from .serving.drift import default_build_serving
+
+        gate = DriftGate(predict)
+        _build_bare = default_build_serving(
+            name, tuple(model.classes.names)
+        )
+
+        def _build_promoted(params):
+            """Candidate params → the serving pair a promotion installs:
+            the default resolution (models.serving_path + jit rule),
+            PLUS the degradation ladder when --degrade engaged — a
+            promoted checkpoint must keep the watchdog/fallback
+            guarantees, not silently shed them at the first swap."""
+            pred, p = _build_bare(params)
+            if degrade is None or getattr(pred, "host_native", False):
+                return pred, p
+            from .models import resolve_fallback
+            from .serving.degrade import DegradeLadder
+
+            return DegradeLadder(
+                pred, resolve_fallback(name, params),
+                deadline=args.device_deadline,
+                probe_every=args.probe_every,
+                probe_successes=args.probe_successes,
+                metrics=m, recorder=recorder,
+            ), p
+
+        drift = DriftController(
+            gate,
+            family=name,
+            classes=tuple(model.classes.names),
+            directory=args.drift_dir,
+            window=args.drift_window,
+            threshold=args.drift_threshold,
+            trips=args.drift_trips,
+            class_tolerance=args.drift_class_tolerance,
+            probe_successes=args.drift_probe_successes,
+            parity_min=args.drift_parity,
+            # a refit clustering orders its centroids arbitrarily —
+            # raw kmeans cluster ids are a permutation of the live
+            # model's, so parity must mode-match before comparing
+            parity_mode=(
+                "mode-matched" if name == "kmeans" else "exact"
+            ),
+            retrain_deadline=args.retrain_deadline,
+            reference=getattr(engine, "feature_reference", None),
+            build_serving=_build_promoted,
+            boot_params=model.params,
+            metrics=m,
+            recorder=recorder,
+        )
+        predict = gate
+        if degrade is not None:
+            # promotions rebuild the ladder around the new kernel, so
+            # the render STALE column and /healthz must follow the
+            # gate's CURRENT ladder, not the boot object
+            degrade_surface = GateLadderView(gate, degrade)
+
     server = None
     health = None
     probe_out: dict = {}
@@ -551,10 +694,18 @@ def _run_classify(args) -> None:
                 args.obs_checkpoint_stale_after or None
             ),
         )
-        if degrade is not None:
+        health.model_loaded()  # the model_age_s staleness anchor
+        if degrade_surface is not None:
             # /healthz reports 200-but-degraded with the ladder rung —
-            # a degraded serve still answers every tick
-            health.set_degrade(degrade.status)
+            # a degraded serve still answers every tick (the surface
+            # follows promotions when the drift loop is on)
+            health.set_degrade(degrade_surface.status)
+        if drift is not None:
+            # the drift loop's self-report + promotion timestamps: an
+            # operator can tell "healthy but ancient" from "freshly
+            # promoted" by model_age_s alone
+            health.set_drift(drift.status)
+            drift.set_health(health)
         server = ExpositionServer(
             m, recorder=recorder, health=health, port=args.obs_port,
             host=args.obs_host,
@@ -595,7 +746,8 @@ def _run_classify(args) -> None:
             _serve_loop(args, engine, model, predict, serve_params, m,
                         sharded, use_native, dropped_seen=0,
                         tracer=tracer, recorder=recorder, health=health,
-                        probe_out=probe_out, degrade=degrade)
+                        probe_out=probe_out, degrade=degrade_surface,
+                        drift=drift)
     except BaseException as e:
         # the crash-forensics moment: record the terminal exception and
         # freeze the ring — safely outside any signal-handler frame.
@@ -628,8 +780,12 @@ def _run_classify(args) -> None:
     finally:
         if server is not None:
             server.stop()
-        if degrade is not None:
-            degrade.close()
+        if degrade_surface is not None:
+            # the view closes both the live (possibly promoted) ladder
+            # and the boot one; without drift it IS the boot ladder
+            degrade_surface.close()
+        if drift is not None:
+            drift.close()
         if sigterm_hooked:
             signal.signal(signal.SIGTERM, prev_sigterm)
         # the checkpoint must survive EVERY exit, including Ctrl-C on a
@@ -638,7 +794,13 @@ def _run_classify(args) -> None:
         if args.save_serve_state:
             from .io import serving_checkpoint as _sc
 
-            _sc.save(engine, args.save_serve_state)
+            _sc.save(
+                engine, args.save_serve_state,
+                feature_reference=(
+                    drift.reference_arrays()
+                    if drift is not None else None
+                ),
+            )
             print(
                 f"saved serving state ({engine.num_flows()} tracked "
                 f"flows) to {args.save_serve_state}",
@@ -661,7 +823,7 @@ def _dump_flight(recorder, obs_dir, reason: str) -> None:
 
 
 def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
-                     recorder=None, health=None) -> None:
+                     recorder=None, health=None, drift=None) -> None:
     """Periodic in-loop serving snapshot (between ticks, state flushed).
 
     The wall-clock budget guard keeps checkpointing from starving the
@@ -697,6 +859,13 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
             _, nbytes = _sc.save_rotating(
                 engine, args.serve_checkpoint_dir, tick=ticks,
                 keep=args.serve_checkpoint_keep,
+                # the drift reference rides in the snapshot (format v3)
+                # so a restored serve resumes detection against the
+                # same training-time distribution
+                feature_reference=(
+                    drift.reference_arrays()
+                    if drift is not None else None
+                ),
             )
     except FaultInjected:
         raise
@@ -723,7 +892,8 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
 
 def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 use_native, dropped_seen, tracer, recorder=None,
-                health=None, probe_out=None, degrade=None) -> None:
+                health=None, probe_out=None, degrade=None,
+                drift=None) -> None:
     from .utils.profiling import trace
 
     # Pipelined serving (serving/pipeline.py): the host stage (this
@@ -828,7 +998,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 serve_params, m, tracer, pipe,
                                 feature_stage, sharded,
                                 evict_state=evict_state,
-                                degrade=degrade,
+                                degrade=degrade, drift=drift,
                             )
                         elif sharded:
                             # the sharded tick's whole read side
@@ -864,13 +1034,17 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                     serve_params, args, tracer,
                                     degrade=degrade,
                                 )
+                            if drift is not None:
+                                # off the hot path: the tick's labels
+                                # are already rendered
+                                drift.poll()
                     if (args.serve_checkpoint_every
                             and ticks % args.serve_checkpoint_every == 0):
                         with tracer.span("snapshot"):
                             _snapshot_if_due(
                                 args, engine, m, tick_base + ticks,
                                 loop_t0, recorder=recorder,
-                                health=health,
+                                health=health, drift=drift,
                             )
                 if args.metrics_every and ticks % args.metrics_every == 0:
                     print(m.report(), file=sys.stderr, flush=True)
@@ -893,7 +1067,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
 
 def _dispatch_render(args, engine, model, predict, serve_params, m,
                      tracer, pipe, feature_stage, sharded,
-                     evict_state=None, degrade=None) -> None:
+                     evict_state=None, degrade=None, drift=None) -> None:
     """Host-stage half of one pipelined render tick: dispatch the read
     side against THIS tick's table and stage the device-stage job.
     Output is byte-identical to the serial render of the same tick —
@@ -981,6 +1155,10 @@ def _dispatch_render(args, engine, model, predict, serve_params, m,
                                   stale=stale)
                 else:
                     _print_full(model, rows, stale=stale)
+        if drift is not None:
+            # the device-stage worker's idle time: the tick's frame is
+            # already printed, the next render is not yet staged
+            drift.poll()
 
     pipe.submit(job)
 

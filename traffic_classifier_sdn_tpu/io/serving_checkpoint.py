@@ -38,10 +38,28 @@ Durability (the crash-safety layer):
   (``ckpt-000000123.npz``) with keep-N pruning, and ``resolve_latest``
   returns the newest file that *passes validation* — a corrupt newest
   checkpoint means rollback to the previous one, not a crash.
+- Rotation (sweep + save + prune) is serialized **per directory**
+  behind an in-process lock. ``sweep_stale_tmp`` and keep-N pruning
+  assume exactly one rotation pass in flight: a second in-process
+  writer (an embedding caller snapshotting from its own thread — the
+  kind of background writer the drift loop's threading makes easy to
+  add) could otherwise have its in-flight temp swept as an "orphan",
+  or its freshly committed member pruned by a pass that listed the
+  directory pre-commit. The shipped CLI serves rotate from one thread
+  today; the lock makes the single-writer assumption a guarantee
+  instead of a convention (regression-tested by interleaving two
+  rotation passes).
 - Fault sites (utils/faults.py): ``serving_ckpt.write`` between temp
   write and rename, ``serving_ckpt.rename`` at the rename, and
   ``serving_ckpt.restore`` at restore entry. tests/test_chaos.py kills
   saves mid-write and proves the rollback + replay-convergence story.
+
+Format v3 adds an optional ``feature_reference`` block — the drift
+monitor's training-time per-feature/per-class population statistics
+(serving/drift.py) — so a restored serve resumes drift detection
+against the same reference instead of re-calibrating on already-drifted
+traffic. v2 checkpoints (no block) still load; restore then reports no
+reference and the monitor re-calibrates.
 """
 
 from __future__ import annotations
@@ -49,6 +67,7 @@ from __future__ import annotations
 import io
 import os
 import re
+import threading
 import zipfile
 import zlib
 
@@ -59,9 +78,32 @@ from ..core import flow_table as ft
 from ..utils.atomicio import atomic_write_bytes, sweep_stale_tmp
 from ..utils.faults import fault_point
 
-FORMAT_VERSION = 2
+FORMAT_VERSION = 3
+# oldest format this build still restores (v1 predates the content
+# checksum and is rejected as old-format, never as corruption)
+MIN_FORMAT_VERSION = 2
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+_REF_PREFIX = "feature_reference/"
+
+# Per-directory rotation locks: keep-N pruning and sweep_stale_tmp
+# assume a single rotation pass in flight — serialize whole passes per
+# directory so a second in-process writer (embedding callers; any
+# future background snapshot path) cannot have its temp swept or its
+# fresh member pruned mid-commit. Process-local by design: the rotation
+# contract has always been single-process-per-directory; this turns the
+# single-THREAD assumption into a guarantee.
+_dir_locks: dict[str, threading.Lock] = {}
+_dir_locks_guard = threading.Lock()
+
+
+def _rotation_lock(directory: str) -> threading.Lock:
+    key = os.path.abspath(directory)
+    with _dir_locks_guard:
+        lock = _dir_locks.get(key)
+        if lock is None:
+            lock = _dir_locks[key] = threading.Lock()
+        return lock
 
 
 class CorruptCheckpointError(ValueError):
@@ -97,11 +139,16 @@ def _content_crc(data: dict) -> int:
     return crc & 0xFFFFFFFF
 
 
-def save(engine, path: str) -> int:
+def save(engine, path: str, feature_reference: dict | None = None) -> int:
     """One ``.npz`` with the full serving state, written atomically with
     an embedded content checksum. Call between ticks (all pending records
     stepped) — pending host-side rows are not captured. Returns the byte
-    size of the written checkpoint (the metrics feed)."""
+    size of the written checkpoint (the metrics feed).
+
+    ``feature_reference`` (a flat name→array dict, the drift monitor's
+    reference population statistics) is embedded under the
+    ``feature_reference/`` key prefix and covered by the same content
+    CRC; ``restore`` hands it back on the engine."""
     engine.step()  # flush: the device table is the only counter state
     data: dict = {
         "format_version": FORMAT_VERSION,
@@ -110,6 +157,9 @@ def save(engine, path: str) -> int:
         "last_time": int(engine.last_time),
         "tick_floor": int(engine._tick_floor),
     }
+    if feature_reference:
+        for key, value in feature_reference.items():
+            data[f"{_REF_PREFIX}{key}"] = np.asarray(value)
     for name in _TABLE_LEAVES:
         data[f"table/{name}"] = np.asarray(_get_leaf(engine.table, name))
 
@@ -177,25 +227,31 @@ def list_checkpoints(directory: str) -> list[tuple[int, str]]:
     return out
 
 
-def save_rotating(engine, directory: str, tick: int, keep: int = 3) -> tuple[str, int]:
+def save_rotating(engine, directory: str, tick: int, keep: int = 3,
+                  feature_reference: dict | None = None) -> tuple[str, int]:
     """Atomic tick-numbered checkpoint + keep-N pruning.
 
     Pruning runs *after* the new checkpoint commits and never trims below
     ``keep`` survivors, so a corrupt newest file always leaves a valid
-    predecessor for ``resolve_latest`` to roll back to. Returns
+    predecessor for ``resolve_latest`` to roll back to. The whole pass
+    (sweep + save + prune) holds the directory's rotation lock: a
+    concurrent in-process writer's half-written temp must not be swept
+    as an orphan, and its just-committed member must not be pruned by a
+    rotation that listed the directory before the commit. Returns
     ``(path, bytes_written)``."""
     os.makedirs(directory, exist_ok=True)
-    # collect orphaned temps from SIGKILLed predecessors — a real kill
-    # can't run atomic_write_bytes's cleanup, and the rotation's pruning
-    # only matches committed ckpt-*.npz names
-    sweep_stale_tmp(directory)
-    path = checkpoint_path(directory, tick)
-    n = save(engine, path)
-    for _, old in list_checkpoints(directory)[max(keep, 1):]:
-        try:
-            os.unlink(old)
-        except OSError:
-            pass  # pruning is advisory; never fail a save over it
+    with _rotation_lock(directory):
+        # collect orphaned temps from SIGKILLed predecessors — a real
+        # kill can't run atomic_write_bytes's cleanup, and the
+        # rotation's pruning only matches committed ckpt-*.npz names
+        sweep_stale_tmp(directory)
+        path = checkpoint_path(directory, tick)
+        n = save(engine, path, feature_reference=feature_reference)
+        for _, old in list_checkpoints(directory)[max(keep, 1):]:
+            try:
+                os.unlink(old)
+            except OSError:
+                pass  # pruning is advisory; never fail a save over it
     return path, n
 
 
@@ -212,11 +268,14 @@ def _load_validated(path: str) -> dict:
                     f"missing format_version"
                 )
             # format first: a genuine pre-checksum (v1) file is an
-            # old-format error, not a corruption claim
-            if int(z["format_version"]) != FORMAT_VERSION:
+            # old-format error, not a corruption claim. v2 (no
+            # feature_reference block) still loads — backward compat.
+            version = int(z["format_version"])
+            if not MIN_FORMAT_VERSION <= version <= FORMAT_VERSION:
                 raise ValueError(
-                    f"serving checkpoint format {int(z['format_version'])}"
-                    f" != {FORMAT_VERSION} ({path})"
+                    f"serving checkpoint format {version} unsupported "
+                    f"(this build reads {MIN_FORMAT_VERSION}.."
+                    f"{FORMAT_VERSION}) ({path})"
                 )
             if "crc32" not in keys:
                 raise CorruptCheckpointError(
@@ -363,4 +422,13 @@ def restore(path: str, buckets=None, recorder=None):
         idx.next_slot = next_slot
     eng._last_time = last_time
     eng._tick_floor = int(z["tick_floor"])
+    # v3 drift reference (absent in v2 checkpoints): handed back on the
+    # engine so the CLI can re-seed the drift monitor — a restored serve
+    # must not re-calibrate its reference on already-drifted traffic
+    reference = {
+        k[len(_REF_PREFIX):]: np.asarray(v)
+        for k, v in z.items()
+        if k.startswith(_REF_PREFIX)
+    }
+    eng.feature_reference = reference or None
     return eng

@@ -118,9 +118,35 @@ class HealthState:
         self._started_at = clock()
         self._last_tick_at: float | None = None
         self._last_checkpoint_at: float | None = None
+        self._model_loaded_at: float | None = None
+        self._model_promoted_at: float | None = None
         self._ticks = 0
         self._probe = None
         self._degrade = None
+        self._drift = None
+
+    def model_loaded(self) -> None:
+        """The serve registered its boot model — the ``model_age_s``
+        staleness anchor. A serve that never promotes reports its age
+        from here, so 'healthy but ancient' is visible without the
+        drift loop being on at all."""
+        with self._lock:
+            self._model_loaded_at = self._clock()
+
+    def model_promoted(self) -> None:
+        """A fresh checkpoint was hot-promoted (serving/drift.py):
+        ``model_age_s`` re-anchors here and
+        ``model_promoted_age_s`` starts reporting."""
+        with self._lock:
+            self._model_promoted_at = self._clock()
+
+    def set_drift(self, status_fn) -> None:
+        """``status_fn() -> dict`` (serving/drift.DriftController
+        .status): the drift loop's self-report, folded into /healthz as
+        a ``drift`` object — state machine position, score, and the
+        retrain/promotion/rollback counters."""
+        with self._lock:
+            self._drift = status_fn
 
     def set_degrade(self, status_fn) -> None:
         """``status_fn() -> dict`` (serving/degrade.DegradeLadder.status):
@@ -156,6 +182,9 @@ class HealthState:
             ticks = self._ticks
             probe = self._probe
             degrade = self._degrade
+            drift = self._drift
+            model_loaded = self._model_loaded_at
+            model_promoted = self._model_promoted_at
             started = self._started_at
         tick_age = now - (last_tick if last_tick is not None else started)
         stale = tick_age > self.max_tick_age_s
@@ -195,6 +224,22 @@ class HealthState:
             ),
             "max_checkpoint_age_s": self.max_checkpoint_age_s,
             "checkpoint_stale": ckpt_stale,
+            # model staleness relative to the live stream: age since
+            # the last promotion (or boot load, before any) — an
+            # operator distinguishes "healthy but ancient" from
+            # "freshly promoted" without correlating logs
+            "model_age_s": (
+                None if model_loaded is None else round(
+                    now - (
+                        model_promoted if model_promoted is not None
+                        else model_loaded
+                    ), 6,
+                )
+            ),
+            "model_promoted_age_s": (
+                None if model_promoted is None
+                else round(now - model_promoted, 6)
+            ),
         }
         if probe_error is not None:
             report["collector_probe_error"] = probe_error
@@ -208,6 +253,11 @@ class HealthState:
             # it stays "healthy" for the restart-probe — the rung is
             # the alerting signal, not a reason to kill the process
             report["degraded"] = dstatus.get("state") != "HEALTHY"
+        if drift is not None:
+            try:
+                report["drift"] = drift()
+            except Exception as e:  # noqa: BLE001 — health must not crash
+                report["drift"] = {"state": "unknown", "error": str(e)}
         return healthy, report
 
 

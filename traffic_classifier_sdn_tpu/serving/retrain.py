@@ -1,0 +1,271 @@
+"""Background retraining + candidate checkpoint rotation for the drift
+loop (serving/drift.py).
+
+Two halves:
+
+- **Fitting** (``fit_family``): a fresh checkpoint for any of the six
+  model families from the drift monitor's recent labeled window. The
+  families with distributed trainers (gnb, kmeans, forest, svc) route
+  through ``train/distributed.py`` on a single-device ``(1, 1)`` mesh —
+  the same code path that scales the fit across chips when the window
+  outgrows one device — and logreg/knn use their canonical
+  ``train/<family>.fit``. The ``retrain.fit`` fault site sits at the
+  entry so the chaos suite can kill a refit mid-fit and prove the serve
+  keeps the old model.
+
+- **Candidate rotation**: fitted candidates are written through
+  ``io/checkpoint.save_model`` — the staged-arrays + atomic-manifest
+  commit path, so a crash mid-save can never publish a half-written
+  candidate — into tick-ordered ``model-<seq>`` directories under the
+  drift directory. ``resolve_latest`` returns the newest candidate that
+  actually LOADS (mirroring ``io/serving_checkpoint.resolve_latest``'s
+  rollback semantics): a bad promotion discards its candidate and
+  reloads through here, so the old model keeps serving. The rotation is
+  seeded with the boot model at drift-enable time, which is what makes
+  "roll back" well-defined before any promotion has ever happened.
+
+``BackgroundRetrainer`` runs one fit at a time on a daemon worker with
+the ``DeviceWatchdog`` abandon discipline (serving/degrade.py): the
+caller polls, and a fit that outlives its deadline is ABANDONED — the
+generation counter bumps, the worker's late result is discarded when it
+eventually lands, and the loop returns to watching the stream. The
+deadline itself is enforced by the caller's injectable clock
+(serving/drift.DriftController), so tests pin the exact abandon tick
+without sleeping.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import threading
+
+import numpy as np
+
+from ..utils import faults
+
+_MODEL_RE = re.compile(r"^model-(\d+)$")
+
+# BackgroundRetrainer states
+IDLE = "idle"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+
+
+# ---------------------------------------------------------------------------
+# fitting
+# ---------------------------------------------------------------------------
+
+
+def fit_family(family: str, X, y, n_classes: int, **kw):
+    """Fit fresh ``family`` params from the labeled window ``(X, y)``.
+
+    gnb/kmeans/forest/svc go through ``train/distributed.py`` on a
+    single-device mesh; logreg/knn use their canonical trainers (no
+    distributed variant exists). ``kw`` forwards family-specific knobs
+    (e.g. ``n_trees`` for forest). Raises whatever the trainer raises —
+    the caller (the background worker) owns failure semantics."""
+    faults.fault_point("retrain.fit")
+    import jax
+    import jax.numpy as jnp
+
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.int32)
+    if family in ("gnb", "kmeans", "forest", "svc"):
+        from ..parallel import mesh as meshlib
+        from ..train import distributed as dist
+
+        mesh = meshlib.make_mesh(
+            n_data=1, n_state=1, devices=jax.devices()[:1]
+        )
+        if family == "gnb":
+            return dist.fit_gnb(mesh, X, y, n_classes, **kw)
+        if family == "kmeans":
+            params, _inertia = dist.fit_kmeans(
+                mesh, X, k=n_classes, **kw
+            )
+            return params
+        if family == "forest":
+            return dist.fit_forest(mesh, X, y, n_classes, **kw)
+        return dist.fit_svc(mesh, X, y, n_classes, **kw)
+    if family == "logreg":
+        from ..train import logreg as t
+
+        return t.fit(jnp.asarray(X), jnp.asarray(y), n_classes, **kw)
+    if family == "knn":
+        from ..train import knn as t
+
+        kw.setdefault("n_neighbors", 5)
+        return t.fit(
+            jnp.asarray(X), jnp.asarray(y), n_classes=n_classes, **kw
+        )
+    raise ValueError(f"unknown model family {family!r}")
+
+
+# ---------------------------------------------------------------------------
+# candidate rotation
+# ---------------------------------------------------------------------------
+
+
+def candidate_path(directory: str, seq: int) -> str:
+    return os.path.join(directory, f"model-{seq:09d}")
+
+
+def list_candidates(directory: str) -> list[tuple[int, str]]:
+    """``(seq, path)`` for every rotation member, newest seq first."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        m = _MODEL_RE.match(name)
+        if m and os.path.isdir(os.path.join(directory, name)):
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def next_seq(directory: str) -> int:
+    members = list_candidates(directory)
+    return members[0][0] + 1 if members else 0
+
+
+def save_candidate(directory: str, seq: int, family: str, params,
+                   classes) -> str:
+    """Write one candidate through the atomic staged-commit model
+    checkpoint path (io/checkpoint.save_model). Returns its path."""
+    from ..io import checkpoint as ck
+
+    path = candidate_path(directory, seq)
+    ck.save_model(path, family, params, classes=list(classes))
+    return path
+
+
+def load_candidate(path: str):
+    """``io/checkpoint.load_model`` → models.LoadedModel (canonical
+    params + classes); raises on a missing/garbage candidate."""
+    from ..io import checkpoint as ck
+
+    return ck.load_model(path)
+
+
+def discard_candidate(path: str) -> None:
+    """Remove a rejected/rolled-back candidate so ``resolve_latest``
+    can never hand it back."""
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def _resolve_and_load(directory: str):
+    """Newest rotation member that LOADS, with its loaded content —
+    the rollback read path decodes the winner exactly once. Members
+    that fail to load are skipped on the way down (the
+    serving-checkpoint rollback semantics, applied to model dirs)."""
+    for _, path in list_candidates(directory):
+        try:
+            return path, load_candidate(path)
+        except Exception:  # noqa: BLE001 — any unloadable member is skipped
+            continue
+    return None, None
+
+
+def resolve_latest(directory: str) -> str | None:
+    """The newest candidate checkpoint that actually loads — a corrupt
+    or discarded newest member means rollback to its predecessor (the
+    boot seed at minimum), never a crash. None when the rotation holds
+    nothing loadable."""
+    return _resolve_and_load(directory)[0]
+
+
+def prune_candidates(directory: str, keep: int = 3) -> None:
+    """Keep the newest ``keep`` members; pruning is advisory (a failed
+    unlink must never fail a promotion)."""
+    for _, old in list_candidates(directory)[max(keep, 1):]:
+        shutil.rmtree(old, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# the background worker
+# ---------------------------------------------------------------------------
+
+
+class BackgroundRetrainer:
+    """One background fit at a time, abandonable.
+
+    ``submit(fn)`` starts a daemon worker running ``fn(is_current)``,
+    where ``is_current()`` reports whether this generation is still the
+    live one — the job checks it before PUBLISHING side effects (the
+    candidate checkpoint save), so an abandoned fit leaves no stray in
+    the rotation. The caller polls for ``DONE``/``FAILED`` and consumes
+    the terminal state with ``take``. ``abandon`` bumps the generation
+    so a worker that outlived its deadline publishes into the void when
+    it eventually returns — the same discard-late-results discipline as
+    ``serving.degrade.DeviceWatchdog``, minus the blocking wait (the
+    drift loop must keep serving while the fit runs)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gen = 0
+        self._state = IDLE
+        self._result = None
+        self._error: BaseException | None = None
+
+    def submit(self, fn) -> None:
+        with self._lock:
+            if self._state == RUNNING:
+                raise RuntimeError("a retrain is already running")
+            self._gen += 1
+            gen = self._gen
+            self._state = RUNNING
+            self._result = None
+            self._error = None
+        threading.Thread(
+            target=self._run, args=(gen, fn), name="tcsdn-retrain",
+            daemon=True,
+        ).start()
+
+    def _is_current(self, gen: int) -> bool:
+        with self._lock:
+            return gen == self._gen
+
+    def _run(self, gen: int, fn) -> None:
+        try:
+            out = fn(lambda: self._is_current(gen))
+        except BaseException as e:  # noqa: BLE001 — published to the poller
+            with self._lock:
+                if gen == self._gen and self._state == RUNNING:
+                    self._state = FAILED
+                    self._error = e
+            return
+        with self._lock:
+            if gen == self._gen and self._state == RUNNING:
+                # an abandoned generation publishes nothing here, and
+                # the job's own is_current() check keeps it from
+                # committing a candidate into the rotation either
+                self._state = DONE
+                self._result = out
+
+    def poll(self) -> str:
+        with self._lock:
+            return self._state
+
+    def take(self):
+        """Consume a terminal state: ``(state, result, error)``, reset
+        to IDLE. Call only after ``poll`` reports DONE/FAILED."""
+        with self._lock:
+            state, result, error = self._state, self._result, self._error
+            self._state = IDLE
+            self._result = None
+            self._error = None
+            return state, result, error
+
+    def abandon(self) -> None:
+        """Discard the in-flight fit (deadline expiry): its eventual
+        result is dropped by the generation check."""
+        with self._lock:
+            self._gen += 1
+            self._state = IDLE
+            self._result = None
+            self._error = None

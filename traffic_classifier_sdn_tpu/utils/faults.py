@@ -106,6 +106,29 @@ SITES: dict[str, str] = {
         "backoff (chaos must cover the failed-recovery path, not just "
         "the clean re-promotion)"
     ),
+    "drift.window": (
+        "serving/drift.DriftController window observation — the "
+        "off-hot-path materialization/stats update for one observed "
+        "batch fails; ABSORBED: the observation is dropped (counted in "
+        "drift_window_errors) and the serve tick's output is unaffected"
+    ),
+    "retrain.fit": (
+        "serving/retrain.fit_family entry — the background refit "
+        "itself dies mid-fit; ABSORBED by the drift controller: the "
+        "retrain run is marked failed, the serve keeps the old model, "
+        "and a still-drifting stream re-trips later"
+    ),
+    "promote.swap": (
+        "serving/drift.DriftController promotion — the hot swap of the "
+        "candidate into the live predict path fails; ABSORBED: the "
+        "controller rolls back via serving/retrain.resolve_latest and "
+        "the old model keeps serving every tick"
+    ),
+    "promote.rollback": (
+        "serving/drift.DriftController rollback — the rollback reload "
+        "itself fails; ABSORBED: the gate keeps the pair it already "
+        "holds (the old model), so serving continues regardless"
+    ),
 }
 
 
