@@ -37,6 +37,49 @@ os.environ.setdefault(
 )
 
 
+# The locktrace runtime witness (utils/locktrace.py) rides the suites
+# that already drive real multi-thread schedules — chaos, degrade,
+# drift, and pipeline — so every schedule they exercise doubles as
+# lock-ordering evidence (the TSan gate covers the C++; this is the
+# Python side). TCSDN_LOCKTRACE=1 (tools/chaos_matrix.sh sets it)
+# widens the witness to every test module.
+LOCKTRACE_SUITES = {
+    "test_chaos", "test_degrade", "test_drift", "test_pipeline",
+}
+
+
+@pytest.fixture(autouse=True)
+def _locktrace_witness(request):
+    name = request.module.__name__.rsplit(".", 1)[-1]
+    if name == "test_locktrace":
+        # the witness's own suite installs/uninstalls per test; a
+        # fixture-held install would make those installs collide
+        yield None
+        return
+    if (
+        name not in LOCKTRACE_SUITES
+        and os.environ.get("TCSDN_LOCKTRACE") != "1"
+    ):
+        yield None
+        return
+    from traffic_classifier_sdn_tpu.utils import locktrace
+
+    if locktrace._installed is not None:  # a test drives its own witness
+        yield None
+        return
+    with locktrace.tracing() as witness:
+        yield witness
+    violations = witness.violations
+    assert not violations, (
+        "lock-order violations observed at runtime:\n" + "\n".join(
+            f"  edge {v['edge'][0]} -> {v['edge'][1]} closes a cycle "
+            f"via {' -> '.join(v['conflict_path'])} "
+            f"(thread {v['thread']})"
+            for v in violations
+        )
+    )
+
+
 @pytest.fixture(scope="session")
 def reference_models_dir():
     path = os.path.join(REFERENCE_ROOT, "models")

@@ -28,11 +28,14 @@ from traffic_classifier_sdn_tpu.analysis_static.framework import (
 from traffic_classifier_sdn_tpu.analysis_static.rules import (
     ALL_RULES,
     AtomicIoRule,
+    BlockingUnderLockRule,
     CtypesAbiRule,
     FaultSiteRegistryRule,
     JitPurityRule,
     LockDisciplineRule,
+    LockOrderRule,
     RetraceHazardRule,
+    ThreadLifecycleRule,
 )
 
 PACKAGE_DIR = os.path.dirname(
@@ -584,6 +587,507 @@ def test_lock_discipline_clean_retrainer_publication(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# lock-order (graftlock)
+# ---------------------------------------------------------------------------
+
+# the AB/BA shape: two methods acquiring the same two locks in opposite
+# orders — two threads interleaving them deadlock with both locks held.
+# tests/test_locktrace.py runs THIS SAME source under the runtime
+# witness and proves it trips there too (static + dynamic agreement).
+LOCK_ORDER_ABBA = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def fwd(self):
+            with self._a_lock:
+                with self._b_lock:
+                    return 1
+
+        def rev(self):
+            with self._b_lock:
+                with self._a_lock:
+                    return 2
+"""
+
+# same two locks, same order everywhere: consistent, clean
+LOCK_ORDER_CONSISTENT = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def fwd(self):
+            with self._a_lock:
+                with self._b_lock:
+                    return 1
+
+        def rev(self):
+            with self._a_lock:
+                with self._b_lock:
+                    return 2
+"""
+
+
+def test_lock_order_fires_on_abba(tmp_path):
+    findings = run_rule(tmp_path, LockOrderRule, LOCK_ORDER_ABBA)
+    assert len(findings) == 1
+    assert findings[0].rule == "lock-order"
+    assert "cycle" in findings[0].message
+    assert "_a_lock" in findings[0].message
+    assert "_b_lock" in findings[0].message
+
+
+def test_lock_order_clean_when_consistent(tmp_path):
+    assert run_rule(
+        tmp_path, LockOrderRule, LOCK_ORDER_CONSISTENT
+    ) == []
+
+
+def test_lock_order_removing_either_edge_passes(tmp_path):
+    # the acceptance contract: dropping EITHER acquisition edge of the
+    # AB/BA pair makes the cycle (and the finding) disappear
+    no_fwd_nesting = LOCK_ORDER_ABBA.replace(
+        "with self._a_lock:\n                with self._b_lock:\n                    return 1",
+        "with self._a_lock:\n                return 1",
+    )
+    assert run_rule(tmp_path, LockOrderRule, no_fwd_nesting) == []
+    no_rev_nesting = LOCK_ORDER_ABBA.replace(
+        "with self._b_lock:\n                with self._a_lock:\n                    return 2",
+        "with self._b_lock:\n                return 2",
+    )
+    assert run_rule(tmp_path, LockOrderRule, no_rev_nesting) == []
+
+
+def test_lock_order_sees_interprocedural_cycle(tmp_path):
+    # the second half of the AB edge hides behind a helper call — the
+    # propagation through the call graph must still close the cycle
+    src = """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def fwd(self):
+                with self._a_lock:
+                    self._grab_b()
+
+            def _grab_b(self):
+                with self._b_lock:
+                    return 1
+
+            def rev(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        return 2
+    """
+    findings = run_rule(tmp_path, LockOrderRule, src)
+    assert len(findings) == 1
+    assert "_grab_b" in findings[0].message  # the chain names the hop
+
+
+def test_lock_order_flags_self_reacquire(tmp_path):
+    # re-acquiring a held non-reentrant Lock on the same call path is
+    # the single-thread deadlock variant
+    src = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._read()
+
+            def _read(self):
+                with self._lock:
+                    return self.n
+    """
+    findings = run_rule(tmp_path, LockOrderRule, src)
+    assert len(findings) == 1
+    assert "re-acquired" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock (graftlock)
+# ---------------------------------------------------------------------------
+
+BLOCKING_POSITIVE = """
+    import threading
+    import queue
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            pass
+
+        def drain(self):
+            with self._lock:
+                return self._q.get()
+
+        def stop(self):
+            with self._lock:
+                self._t.join()
+"""
+
+BLOCKING_NEGATIVE = """
+    import threading
+    import queue
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            pass
+
+        def drain(self):
+            with self._lock:
+                return self._q.get(timeout=1.0)
+
+        def stop(self):
+            t = self._t
+            with self._lock:
+                pass
+            t.join(2.0)
+"""
+
+
+def test_blocking_under_lock_fires(tmp_path):
+    findings = run_rule(tmp_path, BlockingUnderLockRule,
+                        BLOCKING_POSITIVE)
+    kinds = sorted(
+        f.message.split("unbounded ")[1].split(" ")[0]
+        for f in findings
+    )
+    assert kinds == ["join", "queue-get"]
+
+
+def test_blocking_under_lock_clean_with_timeouts(tmp_path):
+    assert run_rule(
+        tmp_path, BlockingUnderLockRule, BLOCKING_NEGATIVE
+    ) == []
+
+
+def test_blocking_under_lock_condition_own_wait_exempt(tmp_path):
+    # waiting on the condition you hold RELEASES it — only OTHER held
+    # locks are blocked, so the bare wait alone is clean...
+    src = """
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._lock = threading.Condition()
+
+            def park(self):
+                with self._lock:
+                    self._lock.wait()
+    """
+    assert run_rule(tmp_path, BlockingUnderLockRule, src) == []
+    # ...but the same wait under an ADDITIONAL outer lock blocks that
+    # outer lock without bound and must fire
+    src_nested = """
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._lock = threading.Condition()
+
+            def park(self):
+                with self._outer_lock:
+                    with self._lock:
+                        self._lock.wait()
+    """
+    findings = run_rule(tmp_path, BlockingUnderLockRule, src_nested)
+    assert len(findings) == 1
+    assert "_outer_lock" in findings[0].message
+
+
+def test_blocking_under_lock_explicit_unbounded_spellings(tmp_path):
+    # join(None) / wait(timeout=None) / get(True) / communicate(data)
+    # all block forever despite carrying an argument — none may read
+    # as bounded
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._q = None
+                self._ev = None
+                self._proc = None
+
+            def _run(self):
+                pass
+
+            def a(self):
+                with self._lock:
+                    self._t.join(None)
+
+            def b(self):
+                with self._lock:
+                    self._ev.wait(timeout=None)
+
+            def c(self):
+                with self._lock:
+                    return self._q.get(True)
+
+            def d(self, data):
+                with self._lock:
+                    return self._proc.communicate(data)
+    """
+    findings = run_rule(tmp_path, BlockingUnderLockRule, src)
+    assert len(findings) == 4
+    # ...while real timeouts (and dict.get-ambiguous positionals)
+    # still read as bounded
+    bounded = (
+        src.replace("self._t.join(None)", "self._t.join(2.0)")
+        .replace("self._ev.wait(timeout=None)",
+                 "self._ev.wait(timeout=1.0)")
+        .replace("self._q.get(True)", "self._q.get('key')")
+        .replace("self._proc.communicate(data)",
+                 "self._proc.communicate(data, timeout=5)")
+    )
+    assert run_rule(tmp_path, BlockingUnderLockRule, bounded) == []
+
+
+def test_blocking_under_lock_multi_item_with(tmp_path):
+    # items of one `with` enter left-to-right: the open() in
+    # `with self._lock, open(p) as f:` runs WITH the lock held and
+    # must be flagged exactly like the nested two-statement form
+    src = """
+        import threading
+
+        class Writer:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def dump(self, p):
+                with self._lock, open(p) as f:
+                    return f.name
+    """
+    findings = run_rule(tmp_path, BlockingUnderLockRule, src)
+    assert len(findings) == 1
+    assert "file-io" in findings[0].message
+    # ...and the reverse item order opens BEFORE the lock: clean
+    src_rev = src.replace("with self._lock, open(p) as f:",
+                          "with open(p) as f, self._lock:")
+    assert run_rule(tmp_path, BlockingUnderLockRule, src_rev) == []
+
+
+def test_lock_order_multi_item_with_edge(tmp_path):
+    # a two-item `with a, b:` is an a→b edge like the nested form —
+    # reversed nesting elsewhere must close the cycle
+    src = """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def fwd(self):
+                with self._a_lock, self._b_lock:
+                    return 1
+
+            def rev(self):
+                with self._b_lock, self._a_lock:
+                    return 2
+    """
+    findings = run_rule(tmp_path, LockOrderRule, src)
+    assert len(findings) == 1
+    assert "cycle" in findings[0].message
+
+
+def test_analysis_scales_on_diamond_call_graphs(tmp_path):
+    # a memo-at-top-only recursion is exponential in diamond depth
+    # (measured: 37 s at depth 20) — the fixed-point closure must walk
+    # a deep diamond chain in well under a second
+    import time as _time
+
+    depth = 40
+    parts = ["import threading", "_lock = threading.Lock()"]
+    parts.append(f"def f{depth}():\n    with _lock:\n        pass")
+    for i in range(depth - 1, -1, -1):
+        parts.append(
+            f"def g{i}():\n    f{i + 1}()\n"
+            f"def h{i}():\n    f{i + 1}()\n"
+            f"def f{i}():\n    g{i}()\n    h{i}()"
+        )
+    src = "\n".join(parts)
+    path = tmp_path / "diamond.py"
+    path.write_text(src, encoding="utf-8")
+    t0 = _time.perf_counter()
+    findings = LintRunner(
+        [LockOrderRule(), BlockingUnderLockRule()]
+    ).run([str(path)])
+    elapsed = _time.perf_counter() - t0
+    assert findings == []
+    assert elapsed < 5.0, f"diamond depth {depth} took {elapsed:.1f}s"
+
+
+def test_lock_order_survives_call_cycles(tmp_path):
+    # mutual recursion in the call graph must neither hang the
+    # fixed-point nor hide the edge reachable through the cycle
+    src = """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def ping(self, n):
+                if n:
+                    self.pong(n - 1)
+                with self._b_lock:
+                    pass
+
+            def pong(self, n):
+                self.ping(n)
+
+            def fwd(self):
+                with self._a_lock:
+                    self.ping(3)
+
+            def rev(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        return 2
+    """
+    findings = run_rule(tmp_path, LockOrderRule, src)
+    assert len(findings) == 1
+    assert "cycle" in findings[0].message
+
+
+def test_blocking_under_lock_sees_interprocedural_reach(tmp_path):
+    # the blocking call hides behind a helper — call-graph propagation
+    # must still flag the call site under the lock
+    src = """
+        import threading
+
+        class Runner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._proc = None
+
+            def shutdown(self):
+                with self._lock:
+                    self._drain()
+
+            def _drain(self):
+                self._proc.communicate()
+    """
+    findings = run_rule(tmp_path, BlockingUnderLockRule, src)
+    assert len(findings) == 1
+    assert "_drain" in findings[0].message  # the chain names the hop
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle (graftlock)
+# ---------------------------------------------------------------------------
+
+THREAD_LIFECYCLE_POSITIVE = """
+    import threading
+
+    class Leaky:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def _run(self):
+            pass
+"""
+
+THREAD_LIFECYCLE_NEGATIVE = """
+    import threading
+
+    class Daemonized:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            pass
+
+    class Joined:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def _run(self):
+            pass
+
+        def stop(self):
+            self._t.join(timeout=5.0)
+"""
+
+
+def test_thread_lifecycle_fires(tmp_path):
+    findings = run_rule(tmp_path, ThreadLifecycleRule,
+                        THREAD_LIFECYCLE_POSITIVE)
+    assert len(findings) == 1
+    assert "neither daemonized" in findings[0].message
+
+
+def test_thread_lifecycle_clean(tmp_path):
+    assert run_rule(
+        tmp_path, ThreadLifecycleRule, THREAD_LIFECYCLE_NEGATIVE
+    ) == []
+
+
+def test_thread_lifecycle_accepts_alias_join(tmp_path):
+    # the exposition-server idiom: the attribute is swapped into a
+    # local under the teardown lock, and the LOCAL is joined
+    src = """
+        import threading
+
+        class Server:
+            def start(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                thread, self._thread = self._thread, None
+                if thread is not None:
+                    thread.join(timeout=5.0)
+    """
+    assert run_rule(tmp_path, ThreadLifecycleRule, src) == []
+
+
+def test_thread_lifecycle_flags_unbound_nondaemon(tmp_path):
+    src = """
+        import threading
+
+        def fire_and_forget(fn):
+            threading.Thread(target=fn).start()
+    """
+    findings = run_rule(tmp_path, ThreadLifecycleRule, src)
+    assert len(findings) == 1
+    assert "<unbound>" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
 # fault-site-registry
 # ---------------------------------------------------------------------------
 
@@ -889,18 +1393,31 @@ def test_cli_exit_codes(tmp_path):
 
     dirty = tmp_path / "dirty.py"
     dirty.write_text(textwrap.dedent(ATOMIC_POSITIVE), encoding="utf-8")
+    sarif_path = tmp_path / "findings.sarif"
     found = subprocess.run(
         [sys.executable, "-m",
          "traffic_classifier_sdn_tpu.analysis_static", "--json",
-         str(dirty)],
+         "--sarif", str(sarif_path), str(dirty)],
         capture_output=True, text=True, env=env,
     )
     assert found.returncode == 1
     import json
 
     report = json.loads(found.stdout)
+    assert report["schema_version"] == 2
     assert report["count"] == 1
     assert report["findings"][0]["rule"] == "atomic-io"
+    # the SARIF copy carries the same finding in 2.1.0 shape, with the
+    # rule catalog present so annotators can render descriptions
+    sarif = json.loads(sarif_path.read_text(encoding="utf-8"))
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["results"][0]["ruleId"] == "atomic-io"
+    assert (run["results"][0]["locations"][0]["physicalLocation"]
+            ["region"]["startLine"]) == report["findings"][0]["line"]
+    assert any(
+        r["id"] == "atomic-io" for r in run["tool"]["driver"]["rules"]
+    )
 
     # a --select scoped run must not flag valid suppressions of real
     # but unselected rule ids as bad-suppression
@@ -961,5 +1478,6 @@ def test_every_rule_has_fixture_coverage():
     covered = {
         "jit-purity", "retrace-hazard", "ctypes-abi", "lock-discipline",
         "fault-site-registry", "atomic-io",
+        "lock-order", "blocking-under-lock", "thread-lifecycle",
     }
     assert {cls.id for cls in ALL_RULES} == covered

@@ -1,0 +1,478 @@
+"""The locktrace runtime witness (utils/locktrace.py).
+
+Covers: online cycle detection with an injected deterministic schedule
+(no sleeps — thread interleavings are pinned by joins, and detection is
+lockdep-style so no actual deadlock has to manifest), the
+condition-wait exemption, the static/dynamic agreement contract (ONE
+AB/BA source is flagged by the static ``lock-order`` rule AND trips the
+runtime witness; removing either lock edge makes BOTH pass), the
+static-graph cross-check, and the committed lock-order-graph artifact's
+currency against the package source.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from traffic_classifier_sdn_tpu.analysis_static import lint_paths
+from traffic_classifier_sdn_tpu.analysis_static.framework import (
+    LintRunner,
+    collect_modules,
+)
+from traffic_classifier_sdn_tpu.analysis_static.graftlock import (
+    build_graph_report,
+)
+from traffic_classifier_sdn_tpu.analysis_static.rules import LockOrderRule
+from traffic_classifier_sdn_tpu.utils import locktrace
+
+PACKAGE_DIR = os.path.dirname(
+    os.path.dirname(os.path.abspath(lint_paths.__code__.co_filename))
+)
+REPO_ROOT = os.path.dirname(PACKAGE_DIR)
+
+# The AB/BA deadlock fixture, shared verbatim between the static rule
+# run and the runtime execution — the acceptance contract is that BOTH
+# catch it, and that removing either nesting makes both pass.
+ABBA_SRC = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def fwd(self):
+        with self._a_lock:
+            with self._b_lock:
+                return 1
+
+    def rev(self):
+        with self._b_lock:
+            with self._a_lock:
+                return 2
+"""
+
+ABBA_FWD_FLAT = ABBA_SRC.replace(
+    "with self._a_lock:\n            with self._b_lock:\n"
+    "                return 1",
+    "with self._a_lock:\n            return 1",
+)
+ABBA_REV_FLAT = ABBA_SRC.replace(
+    "with self._b_lock:\n            with self._a_lock:\n"
+    "                return 2",
+    "with self._b_lock:\n            return 2",
+)
+
+
+def _run_two_threads(pair) -> None:
+    """Deterministic injected schedule: thread 1 runs the full forward
+    acquisition, is JOINED, then thread 2 runs the reverse one — no
+    overlap, no sleeps, no real deadlock; the witness's online graph
+    still sees both orders."""
+    t1 = threading.Thread(target=pair.fwd)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=pair.rev)
+    t2.start()
+    t2.join()
+
+
+def _exec_fixture(tmp_path, src: str, name: str = "abba_fixture.py"):
+    """Write + exec the fixture so lock construction frames carry the
+    tmp file's path (the witness scope keys on construction site)."""
+    path = tmp_path / name
+    path.write_text(src, encoding="utf-8")
+    ns: dict = {}
+    exec(compile(src, str(path), "exec"), ns)  # noqa: S102 — test fixture
+    return path, ns
+
+
+# ---------------------------------------------------------------------------
+# online cycle detection
+# ---------------------------------------------------------------------------
+
+
+def test_witness_catches_abba_and_static_rule_agrees(tmp_path):
+    path, ns = _exec_fixture(tmp_path, ABBA_SRC)
+    # static: the lock-order rule flags the same source
+    static = LintRunner([LockOrderRule()]).run([str(path)])
+    assert len(static) == 1 and static[0].rule == "lock-order"
+    # dynamic: the witness trips on the two-thread schedule
+    scope = lambda f: f == str(path)  # noqa: E731
+    with locktrace.tracing(scope=scope) as w:
+        pair = ns["Pair"]()
+        _run_two_threads(pair)
+    assert len(w.violations) == 1
+    v = w.violations[0]
+    sites = set(v["edge"]) | set(v["conflict_path"])
+    assert all(str(path) in s for s in sites)
+
+
+def test_removing_either_edge_passes_both(tmp_path):
+    for i, src in enumerate((ABBA_FWD_FLAT, ABBA_REV_FLAT)):
+        path, ns = _exec_fixture(tmp_path, src, f"flat_{i}.py")
+        assert LintRunner([LockOrderRule()]).run([str(path)]) == []
+        scope = lambda f, p=str(path): f == p  # noqa: E731
+        with locktrace.tracing(scope=scope) as w:
+            _run_two_threads(ns["Pair"]())
+        assert w.violations == []
+
+
+def test_witness_detects_without_interleaving_single_thread(tmp_path):
+    # lockdep property: both orders on ONE thread (sequentially, never
+    # deadlocking) still prove the cycle
+    path, ns = _exec_fixture(tmp_path, ABBA_SRC)
+    scope = lambda f: f == str(path)  # noqa: E731
+    with locktrace.tracing(scope=scope) as w:
+        pair = ns["Pair"]()
+        pair.fwd()
+        pair.rev()
+    assert len(w.violations) == 1
+
+
+def test_witness_injected_schedule_no_threads():
+    # the bare witness API with a hand-injected schedule: thread
+    # identity comes from the caller, so two logical threads can be
+    # simulated exactly (the unit-level no-sleeps test)
+    w = locktrace.LockWitness()
+    results: list = []
+
+    def t1():
+        w.note_acquire("a.py:1")
+        w.note_acquire("b.py:2")
+        w.note_release("b.py:2")
+        w.note_release("a.py:1")
+
+    def t2():
+        w.note_acquire("b.py:2")
+        w.note_acquire("a.py:1")
+        results.append(len(w.violations))
+        w.note_release("a.py:1")
+        w.note_release("b.py:2")
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join()
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join()
+    # the violation is visible ONLINE, at the closing acquisition
+    assert results == [1]
+    assert w.violations[0]["edge"] == ["b.py:2", "a.py:1"]
+
+
+def test_witness_condition_wait_releases_its_lock(tmp_path):
+    # a thread parked in cond.wait() is NOT holding the condition: a
+    # second thread acquiring another lock then the condition must not
+    # manufacture an edge from the waiter's stack
+    src = """
+import threading
+
+class Stage:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._go_lock = threading.Lock()
+        self.ready = False
+
+    def park(self):
+        with self._lock:
+            while not self.ready:
+                self._lock.wait()
+
+    def release(self):
+        with self._go_lock:
+            with self._lock:
+                self.ready = True
+                self._lock.notify_all()
+"""
+    path, ns = _exec_fixture(tmp_path, src, "cond_fixture.py")
+    scope = lambda f: f == str(path)  # noqa: E731
+    with locktrace.tracing(scope=scope) as w:
+        stage = ns["Stage"]()
+        t = threading.Thread(target=stage.park)
+        t.start()
+        stage.release()
+        t.join()
+    assert w.violations == []
+    # exactly the releaser's go→cond edge was observed; the parked
+    # waiter (which held only the condition it released) produced none
+    assert len(w.edges()) == 1
+
+
+def test_witness_same_order_clean(tmp_path):
+    path, ns = _exec_fixture(tmp_path, ABBA_SRC, "consistent.py")
+    scope = lambda f: f == str(path)  # noqa: E731
+    with locktrace.tracing(scope=scope) as w:
+        pair = ns["Pair"]()
+        pair.fwd()
+        pair.fwd()  # repeated consistent order: one edge, no violation
+    assert w.violations == []
+    assert len(w.edges()) == 1
+
+
+# ---------------------------------------------------------------------------
+# scope + stdlib hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_stdlib_locks_stay_real():
+    import queue
+
+    with locktrace.tracing(scope=lambda f: False):
+        q = queue.Queue()
+        assert not isinstance(q.mutex, locktrace.TracedLock)
+        lock = threading.Lock()
+        assert not isinstance(lock, locktrace.TracedLock)
+
+
+def test_package_locks_get_wrapped_under_default_scope():
+    from traffic_classifier_sdn_tpu.obs.flight_recorder import (
+        FlightRecorder,
+    )
+
+    with locktrace.tracing() as w:
+        rec = FlightRecorder(capacity=4)
+        assert isinstance(rec._lock, locktrace.TracedLock)
+        rec.record("demo", x=1)  # acquire/release through the shim
+        assert rec.count() == 1
+    assert w.violations == []
+    # and the wrapper keeps working after uninstall (late events are
+    # tolerated, not tracked)
+    rec.record("late", x=2)
+    assert rec.count() == 2
+
+
+# ---------------------------------------------------------------------------
+# static-graph cross-check
+# ---------------------------------------------------------------------------
+
+
+def test_cross_check_maps_sites_and_flags_unknown_edges():
+    w = locktrace.LockWitness()
+
+    def seq():
+        w.note_acquire("pkg/a.py:10")
+        w.note_acquire("pkg/b.py:20")
+        w.note_release("pkg/b.py:20")
+        w.note_release("pkg/a.py:10")
+
+    t = threading.Thread(target=seq)
+    t.start()
+    t.join()
+    graph = {
+        "nodes": [
+            {"id": "pkg/a.py::A._lock", "constructed_at": ["pkg/a.py:10"]},
+            {"id": "pkg/b.py::B._lock", "constructed_at": ["pkg/b.py:20"]},
+        ],
+        "edges": [
+            {"from": "pkg/a.py::A._lock", "to": "pkg/b.py::B._lock"},
+        ],
+    }
+    report = w.check_against(graph)
+    assert report["checked"]
+    assert report["unknown_edges"] == []
+    assert report["unmapped_sites"] == []
+    # drop the edge from the static graph → the observed edge becomes a
+    # reported static-analysis hole
+    graph["edges"] = []
+    report = w.check_against(graph)
+    assert len(report["unknown_edges"]) == 1
+    assert report["unknown_edges"][0]["from"] == "pkg/a.py::A._lock"
+
+
+def test_cross_check_reports_unmapped_sites():
+    w = locktrace.LockWitness()
+
+    def seq():
+        w.note_acquire("pkg/a.py:10")
+        w.note_acquire("pkg/unknown.py:99")
+        w.note_release("pkg/unknown.py:99")
+        w.note_release("pkg/a.py:10")
+
+    t = threading.Thread(target=seq)
+    t.start()
+    t.join()
+    graph = {"nodes": [{"id": "pkg/a.py::A._lock",
+                        "constructed_at": ["pkg/a.py:10"]}],
+             "edges": []}
+    report = w.check_against(graph)
+    assert report["unmapped_sites"] == ["pkg/unknown.py:99"]
+
+
+def test_check_against_none_is_inert():
+    w = locktrace.LockWitness()
+    report = w.check_against(None)
+    assert report == {"unknown_edges": [], "unmapped_sites": [],
+                      "checked": False}
+
+
+# ---------------------------------------------------------------------------
+# the committed artifact
+# ---------------------------------------------------------------------------
+
+
+def test_lock_graph_artifact_is_current():
+    """docs/artifacts/lock_order_graph.json must match a fresh build
+    from the package source — the artifact exists so review can diff
+    concurrency structure, which only works if it never goes stale.
+    Regenerate from the repo root with:
+
+        python -m traffic_classifier_sdn_tpu.analysis_static \\
+            traffic_classifier_sdn_tpu --lock-graph \\
+            docs/artifacts/lock_order_graph.json
+    """
+    artifact_path = locktrace.DEFAULT_GRAPH_PATH
+    assert os.path.exists(artifact_path), (
+        f"missing artifact {artifact_path} — generate it (see docstring)"
+    )
+    with open(artifact_path, encoding="utf-8") as f:
+        committed = json.load(f)
+    modules, errs = collect_modules([PACKAGE_DIR],
+                                    relative_to=REPO_ROOT)
+    assert errs == []
+    fresh = build_graph_report(modules)
+    assert committed == fresh, (
+        "docs/artifacts/lock_order_graph.json is stale — regenerate "
+        "it (see this test's docstring)"
+    )
+
+
+def test_package_has_no_lock_order_cycles():
+    modules, _ = collect_modules([PACKAGE_DIR], relative_to=REPO_ROOT)
+    report = build_graph_report(modules)
+    assert report["cycles"] == []
+    # the graph is non-trivial: the known cross-subsystem edges exist
+    edge_pairs = {(e["from"], e["to"]) for e in report["edges"]}
+    assert any(
+        "DegradeLadder._lock" in a and "DeviceWatchdog._lock" in b
+        for a, b in edge_pairs
+    ), edge_pairs
+
+
+def test_cli_env_flag_arms_witness_and_reports_clean(
+    tmp_path, monkeypatch, capsys
+):
+    """``TCSDN_LOCKTRACE=1`` arms the witness for a real CLI serve
+    (replay source, in-process): the run completes, the witness
+    uninstalls cleanly, and no ordering violation is reported — the
+    operator-facing half of the fixture that guards the tier-1
+    suites."""
+    import numpy as np
+
+    from traffic_classifier_sdn_tpu import cli
+    from traffic_classifier_sdn_tpu.ingest.protocol import format_line
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+    from traffic_classifier_sdn_tpu.io.checkpoint import save_model
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    capture = tmp_path / "capture.tsv"
+    syn = SyntheticFlows(n_flows=8, seed=3)
+    with open(capture, "wb") as f:
+        f.write(b"header to ignore\n")
+        for _ in range(8):
+            for r in syn.tick():
+                f.write(format_line(r))
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (4, 12)),
+        "var": rng.gamma(2.0, 50.0, (4, 12)) + 1.0,
+        "class_prior": np.full(4, 0.25),
+    })
+    ckpt = str(tmp_path / "gnb")
+    save_model(ckpt, "gnb", params, ["dns", "ping", "telnet", "voice"])
+
+    monkeypatch.setenv(locktrace.ENV_FLAG, "1")
+    cli.main([
+        "gaussiannb",
+        "--source", "replay",
+        "--capture", str(capture),
+        "--native-checkpoint", ckpt,
+        "--capacity", "32",
+        "--print-every", "4",
+        "--max-ticks", "8",
+    ])
+    # witness uninstalled in the serve's finally
+    assert locktrace._installed is None
+    assert not isinstance(threading.Lock(), locktrace.TracedLock)
+    err = capsys.readouterr().err
+    assert "LOCKTRACE VIOLATION" not in err
+
+
+def test_cli_early_sysexit_unwinds_witness(monkeypatch):
+    """A sys.exit INSIDE the serve body (flag-validation guards, after
+    the witness installed) must not leak the monkeypatched factories —
+    the wrapper's finally is the backstop."""
+    from traffic_classifier_sdn_tpu import cli
+
+    monkeypatch.setenv(locktrace.ENV_FLAG, "1")
+    real_lock = threading.Lock
+    try:
+        cli.main(["gaussiannb", "--source", "synthetic",
+                  "--obs-dump-on-exit"])  # needs --obs-dir: exits
+    except SystemExit:
+        pass
+    assert locktrace._installed is None
+    assert threading.Lock is real_lock
+
+
+def test_finish_does_not_duplicate_live_recorded_violations():
+    """A violation recorded live (witness.recorder attached) must not
+    be re-recorded by finish() into the same ring — and every fresh
+    violation of a multi-held acquisition is recorded live, not just
+    the last."""
+    from traffic_classifier_sdn_tpu.obs.flight_recorder import (
+        FlightRecorder,
+    )
+
+    rec = FlightRecorder(capacity=64)
+    w = locktrace.LockWitness(recorder=rec)
+
+    def t1():
+        w.note_acquire("x.py:1")
+        w.note_acquire("y.py:2")
+        w.note_acquire("z.py:3")
+        for s in ("z.py:3", "y.py:2", "x.py:1"):
+            w.note_release(s)
+
+    def t2():
+        w.note_acquire("z.py:3")
+        w.note_acquire("y.py:2")  # z→y closes a cycle against y→z
+        # x under BOTH z and y: edges z→x and y→x each close a cycle —
+        # two fresh violations from ONE acquisition, both live-recorded
+        w.note_acquire("x.py:1")
+        for s in ("x.py:1", "y.py:2", "z.py:3"):
+            w.note_release(s)
+
+    for fn in (t1, t2):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    assert len(w.violations) == 3
+    live = rec.count("locktrace.violation")
+    assert live == len(w.violations)  # every violation recorded live
+    locktrace.finish(w, recorder=rec)
+    assert rec.count("locktrace.violation") == live  # no duplicates
+
+
+def test_witness_maps_onto_static_graph_for_real_package_locks():
+    """End-to-end: drive a real package object under the witness and
+    map the observed acquisition sites onto the committed static
+    graph's nodes — the cross-check contract on non-fixture code."""
+    graph = locktrace.load_static_graph()
+    assert graph is not None
+    from traffic_classifier_sdn_tpu.serving.degrade import (
+        DeviceWatchdog,
+    )
+
+    with locktrace.tracing() as w:
+        wd = DeviceWatchdog()
+        assert wd.call(lambda: 7, deadline=5.0) == 7
+        wd.close()
+    report = w.check_against(graph)
+    assert report["checked"]
+    # the watchdog condition is a known static node, so its site maps
+    assert not any(
+        "degrade.py" in s for s in report["unmapped_sites"]
+    ), report["unmapped_sites"]

@@ -24,6 +24,13 @@
 # seams this sweep is supposed to cover. If you add a site, the linter
 # fails tier-1 until the registry, a chaos test, and (if it is a new
 # seam family) a group below all exist.
+#
+# Lock-order probing: every group runs with TCSDN_LOCKTRACE=1, so the
+# locktrace runtime witness (utils/locktrace.py) wraps every project
+# lock and asserts acquisition-order acyclicity across EVERY chaos
+# schedule this sweep drives — each crash/recovery interleaving doubles
+# as ordering evidence cross-checked against the static lock-order
+# graph (docs/artifacts/lock_order_graph.json).
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -52,7 +59,7 @@ for seed in "${SEEDS[@]}"; do
     site="${entry%%:*}"
     kexpr="${entry#*:}"
     echo "=== chaos seed=${seed} site=${site}"
-    if ! TCSDN_CHAOS_SEED="$seed" JAX_PLATFORMS=cpu \
+    if ! TCSDN_CHAOS_SEED="$seed" TCSDN_LOCKTRACE=1 JAX_PLATFORMS=cpu \
         python -m pytest tests/test_chaos.py -q -m chaos -k "$kexpr" \
         -p no:cacheprovider; then
       echo "!!! UNRECOVERED: seed=${seed} site=${site}" >&2

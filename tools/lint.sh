@@ -3,9 +3,12 @@
 #
 #   graftlint  project-native AST rules (jit-purity, retrace-hazard,
 #              ctypes-abi, lock-discipline, fault-site-registry,
-#              atomic-io) — always runs, zero findings required. Also
-#              enforced in tier-1 via `pytest -m lint`
-#              (tests/test_graftlint.py::test_package_is_clean).
+#              atomic-io, plus the graftlock whole-program concurrency
+#              pass: lock-order, blocking-under-lock,
+#              thread-lifecycle) — always runs, zero findings
+#              required. Also enforced in tier-1 via `pytest -m lint`
+#              (tests/test_graftlint.py::test_package_is_clean);
+#              `--list-rules` prints the full set.
 #   ruff       generic baseline, config pinned in [tool.ruff]
 #   mypy       typing baseline, config pinned in [tool.mypy]
 #
@@ -14,7 +17,10 @@
 # silently dropped — the gate still fails if an INSTALLED tool finds
 # violations. Machine-readable findings land in $LINT_SUMMARY (default:
 # a per-run /tmp/lint_summary.<pid>.json, path echoed on exit):
-# per-tool status plus graftlint's full --json findings array.
+# per-tool status plus graftlint's full --json findings array (the
+# schema_version-stamped report) and the path of the SARIF 2.1.0 copy
+# ($LINT_SARIF, default /tmp/graftlint_sarif.<pid>.json) CI can feed
+# to an inline annotator.
 #
 # Usage: tools/lint.sh [paths...]   (default: the package only — tests/
 # and tools/ are not held to the graftlint bar; pass them explicitly to
@@ -37,8 +43,11 @@ echo "=== graftlint ($*)"
 # them back
 GRAFT_JSON="$(mktemp /tmp/graftlint_findings.XXXXXX.json)" || exit 2
 trap 'rm -f "$GRAFT_JSON"' EXIT
+# SARIF copy survives the run (CI uploads it for inline annotations);
+# per-run default so concurrent runs never clobber each other
+SARIF_OUT="${LINT_SARIF:-/tmp/graftlint_sarif.$$.json}"
 if JAX_PLATFORMS=cpu python -m traffic_classifier_sdn_tpu.analysis_static \
-     --json "$@" > "$GRAFT_JSON"; then
+     --json --sarif "$SARIF_OUT" "$@" > "$GRAFT_JSON"; then
   graftlint_status=pass
   echo "graftlint: clean"
 else
@@ -96,17 +105,30 @@ fi
 
 # ---- summary ---------------------------------------------------------------
 python - "$SUMMARY" "$GRAFT_JSON" \
-    "$graftlint_status" "$ruff_status" "$mypy_status" <<'EOF'
-import json, sys
-out, graft_json, graftlint, ruff, mypy = sys.argv[1:6]
+    "$graftlint_status" "$ruff_status" "$mypy_status" "$SARIF_OUT" <<'EOF'
+import json, os, sys
+out, graft_json, graftlint, ruff, mypy, sarif = sys.argv[1:7]
 try:
     with open(graft_json) as f:
         findings = json.load(f)["findings"]
 except (OSError, ValueError, KeyError):
     findings = []
+# the enabled rule set, read back from the SARIF driver catalog so the
+# summary's list can never drift from what actually ran
+try:
+    with open(sarif) as f:
+        rules = [r["id"] for r in
+                 json.load(f)["runs"][0]["tool"]["driver"]["rules"]]
+except (OSError, ValueError, KeyError, IndexError):
+    rules = []
 summary = {
     "tools": [
-        {"name": "graftlint", "status": graftlint, "findings": findings},
+        {"name": "graftlint", "status": graftlint, "findings": findings,
+         "rules": rules,
+         # the SARIF path is recorded even when clean — CI annotators
+         # want the (empty) run object either way; absent only on a
+         # usage-error run that never wrote it
+         "sarif": sarif if os.path.exists(sarif) else None},
         {"name": "ruff", "status": ruff},
         {"name": "mypy", "status": mypy},
     ],

@@ -10,7 +10,13 @@ import argparse
 import os
 import sys
 
-from .framework import LintRunner, _iter_py_files, render_report
+from .framework import (
+    LintRunner,
+    _iter_py_files,
+    collect_modules,
+    render_report,
+    render_sarif,
+)
 from .rules import ALL_RULES
 
 
@@ -26,7 +32,19 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--json", action="store_true",
-        help="machine-readable findings on stdout",
+        help="machine-readable findings on stdout "
+             "(schema_version-stamped)",
+    )
+    parser.add_argument(
+        "--sarif", metavar="PATH",
+        help="also write the findings as SARIF 2.1.0 to PATH, so CI "
+             "can annotate them inline (always written, clean or not)",
+    )
+    parser.add_argument(
+        "--lock-graph", metavar="PATH",
+        help="write the static lock-order graph (JSON with embedded "
+             "DOT) to PATH — the docs/artifacts/lock_order_graph.json "
+             "artifact and the runtime witness's cross-check input",
     )
     parser.add_argument(
         "--select",
@@ -80,6 +98,43 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     findings = LintRunner(rules, known_ids=all_ids).run(paths)
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            f.write(render_sarif(findings, rules))
+    if args.lock_graph:
+        import json as _json
+
+        from .graftlock import build_graph_report
+
+        # display paths pinned to the parent of the TOPMOST enclosing
+        # package (walking up through __init__.py), NOT the cwd and
+        # not the scanned subtree: a subpackage scan
+        # (`--lock-graph g.json pkg/serving`) must still emit
+        # `pkg/serving/...` site keys, because the runtime witness
+        # normalizes its construction frames against the package root
+        # — anything else makes every observed site "unmapped". A
+        # fresh parse, not the lint run's modules: the pin changes
+        # every display path, and finding paths must stay cwd-relative
+        # for editor links.
+        anchor = os.path.commonpath(
+            [os.path.abspath(p) for p in paths]
+        )
+        if os.path.isfile(anchor):
+            anchor = os.path.dirname(anchor)
+        while os.path.exists(os.path.join(anchor, "__init__.py")):
+            anchor = os.path.dirname(anchor)
+        modules, parse_errs = collect_modules(paths,
+                                              relative_to=anchor)
+        for fnd in parse_errs:
+            # a lock constructed in an unparseable file would silently
+            # vanish from the graph — say so (the lint findings above
+            # already fail the run on the same parse error)
+            print(f"lock-graph: skipping unparseable {fnd.path}: "
+                  f"{fnd.message}", file=sys.stderr)
+        with open(args.lock_graph, "w", encoding="utf-8") as f:
+            _json.dump(build_graph_report(modules), f, indent=2,
+                       sort_keys=True)
+            f.write("\n")
     print(render_report(findings, as_json=args.json))
     return 1 if findings else 0
 

@@ -188,6 +188,45 @@ def _iter_py_files(paths: Iterable[str]) -> Iterator[str]:
             yield p
 
 
+def collect_modules(
+    paths: Iterable[str], relative_to: str | None = None
+) -> tuple[list[ModuleInfo], list[Finding]]:
+    """Parse every .py under ``paths`` exactly once (overlapping inputs
+    deduped by realpath). Returns the parsed modules plus parse-error
+    findings for the rest. ``relative_to`` pins display paths against a
+    fixed root (the lock-graph artifact must not depend on the caller's
+    cwd); default is cwd-relative, same as before."""
+    modules: list[ModuleInfo] = []
+    findings: list[Finding] = []
+    visited: set[str] = set()
+    for path in _iter_py_files(paths):
+        # overlapping inputs (`lint.sh pkg pkg/sub`) must not parse
+        # a file twice: duplicate findings, duplicate registries
+        real = os.path.realpath(path)
+        if real in visited:
+            continue
+        visited.add(real)
+        display = os.path.relpath(path, relative_to)
+        if display.startswith(".."):
+            display = path
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(PARSE_ERROR, display, 1, str(e)))
+            continue
+        mod = ModuleInfo(path, display, source)
+        if mod.parse_error is not None:
+            findings.append(Finding(
+                PARSE_ERROR, display,
+                mod.parse_error.lineno or 1,
+                f"syntax error: {mod.parse_error.msg}",
+            ))
+            continue
+        modules.append(mod)
+    return modules, findings
+
+
 class LintRunner:
     """Parse once, run every rule, apply suppressions."""
 
@@ -205,35 +244,7 @@ class LintRunner:
         )
 
     def run(self, paths: Iterable[str]) -> list[Finding]:
-        modules = []
-        findings: list[Finding] = []
-        visited: set[str] = set()
-        for path in _iter_py_files(paths):
-            # overlapping inputs (`lint.sh pkg pkg/sub`) must not parse
-            # a file twice: duplicate findings, duplicate registries
-            real = os.path.realpath(path)
-            if real in visited:
-                continue
-            visited.add(real)
-            display = os.path.relpath(path)
-            if display.startswith(".."):
-                display = path
-            try:
-                with open(path, encoding="utf-8") as f:
-                    source = f.read()
-            except (OSError, UnicodeDecodeError) as e:
-                findings.append(Finding(PARSE_ERROR, display, 1, str(e)))
-                continue
-            mod = ModuleInfo(path, display, source)
-            if mod.parse_error is not None:
-                findings.append(Finding(
-                    PARSE_ERROR, display,
-                    mod.parse_error.lineno or 1,
-                    f"syntax error: {mod.parse_error.msg}",
-                ))
-                continue
-            modules.append(mod)
-
+        modules, findings = collect_modules(paths)
         by_path = {m.display_path: m for m in modules}
         raw: list[Finding] = []
         for mod in modules:
@@ -276,10 +287,16 @@ def lint_paths(paths: Iterable[str],
     return LintRunner(rules).run(paths)
 
 
+# the --json report schema: 2 added schema_version itself (the field
+# consumers key migrations on) — the findings array is unchanged
+JSON_SCHEMA_VERSION = 2
+
+
 def render_report(findings: Sequence[Finding], as_json: bool) -> str:
     if as_json:
         return json.dumps(
-            {"findings": [f.to_dict() for f in findings],
+            {"schema_version": JSON_SCHEMA_VERSION,
+             "findings": [f.to_dict() for f in findings],
              "count": len(findings)},
             indent=2,
         )
@@ -288,3 +305,62 @@ def render_report(findings: Sequence[Finding], as_json: bool) -> str:
     lines = [f.render() for f in findings]
     lines.append(f"graftlint: {len(findings)} finding(s)")
     return "\n".join(lines)
+
+
+def render_sarif(findings: Sequence[Finding],
+                 rules: Sequence[Rule]) -> str:
+    """SARIF 2.1.0 — the interchange format CI annotators consume
+    (GitHub code scanning et al.), so a graftlint finding lands as an
+    inline annotation on the offending line instead of a log grep.
+    ``tools/lint.sh`` records the written path in its JSON summary."""
+    rule_meta = [
+        {
+            "id": r.id,
+            "shortDescription": {"text": r.description or r.id},
+            "defaultConfiguration": {
+                "level": "error" if r.severity == "error" else "warning"
+            },
+        }
+        for r in rules
+    ]
+    known = {r.id for r in rules}
+    extra = sorted(
+        {f.rule for f in findings} - known
+    )  # bad-suppression / parse-error
+    rule_meta.extend(
+        {"id": rid, "shortDescription": {"text": rid}} for rid in extra
+    )
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/")
+                    },
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        for f in findings
+    ]
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "graftlint",
+                    "informationUri": "docs/STATIC_ANALYSIS.md",
+                    "rules": rule_meta,
+                },
+            },
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)

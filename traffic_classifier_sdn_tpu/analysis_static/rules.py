@@ -1,4 +1,4 @@
-"""The six project rules. Each encodes an invariant one of the
+"""The project rules. Each encodes an invariant one of the
 framework's layers relies on but Python cannot enforce at runtime:
 
 ====================  =====================================================
@@ -24,6 +24,13 @@ framework's layers relies on but Python cannot enforce at runtime:
                       (usually wrongly: no fsync, wrong temp dir) what
                       ``utils.atomicio.atomic_write_bytes`` already proves
                       under fault injection
+``lock-order``        a cycle in the global lock-order graph is a deadlock
+                      two threads can reach (AB/BA); whole-program, see
+                      ``graftlock.py``
+``blocking-under-lock``  an unbounded blocking call reachable while a lock
+                      is held wedges every thread that ever takes that lock
+``thread-lifecycle``  a non-daemon thread with no reachable join outlives
+                      the serve; an unretired per-cycle worker is a leak
 ====================  =====================================================
 
 Rules are deliberately module-local and syntactic (no type inference, no
@@ -901,6 +908,12 @@ class AtomicIoRule(Rule):
                 )
 
 
+from .graftlock import (  # noqa: E402 — graftlock imports framework only
+    BlockingUnderLockRule,
+    LockOrderRule,
+    ThreadLifecycleRule,
+)
+
 ALL_RULES = (
     JitPurityRule,
     RetraceHazardRule,
@@ -908,4 +921,8 @@ ALL_RULES = (
     LockDisciplineRule,
     FaultSiteRegistryRule,
     AtomicIoRule,
+    # graftlock: the whole-program concurrency pass (graftlock.py)
+    LockOrderRule,
+    BlockingUnderLockRule,
+    ThreadLifecycleRule,
 )

@@ -463,6 +463,26 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
 
 
 def _run_classify(args) -> None:
+    # lockdep witness (utils/locktrace.py): TCSDN_LOCKTRACE=1 wraps
+    # every project lock constructed from here on, so the serve's real
+    # schedules become lock-ordering evidence. Armed in this thin
+    # wrapper so the monkeypatched factories can NEVER leak: any exit
+    # out of the serve body — the flag-validation sys.exit guards, a
+    # failed restore, an exception before the serve loop's own
+    # try/finally — lands in this finally, which uninstalls and
+    # reports iff the serve body's finish didn't already run
+    from .utils import locktrace
+
+    lock_witness = locktrace.maybe_trace_from_env()
+    try:
+        _run_classify_armed(args, lock_witness)
+    finally:
+        if (lock_witness is not None
+                and locktrace._installed is lock_witness):
+            locktrace.finish(lock_witness)
+
+
+def _run_classify_armed(args, lock_witness) -> None:
     from .ingest.batcher import FlowStateEngine
     from .models import (
         SUBCOMMAND_ALIASES,
@@ -503,6 +523,7 @@ def _run_classify(args) -> None:
 
     from .utils.metrics import global_metrics as m
     from .obs import FlightRecorder, Tracer
+    from .utils import locktrace
 
     # the obs plane: the flight recorder exists whenever any obs surface
     # is on (it feeds both /events and the post-mortem dump); the tracer
@@ -511,6 +532,10 @@ def _run_classify(args) -> None:
     recorder = (
         FlightRecorder() if (args.obs_port or args.obs_dir) else None
     )
+    if lock_witness is not None and recorder is not None:
+        # live attachment: a violation lands in the ring the moment the
+        # offending edge is observed, so post-mortem dumps carry it
+        lock_witness.recorder = recorder
     tracer = Tracer(metrics=m, recorder=recorder)
 
     use_native = _use_native(args)
@@ -778,6 +803,11 @@ def _run_classify(args) -> None:
             elif args.obs_dump_on_exit:
                 _dump_flight(recorder, args.obs_dir, "on-demand")
     finally:
+        if lock_witness is not None:
+            # surface ordering violations + the static-graph
+            # cross-check before the recorder goes away (violations
+            # also land in the ring as locktrace.violation events)
+            locktrace.finish(lock_witness, recorder=recorder)
         if server is not None:
             server.stop()
         if degrade_surface is not None:

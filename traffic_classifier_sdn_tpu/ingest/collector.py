@@ -58,16 +58,40 @@ class SubprocessCollector:
         # access snapshots the handle under this lock; the Popen object
         # itself is thread-safe to poll once you hold a reference.
         self._proc_lock = threading.Lock()
+        # stop() is terminal for this collector object (the supervisor
+        # spawns a fresh one per incarnation): the flag closes the
+        # spawn-vs-stop race now that start() spawns outside the lock
+        self._stopped = False
 
     def start(self) -> None:
+        # spawn OUTSIDE the lock: fork/exec can stall on a loaded host,
+        # and _proc_lock is taken by running/returncode/stop from other
+        # threads — only the handle PUBLICATION needs the lock
+        # (graftlint blocking-under-lock surfaced this)
+        proc = subprocess.Popen(
+            self.cmd,
+            shell=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            preexec_fn=os.setsid,
+        )
         with self._proc_lock:
-            self._proc = subprocess.Popen(
-                self.cmd,
-                shell=True,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                preexec_fn=os.setsid,
-            )
+            published = not self._stopped
+            if published:
+                self._proc = proc
+        if not published:
+            # a concurrent stop() won the race while we were spawning:
+            # the fresh monitor must not outlive it un-tracked — and
+            # with no reader thread coming, WE must close the pipe and
+            # reap the child (else: leaked fd + zombie until exit)
+            self._kill_group(proc)
+            if proc.stdout is not None:
+                proc.stdout.close()
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass  # SIGTERM ignored: unreaped, but not our hang
+            return
         self._thread = threading.Thread(target=self._reader, daemon=True)
         self._thread.start()
 
@@ -197,10 +221,18 @@ class SubprocessCollector:
 
     def stop(self) -> None:
         """Terminate the monitor's process group (the reference's
-        ``os.killpg`` teardown at traffic_classifier.py:222)."""
+        ``os.killpg`` teardown at traffic_classifier.py:222). Terminal:
+        a start() racing this stop sees ``_stopped`` and kills its own
+        fresh spawn instead of publishing it."""
         with self._proc_lock:
+            self._stopped = True
             proc, self._proc = self._proc, None
-        if proc is not None and proc.poll() is None:
+        if proc is not None:
+            self._kill_group(proc)
+
+    @staticmethod
+    def _kill_group(proc) -> None:
+        if proc.poll() is None:
             try:
                 os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
             except ProcessLookupError:

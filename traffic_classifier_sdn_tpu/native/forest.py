@@ -45,7 +45,7 @@ def _load():
     with _lock:
         if _lib is not None:
             return _lib
-        lib = _lazy.load()  # build machinery shared with engine.py
+        lib = _lazy.load()  # graftlint: disable=blocking-under-lock -- one-time g++ build serialized under the module lock by design (build-once; shared machinery with engine.py); later calls are cache hits
         lib.tcf_create.restype = ct.c_void_p
         lib.tcf_create.argtypes = [
             ct.c_uint32, ct.c_uint32, ct.c_uint32,

@@ -44,7 +44,7 @@ def _load():
     with _lock:
         if _lib is not None:
             return _lib
-        lib = _lazy.load()
+        lib = _lazy.load()  # graftlint: disable=blocking-under-lock -- one-time g++ build serialized under the module lock by design (build-once); later calls are cache hits
         lib.tck_create.restype = ct.c_void_p
         lib.tck_create.argtypes = [
             ct.c_uint32, ct.c_uint32, ct.c_uint32, ct.c_uint32,

@@ -53,7 +53,7 @@ class LazyLib:
                 if (not os.path.exists(self._lib_path)
                         or os.path.getmtime(self._lib_path)
                         < os.path.getmtime(self._src)):
-                    self._build()
+                    self._build()  # graftlint: disable=blocking-under-lock -- the first caller pays the one-time g++ build under the lock BY DESIGN (build-once guarantee: concurrent loaders must wait, not race a second compile); every later acquisition is a cached-handle hit
                 self._lib = ct.CDLL(self._lib_path)
             except (OSError, subprocess.CalledProcessError) as e:
                 detail = getattr(e, "stderr", "") or str(e)
