@@ -1004,3 +1004,168 @@ def test_pipeline_handoff_probabilistic_any_seed_serve_survivable():
     finally:
         pipe.shutdown(drain=False)
     assert len(done) == queued  # coalesced ticks superseded, not lost
+
+
+# ---------------------------------------------------------------------------
+# serve.dirty_mask / serve.label_cache — the incremental serving seams
+# (serving/incremental.py). Both ABSORBED: a fire degrades that tick to a
+# full-table re-predict served fresh — never a stale label as fresh.
+# ---------------------------------------------------------------------------
+
+
+def _inc_pair(capacity=64):
+    """(full_engine, inc_engine, inc, predict, params): two engines fed
+    identical streams, one full re-predict, one incremental."""
+    from traffic_classifier_sdn_tpu.models import gnb, jit_serving_fn
+    from traffic_classifier_sdn_tpu.serving.incremental import (
+        IncrementalLabels,
+    )
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (3, 12)),
+        "var": rng.gamma(2.0, 50.0, (3, 12)) + 1.0,
+        "class_prior": np.full(3, 1 / 3),
+    })
+    predict = jit_serving_fn(gnb.predict)
+    full = FlowStateEngine(capacity=capacity)
+    inc_eng = FlowStateEngine(capacity=capacity, track_dirty=True)
+    inc = IncrementalLabels(inc_eng, predict, params)
+    return full, inc_eng, inc, predict, params
+
+
+def _drive_pair(full, inc_eng, t, n):
+    _drive(full, t, n)
+    _drive(inc_eng, t, n)
+
+
+def _assert_labels_fresh(full, inc, predict, params):
+    """The incremental labels match a FRESH full-table re-predict on
+    every in-use row — the never-a-stale-label-as-fresh invariant."""
+    want = np.asarray(predict(params, full.features()))
+    got = np.asarray(inc.labels() if callable(inc) else inc)
+    in_use = np.asarray(full.table.in_use)[:-1]
+    np.testing.assert_array_equal(want[in_use], got[in_use])
+
+
+def test_serve_dirty_mask_fault_degrades_to_full_repredict():
+    """A serve.dirty_mask fire mid-serve is ABSORBED: that tick serves
+    a direct full-table re-predict (fresh labels, byte-equal to the
+    uninjected path), and the rebuilt mask/cache pair keeps subsequent
+    ticks exact."""
+    full, inc_eng, inc, predict, params = _inc_pair()
+    _drive_pair(full, inc_eng, 1, 24)
+    _assert_labels_fresh(full, np.asarray(inc.labels()), predict, params)
+
+    _drive_pair(full, inc_eng, 2, 8)  # real churn pending
+    plan = faults.FaultPlan(
+        [faults.FaultRule("serve.dirty_mask")], SEED
+    )
+    with faults.installed(plan):
+        got = np.asarray(inc.labels())  # fire absorbed, never raises
+    assert plan.fires == [("serve.dirty_mask", 1)]
+    _assert_labels_fresh(full, got, predict, params)
+
+    # recovery: the next (uninjected) render rebuilds mask + cache and
+    # stays exact through further churn
+    _drive_pair(full, inc_eng, 3, 16)
+    _assert_labels_fresh(full, np.asarray(inc.labels()), predict, params)
+    assert inc.status()["invalidations"] >= 1
+
+
+def test_serve_label_cache_fault_never_serves_stale():
+    """A serve.label_cache fire preempts the cache merge: the tick is
+    served from a fresh full re-predict (the dirty rows' NEW labels,
+    not their cached pre-churn ones), the cache/mask pair is left
+    untouched, and the dirty rows re-predict at the next render."""
+    full, inc_eng, inc, predict, params = _inc_pair()
+    _drive_pair(full, inc_eng, 1, 24)
+    inc.labels()
+
+    # churn a subset so the cached labels for those rows are stale
+    _drive_pair(full, inc_eng, 2, 6)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("serve.label_cache", times=None)], SEED
+    )
+    with faults.installed(plan):
+        got = np.asarray(inc.labels())
+        _assert_labels_fresh(full, got, predict, params)
+        # the merge was preempted — the dirty rows are still marked
+        # (mask untouched), so the NEXT tick re-predicts them too
+        got2 = np.asarray(inc.labels())
+        _assert_labels_fresh(full, got2, predict, params)
+    assert [s for s, _ in plan.fires] == ["serve.label_cache"] * 2
+    # uninjected again: the pending dirty rows finally merge, so the
+    # render after THAT re-predicts nothing
+    _assert_labels_fresh(full, np.asarray(inc.labels()), predict, params)
+    _assert_labels_fresh(full, np.asarray(inc.labels()), predict, params)
+    assert inc.status()["dirty_rows"] == 0
+
+
+def test_serve_dirty_mask_and_label_cache_probabilistic_any_seed():
+    """Probability-scheduled fires at BOTH incremental seams (any
+    TCSDN_CHAOS_SEED): every tick's served labels must equal a fresh
+    full-table re-predict — the fault path may only ever cost speed,
+    never correctness."""
+    full, inc_eng, inc, predict, params = _inc_pair()
+    with faults.installed(faults.FaultPlan([
+        faults.FaultRule("serve.dirty_mask", p=0.3, times=None),
+        faults.FaultRule("serve.label_cache", p=0.3, times=None),
+    ], SEED)) as plan:
+        for t in range(1, 13):
+            n = (5 * t) % 30
+            _drive_pair(full, inc_eng, t, n)
+            _assert_labels_fresh(
+                full, np.asarray(inc.labels()), predict, params
+            )
+    # the schedule is seeded; whatever subset fired, nothing escaped
+    assert all(
+        s in ("serve.dirty_mask", "serve.label_cache")
+        for s, _ in plan.fires
+    )
+
+
+def test_serve_dirty_mask_fault_sharded_engine_absorbed():
+    """The sharded spine's incremental read side shares the seams: a
+    fire degrades that tick to the full per-shard re-predict and the
+    rebuilt dirty mask keeps later renders exact."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest's 8-device mesh")
+    from traffic_classifier_sdn_tpu.models import gnb
+    from traffic_classifier_sdn_tpu.parallel import (
+        mesh as meshlib,
+        table_sharded as tsh,
+    )
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (3, 12)),
+        "var": rng.gamma(2.0, 50.0, (3, 12)) + 1.0,
+        "class_prior": np.full(3, 1 / 3),
+    })
+    mesh = meshlib.make_mesh(n_data=8, n_state=1)
+    kw = dict(predict_fn=gnb.predict, params=params, table_rows=16)
+    full = tsh.ShardedFlowEngine(mesh, 128, **kw)
+    inc = tsh.ShardedFlowEngine(mesh, 128, incremental=True, **kw)
+    for t in (1, 2):
+        _drive(full, t, 40)
+        _drive(inc, t, 40)
+        rf, _ = full.tick_render(now=full.last_time, idle_seconds=3600)
+        ri, _ = inc.tick_render(now=inc.last_time, idle_seconds=3600)
+        assert rf == ri
+    _drive(full, 3, 10)
+    _drive(inc, 3, 10)
+    with faults.installed(faults.FaultPlan(
+        [faults.FaultRule("serve.dirty_mask")], SEED
+    )) as plan:
+        rf, _ = full.tick_render(now=full.last_time, idle_seconds=3600)
+        ri, _ = inc.tick_render(now=inc.last_time, idle_seconds=3600)
+    assert rf == ri  # the fire degraded to full re-predict, absorbed
+    assert plan.fires == [("serve.dirty_mask", 1)]
+    _drive(full, 4, 25)
+    _drive(inc, 4, 25)
+    rf, _ = full.tick_render(now=full.last_time, idle_seconds=3600)
+    ri, _ = inc.tick_render(now=inc.last_time, idle_seconds=3600)
+    assert rf == ri

@@ -1,0 +1,454 @@
+"""Incremental active-set serving (serving/incremental.py).
+
+The load-bearing guarantee is BYTE-IDENTITY: dirty-set prediction with
+the persistent label cache must render exactly what a full-table
+re-predict renders, at every churn level (including 0% and an
+eviction-heavy schedule), serial and pipelined, for device-kernel and
+host-native predict paths — and the cache must invalidate wholesale on
+model promotion/rollback hot-swaps and degrade rung changes
+(wrong-but-cached must never survive a promotion). Warmup must
+AOT-compile every dirty-bucket shape so the first nonzero-churn tick
+pays no compile (the PR 4 cold-tick discipline applied to the new
+programs).
+"""
+
+import contextlib
+import io
+import os
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu import cli
+from traffic_classifier_sdn_tpu.core import flow_table as ft
+from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+from traffic_classifier_sdn_tpu.ingest.protocol import (
+    TelemetryRecord,
+    format_line,
+)
+from traffic_classifier_sdn_tpu.serving.incremental import (
+    IncrementalLabels,
+    dirty_buckets,
+)
+from traffic_classifier_sdn_tpu.utils.metrics import global_metrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _gnb_predict_and_params(n_classes=3, seed=0):
+    from traffic_classifier_sdn_tpu.models import gnb, jit_serving_fn
+
+    rng = np.random.RandomState(seed)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (n_classes, 12)),
+        "var": rng.gamma(2.0, 50.0, (n_classes, 12)) + 1.0,
+        "class_prior": np.full(n_classes, 1 / n_classes),
+    })
+    return jit_serving_fn(gnb.predict), params
+
+
+def _rec(t, i, pkts, bts):
+    return TelemetryRecord(
+        time=t, datapath="1", in_port=1, eth_src=f"f{i:03d}",
+        eth_dst="gw", out_port=2, packets=pkts, bytes=bts,
+    )
+
+
+class _Stream:
+    """Deterministic cumulative-counter stream with per-tick flow
+    subsets — the churn-schedule harness (one instance per engine so
+    both engines of an A/B see identical records)."""
+
+    def __init__(self):
+        self.cum = {}
+
+    def tick(self, engine, t, flows):
+        engine.mark_tick()
+        records = []
+        for i in flows:
+            p, b = self.cum.get(i, (0, 0))
+            p += 7 + i
+            b += 1000 + 13 * i
+            self.cum[i] = (p, b)
+            records.append(_rec(t, i, p, b))
+        engine.ingest(records)
+        engine.step()
+
+
+# the churn schedule: fill, tiny churn, ZERO churn, big churn, zero
+# again, medium — every bucket transition and the none-dirty fast path
+SCHEDULE = [range(48), range(3), range(0), range(48), range(0), range(20)]
+
+
+def test_dirty_labels_match_full_repredict_across_churn():
+    """cache[i] == full_predict[i] for every in-use row at every churn
+    level, including 0% (no predict at all) and full-table churn."""
+    predict, params = _gnb_predict_and_params()
+    full = FlowStateEngine(capacity=64)
+    inc_eng = FlowStateEngine(capacity=64, track_dirty=True)
+    inc = IncrementalLabels(inc_eng, predict, params)
+    sf, si = _Stream(), _Stream()
+    for t, flows in enumerate(SCHEDULE, start=1):
+        sf.tick(full, t, flows)
+        si.tick(inc_eng, t, flows)
+        want = np.asarray(predict(params, full.features()))
+        got = np.asarray(inc.labels())
+        in_use = np.asarray(full.table.in_use)[:-1]
+        np.testing.assert_array_equal(want[in_use], got[in_use])
+    st = inc.status()
+    assert st["subset_predicts"] >= 1  # the dirty path actually ran
+    # a quiet follow-up render re-predicts nothing: full cache coverage
+    inc.labels()
+    assert inc.status()["dirty_rows"] == 0
+    assert inc.status()["coverage"] == 1.0
+
+
+def test_eviction_invalidates_cache_rows():
+    """An eviction-heavy schedule: evicted rows' cached labels are
+    invalidated (features dropped to zero), reused slots re-predict,
+    and identity with the full path holds throughout."""
+    predict, params = _gnb_predict_and_params()
+    full = FlowStateEngine(capacity=32)
+    inc_eng = FlowStateEngine(capacity=32, track_dirty=True)
+    inc = IncrementalLabels(inc_eng, predict, params)
+    sf, si = _Stream(), _Stream()
+    sf.tick(full, 1, range(24))
+    si.tick(inc_eng, 1, range(24))
+    inc.labels()
+    # keep 4 flows alive, let 20 go idle, evict, then reuse the slots
+    for t in (5, 6):
+        sf.tick(full, t, range(4))
+        si.tick(inc_eng, t, range(4))
+    assert full.evict_idle(now=10, idle_seconds=3) == \
+        inc_eng.evict_idle(now=10, idle_seconds=3) > 0
+    sf.tick(full, 11, range(30))  # reuses freed slots
+    si.tick(inc_eng, 11, range(30))
+    want = np.asarray(predict(params, full.features()))
+    got = np.asarray(inc.labels())
+    in_use = np.asarray(full.table.in_use)[:-1]
+    np.testing.assert_array_equal(want[in_use], got[in_use])
+
+
+def test_promotion_hot_swap_invalidates_whole_cache():
+    """A DriftGate install (promotion) — and a second install
+    (rollback) — must invalidate the whole cache: after the swap every
+    row re-predicts under the NEW model; wrong-but-cached never
+    survives."""
+    from traffic_classifier_sdn_tpu.serving.drift import DriftGate
+
+    predict_a, params_a = _gnb_predict_and_params(seed=0)
+    predict_b, params_b = _gnb_predict_and_params(seed=7)
+    gate = DriftGate(predict_a)
+    eng = FlowStateEngine(capacity=64, track_dirty=True)
+    inc = IncrementalLabels(eng, gate, params_a)
+    s = _Stream()
+    s.tick(eng, 1, range(40))
+    before = np.asarray(inc.labels())
+    in_use = np.asarray(eng.table.in_use)[:-1]
+    np.testing.assert_array_equal(
+        before[in_use],
+        np.asarray(predict_a(params_a, eng.features()))[in_use],
+    )
+    # promotion: NO new telemetry, yet every row must re-label
+    gate.install(predict_b, params_b)
+    s.tick(eng, 2, range(0))
+    after = np.asarray(inc.labels())
+    np.testing.assert_array_equal(
+        after[in_use],
+        np.asarray(predict_b(params_b, eng.features()))[in_use],
+    )
+    assert inc.status()["invalidations"] >= 1
+    # rollback: install again — invalidates again
+    gate.install(predict_a, params_a)
+    s.tick(eng, 3, range(0))
+    rolled = np.asarray(inc.labels())
+    np.testing.assert_array_equal(
+        rolled[in_use],
+        np.asarray(predict_a(params_a, eng.features()))[in_use],
+    )
+    assert inc.status()["invalidations"] >= 2
+
+
+def test_degrade_rung_change_bumps_label_epoch():
+    """The DegradeLadder's label_epoch moves exactly when the RUNG
+    moves — the signal the incremental cache invalidates on."""
+    from traffic_classifier_sdn_tpu.serving.degrade import DegradeLadder
+
+    predict, params = _gnb_predict_and_params()
+
+    def boom(_params, X):
+        raise RuntimeError("sick device")
+
+    ladder = DegradeLadder(
+        boom, None, deadline=0.0, probe_every=3600.0,
+    )
+    e0 = ladder.label_epoch
+    X = np.zeros((4, 12), np.float32)
+    ladder(params, X)  # error → DEGRADED → (no fallback) BROKEN
+    assert ladder.label_epoch > e0
+    ladder.close()
+
+
+def test_sharded_incremental_matches_full():
+    """The sharded spine's per-shard dirty/cache path renders exactly
+    what the full per-shard re-predict renders, across churn levels
+    and eviction."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest's 8-device mesh")
+    from traffic_classifier_sdn_tpu.models import gnb
+    from traffic_classifier_sdn_tpu.parallel import (
+        mesh as meshlib,
+        table_sharded as tsh,
+    )
+
+    _, params = _gnb_predict_and_params()
+    mesh = meshlib.make_mesh(n_data=8, n_state=1)
+    kw = dict(predict_fn=gnb.predict, params=params, table_rows=16)
+    full = tsh.ShardedFlowEngine(mesh, 128, **kw)
+    inc = tsh.ShardedFlowEngine(mesh, 128, incremental=True, **kw)
+    sf, si = _Stream(), _Stream()
+    for t, flows in enumerate(SCHEDULE, start=1):
+        sf.tick(full, t, flows)
+        si.tick(inc, t, flows)
+        rf, ef = full.tick_render(now=full.last_time, idle_seconds=3600)
+        ri, ei = inc.tick_render(now=inc.last_time, idle_seconds=3600)
+        assert rf == ri and ef == ei
+    # eviction + slot reuse
+    rf, ef = full.tick_render(now=100, idle_seconds=1)
+    ri, ei = inc.tick_render(now=100, idle_seconds=1)
+    assert rf == ri and ef == ei and ef > 0
+    sf.tick(full, 101, range(10))
+    si.tick(inc, 101, range(10))
+    rf, _ = full.tick_render(now=101, idle_seconds=3600)
+    ri, _ = inc.tick_render(now=101, idle_seconds=3600)
+    assert rf == ri
+
+
+# ---------------------------------------------------------------------------
+# CLI byte-identity: --incremental auto vs off
+# ---------------------------------------------------------------------------
+
+
+def _native_checkpoint(tmp_path, family):
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+
+    rng = np.random.RandomState(0)
+    if family == "gnb":
+        from traffic_classifier_sdn_tpu.models import gnb
+
+        params = gnb.from_numpy({
+            "theta": rng.gamma(2.0, 100.0, (2, 12)),
+            "var": rng.gamma(2.0, 50.0, (2, 12)) + 1.0,
+            "class_prior": np.full(2, 0.5),
+        })
+    else:  # knn
+        from traffic_classifier_sdn_tpu.train import knn as tknn
+
+        X = rng.rand(64, 12).astype(np.float32) * 100
+        y = rng.randint(0, 2, 64)
+        params = tknn.fit(X, y, n_neighbors=3, n_classes=2)
+    path = str(tmp_path / f"{family}_ckpt")
+    ck.save_model(path, family, params, classes=("ping", "voice"))
+    return path
+
+
+def _serve(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli.main(argv)
+    return buf.getvalue()
+
+
+def _churn_capture(tmp_path):
+    """A replay capture with per-tick churn variation: full population,
+    then small subsets, then a near-idle tick — the dirty fraction
+    swings 100% → ~6% → big again, and flows that go quiet are ranked
+    from the CACHE, not re-predicted."""
+    cum = {}
+    lines = []
+    schedule = [range(32), range(4), range(1), range(24), range(2)]
+    for t, flows in enumerate(schedule, start=1):
+        for i in flows:
+            p, b = cum.get(i, (0, 0))
+            p += 5 + i
+            b += 900 + 17 * i
+            cum[i] = (p, b)
+            lines.append(format_line(_rec(t, i, p, b)))
+    path = tmp_path / "churn.capture"
+    path.write_bytes(b"".join(lines))
+    return str(path)
+
+
+def _capture_common(ckpt, capture, subcommand="gaussiannb"):
+    return [
+        subcommand,
+        "--native-checkpoint", ckpt,
+        "--source", "replay",
+        "--capture", capture,
+        "--capacity", "64",
+        "--print-every", "1",
+        "--idle-timeout", "0",
+        "--table-rows", "8",
+    ]
+
+
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+def test_incremental_matches_full_over_churn_capture(tmp_path, pipeline):
+    common = _capture_common(
+        _native_checkpoint(tmp_path, "gnb"), _churn_capture(tmp_path)
+    ) + ["--pipeline", pipeline]
+    a = _serve(common + ["--incremental", "off"])
+    b = _serve(common + ["--incremental", "auto"])
+    assert "Flow ID" in a and a.count("Flow ID") == 5
+    assert b == a
+
+
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+def test_incremental_matches_full_with_eviction(tmp_path, pipeline):
+    """Eviction-heavy: a 2 s idle horizon evicts the big first-tick
+    population under the later quiet ticks — the cache rows must
+    invalidate with their slots."""
+    common = _capture_common(
+        _native_checkpoint(tmp_path, "gnb"), _churn_capture(tmp_path)
+    ) + ["--pipeline", pipeline]
+    common[common.index("--idle-timeout") + 1] = "2"
+    a = _serve(common + ["--incremental", "off"])
+    b = _serve(common + ["--incremental", "auto"])
+    assert "Flow ID" in a
+    assert b == a
+
+
+def test_incremental_matches_full_table_render(tmp_path):
+    common = _capture_common(
+        _native_checkpoint(tmp_path, "gnb"), _churn_capture(tmp_path)
+    ) + ["--pipeline", "on", "--table-rows", "0"]
+    a = _serve(common + ["--incremental", "off"])
+    b = _serve(common + ["--incremental", "auto"])
+    assert a.count("Flow ID") == 5
+    assert b == a
+
+
+def test_incremental_matches_full_host_native(tmp_path, monkeypatch):
+    """Host-native kernels get the dirty-subset entry point: the C++
+    KNN predicts only the churned rows on the device-stage worker and
+    merges into the host-side cache — rendered output byte-identical
+    to the full host-native re-predict."""
+    from traffic_classifier_sdn_tpu.native import knn as native_knn
+
+    if not native_knn.available():
+        pytest.skip("g++ unavailable — no host-native kernel to serve")
+    monkeypatch.setenv("TCSDN_KNN_TOPK", "native")
+    for pipeline in ("off", "on"):
+        common = _capture_common(
+            _native_checkpoint(tmp_path, "knn"),
+            _churn_capture(tmp_path), subcommand="knearest",
+        ) + ["--pipeline", pipeline]
+        a = _serve(common + ["--incremental", "off"])
+        b = _serve(common + ["--incremental", "auto"])
+        assert "Flow ID" in a
+        assert b == a, f"pipeline={pipeline}"
+
+
+def test_incremental_serve_reports_metrics(tmp_path):
+    """The telemetry satellites: dirty_rows gauge, predict_rows_saved
+    counter, and the stage_compact_s histogram all populate on an
+    incremental serve."""
+    common = _capture_common(
+        _native_checkpoint(tmp_path, "gnb"), _churn_capture(tmp_path)
+    )
+    _serve(common + ["--incremental", "auto", "--pipeline", "off"])
+    assert "dirty_rows" in global_metrics.gauges
+    assert global_metrics.counters.get("predict_rows_saved", 0) > 0
+    assert global_metrics.histograms["stage_compact_s"].count > 0
+
+
+def test_healthz_reports_label_cache_block():
+    from traffic_classifier_sdn_tpu.obs import HealthState
+
+    h = HealthState()
+    h.set_label_cache(lambda: {"mode": "device", "coverage": 0.97,
+                               "dirty_rows": 3})
+    h.tick()
+    healthy, report = h.check()
+    assert healthy
+    assert report["label_cache"]["coverage"] == 0.97
+
+
+# ---------------------------------------------------------------------------
+# Warmup: every dirty-bucket program compiled before the loop
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_first_nonzero_churn_tick_compiles_nothing():
+    """After warmup_serving(incremental=True), a full serve tick through
+    the dirty path — fused scatter+mark, count, compact, dirty-row
+    gather, subset predict, cache merge — re-traces/compiles NOTHING
+    at its first nonzero-churn tick (mirrors the PR 4 cold-tick test)."""
+    from traffic_classifier_sdn_tpu.ingest.batcher import (
+        apply_wire_dirty_jit,
+    )
+    from traffic_classifier_sdn_tpu.serving import incremental as inc_mod
+    from traffic_classifier_sdn_tpu.serving import warmup as wu
+
+    predict, params = _gnb_predict_and_params()
+    engine = FlowStateEngine(capacity=256, track_dirty=True)
+    inc = IncrementalLabels(engine, predict, params)
+    stats = wu.warmup_serving(
+        engine, predict, params, table_rows=16, idle_timeout=60,
+        incremental=True,
+    )
+    assert any(w.startswith("apply_wire_dirty[") for w in stats["warmed"])
+    assert any(w.startswith("dirty[") for w in stats["warmed"])
+
+    sizes = {
+        "predict": predict._cache_size(),
+        "apply": apply_wire_dirty_jit._cache_size(),
+        "compact": inc_mod.compact_dirty_jit._cache_size(),
+        "gather": inc_mod.features12_at_jit._cache_size(),
+        "merge": inc_mod.merge_labels_jit._cache_size(),
+        "count": inc_mod.dirty_count_jit._cache_size(),
+    }
+    s = _Stream()
+    s.tick(engine, 1, range(64))
+    inc.labels()  # full first render primes the cache
+    s.tick(engine, 2, range(9))  # nonzero churn → bucket 16 subset
+    import jax
+
+    jax.block_until_ready(inc.labels())
+    assert inc.status()["subset_predicts"] >= 1
+    assert sizes == {
+        "predict": predict._cache_size(),
+        "apply": apply_wire_dirty_jit._cache_size(),
+        "compact": inc_mod.compact_dirty_jit._cache_size(),
+        "gather": inc_mod.features12_at_jit._cache_size(),
+        "merge": inc_mod.merge_labels_jit._cache_size(),
+        "count": inc_mod.dirty_count_jit._cache_size(),
+    }, "the first nonzero-churn tick paid a compile"
+
+
+def test_dirty_buckets_shape():
+    assert dirty_buckets(1 << 20) == (
+        16, 64, 256, 1024, 4096, 16384, 65536, 262144,
+    )
+    assert dirty_buckets(64) == (16,)
+    assert dirty_buckets(16) == ()
+
+
+def test_compact_and_gather_match_full_projection():
+    """features12_at(table, idx) is elementwise-identical to
+    features12(table)[idx] — the identity the whole byte-equality
+    story rests on."""
+    engine = FlowStateEngine(capacity=32, track_dirty=True)
+    s = _Stream()
+    s.tick(engine, 1, range(20))
+    s.tick(engine, 2, range(7))
+    idx = np.asarray(
+        ft.compact_dirty(engine.dirty, 16)
+    )
+    Xd = np.asarray(ft.features12_at(engine.table, idx))
+    X = np.asarray(ft.features12(engine.table))
+    valid = idx < engine.table.capacity
+    np.testing.assert_array_equal(Xd[valid], X[idx[valid]])
+    assert Xd[~valid].sum() == 0  # padding rows project to zeros

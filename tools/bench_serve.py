@@ -46,6 +46,36 @@ def _build_model(args):
 
     from traffic_classifier_sdn_tpu.models import gnb, jit_serving_fn
 
+    if args.model == "forest-synth":
+        # a forest fit on synthetic class-shaped data at bench time —
+        # the flagship-predict-cost stand-in for hosts without the
+        # reference checkpoint tree; resolves through the same serving
+        # path (honors TCSDN_FOREST_KERNEL, e.g. `native` for the C++
+        # walk the 1.79 s serve_2m_cpu_native_forest baseline measured)
+        from traffic_classifier_sdn_tpu.models import make_loaded_model
+        from traffic_classifier_sdn_tpu.models.base import ClassList
+        from traffic_classifier_sdn_tpu.train import forest as tforest
+
+        rng = np.random.RandomState(1)
+        n_cls = 6
+        theta = rng.gamma(2.0, 100.0, (n_cls, 12))
+        ytr = rng.randint(0, n_cls, 8192)
+        Xtr = (
+            rng.gamma(2.0, 1.0, (8192, 12)) * theta[ytr]
+        ).astype(np.float32)
+        params = tforest.fit(
+            Xtr, ytr, n_classes=n_cls, n_trees=args.synth_trees
+        )
+        m = make_loaded_model(
+            "forest", params,
+            ClassList(tuple(f"class{i}" for i in range(n_cls))),
+        )
+        raw_predict, sp = m.serving_path()
+        predict = jit_serving_fn(raw_predict)
+        if getattr(raw_predict, "host_native", False) and args.shards >= 1:
+            sys.exit("host-native kernels are single-device host "
+                     "serving; use a device kernel with --shards")
+        return predict, sp, raw_predict
     if args.model in ("forest", "knn"):
         # the reference checkpoint through the serving-path resolution —
         # honors TCSDN_FOREST_KERNEL / TCSDN_KNN_TOPK, so the chip day
@@ -82,7 +112,7 @@ def _build_model(args):
     return jit_serving_fn(gnb.predict), params, gnb.predict
 
 
-def _make_engine(args, native, raw_fn, params):
+def _make_engine(args, native, raw_fn, params, incremental=False):
     from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
 
     if args.shards >= 1:
@@ -95,18 +125,26 @@ def _make_engine(args, native, raw_fn, params):
             meshlib.make_mesh(n_data=args.shards, n_state=1),
             args.capacity, predict_fn=raw_fn, params=params,
             table_rows=args.table_rows, native=native,
+            incremental=incremental,
         )
-    return FlowStateEngine(capacity=args.capacity, native=native)
+    return FlowStateEngine(
+        capacity=args.capacity, native=native, track_dirty=incremental
+    )
 
 
-def _run_serial(args, eng, predict, params, payloads):
-    """The serial chain — one tick fully synchronous, per-stage timed."""
+def _run_serial(args, eng, predict, params, payloads, inc=None):
+    """The serial chain — one tick fully synchronous, per-stage timed.
+    ``inc`` (serving/incremental.IncrementalLabels) swaps the
+    full-table predict for the dirty-set/label-cache path; the
+    rendered rows per tick ride back in the result for A/B identity
+    checks."""
     import numpy as np
 
     import jax
 
     timings = {k: [] for k in ("ingest", "step", "predict", "render",
                                "evict", "tick")}
+    rendered_rows = []
     n_parsed = 0
     t_wall0 = time.perf_counter()
     for ti, payload in enumerate(payloads):
@@ -144,7 +182,13 @@ def _run_serial(args, eng, predict, params, payloads):
             # render stage's device fetch is the tick's first hard sync,
             # so it also absorbs the (async-dispatched) scatter + predict
             # time — "predict" is dispatch-only, "render" holds the wait.
-            labels = predict(params, eng.features())
+            # Incremental mode reads the label cache instead: only this
+            # tick's dirty rows are re-predicted (its dirty-count fetch
+            # is a real sync, so "predict" carries the compact cost).
+            if inc is not None:
+                labels = inc.labels()
+            else:
+                labels = predict(params, eng.features())
             t3 = time.perf_counter()
             ranked = eng.render_sample(labels, args.table_rows)
             sample = eng.slot_metadata(slots=[s for s, *_ in ranked])
@@ -162,6 +206,7 @@ def _run_serial(args, eng, predict, params, payloads):
         timings["render"].append(t4 - t3)
         timings["evict"].append(t5 - t4)
         timings["tick"].append(t5 - t0)
+        rendered_rows.append(rows)
         print(
             f"# tick {ti}: {footer}, evicted {evicted}, "
             f"tick {(t5 - t0) * 1e3:.0f} ms",
@@ -171,10 +216,11 @@ def _run_serial(args, eng, predict, params, payloads):
     wall = time.perf_counter() - t_wall0
     p50 = {k: float(np.median(v)) for k, v in timings.items()}
     return {"timings": timings, "p50": p50, "wall_s": wall,
-            "n_parsed": n_parsed, "pipeline_stats": None}
+            "n_parsed": n_parsed, "pipeline_stats": None,
+            "rendered_rows": rendered_rows}
 
 
-def _run_pipelined(args, eng, predict, params, payloads):
+def _run_pipelined(args, eng, predict, params, payloads, inc=None):
     """The pipelined loop: host stage ingests/scatters/dispatches; the
     device stage (worker) syncs and builds the render rows — the same
     shape cli.py serves with (serving/pipeline.py).
@@ -194,7 +240,7 @@ def _run_pipelined(args, eng, predict, params, payloads):
 
     host_native = getattr(predict, "host_native", False)
     fs = (
-        None if (args.shards >= 1 or host_native)
+        None if (args.shards >= 1 or host_native or inc is not None)
         else FeatureStage(args.capacity)
     )
     rendered = []
@@ -228,7 +274,7 @@ def _run_pipelined(args, eng, predict, params, payloads):
                             (s, *sample[s], c)
                             for s, c, _fa, _ra in ranked if s in sample
                         ]
-                        rendered.append((len(rows), n_flows))
+                        rendered.append((rows, n_flows))
                 else:
                     # every tick, unconditionally — the A/B must pay
                     # identical per-tick work in both modes (the serial
@@ -240,7 +286,8 @@ def _run_pipelined(args, eng, predict, params, payloads):
                     # ever at stake.
                     eng.evict_idle(now=eng.last_time, idle_seconds=3600)
                     read = dispatch_read(
-                        eng, predict, params, args.table_rows, fs
+                        eng, predict, params, args.table_rows, fs,
+                        inc=inc,
                     )
 
                     def job(read=read):
@@ -254,7 +301,7 @@ def _run_pipelined(args, eng, predict, params, payloads):
                             (s, *sample[s], c)
                             for s, c, _fa, _ra in ranked if s in sample
                         ]
-                        rendered.append((len(rows), read.n_flows))
+                        rendered.append((rows, read.n_flows))
                 pipe.submit(job)
                 t3 = time.perf_counter()
             timings["ingest"].append(t1 - t0)
@@ -271,12 +318,13 @@ def _run_pipelined(args, eng, predict, params, payloads):
     finally:
         pipe.shutdown(drain=False)
     wall = time.perf_counter() - t_wall0
-    for n_rows, _nf in rendered:
-        assert n_rows <= args.table_rows
+    for rows, _nf in rendered:
+        assert len(rows) <= args.table_rows
     p50 = {k: float(np.median(v)) for k, v in timings.items()}
     return {"timings": timings, "p50": p50, "wall_s": wall,
             "n_parsed": n_parsed, "pipeline_stats": pipe.stats(),
-            "ticks_rendered": len(rendered)}
+            "ticks_rendered": len(rendered),
+            "rendered_rows": [rows for rows, _nf in rendered]}
 
 
 def _mode_summary(args, runs, n_flows_per_tick):
@@ -324,9 +372,155 @@ def _mode_summary(args, runs, n_flows_per_tick):
     return out
 
 
+def _run_sweep(args, native, predict, params, raw_fn,
+               n_flows: int) -> None:
+    """The dirty sweep (docs/artifacts/serve_dirty_sweep_cpu.json): per
+    churn level, A/B incremental vs full re-predict over IDENTICAL
+    payloads with the median-of-interleaved-repeats machinery, assert
+    render identity, and emit one ``serve_dirty_sweep`` JSON object.
+    Engines are rebuilt per level (fresh population, fresh cache) and
+    released before the next one; the jit caches persist, so only the
+    first level pays compiles (pass --warmup to keep even that out of
+    the timed region)."""
+    import numpy as np  # noqa: F401 — _mode_summary pulls it lazily
+
+    import jax
+
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+
+    levels = [float(x) for x in args.churn_sweep.split(",")]
+    out_levels = []
+    warmed = False
+    for lvl in levels:
+        # one synthetic feed per level: a full-churn fill tick first
+        # (churn is meaningful only against a populated table), then
+        # the measured payloads at the level — identical for both modes
+        syn = SyntheticFlows(n_flows=n_flows, seed=0, churn=1.0)
+        fill = syn.tick_bytes()
+        syn.churn = lvl
+        chunks = [
+            [syn.tick_bytes() for _ in range(args.ticks)]
+            for _ in range(args.repeat)
+        ]
+        engines = {
+            "full": _make_engine(args, native, raw_fn, params),
+            "incremental": _make_engine(
+                args, native, raw_fn, params, incremental=True
+            ),
+        }
+        incs: dict = {"full": None, "incremental": None}
+        if args.shards < 1:
+            from traffic_classifier_sdn_tpu.serving.incremental import (
+                IncrementalLabels,
+            )
+
+            incs["incremental"] = IncrementalLabels(
+                engines["incremental"], predict, params
+            )
+        if args.warmup and not warmed:
+            from traffic_classifier_sdn_tpu.serving.warmup import (
+                warmup_serving,
+            )
+
+            t0 = time.perf_counter()
+            for name, eng in engines.items():
+                warmup_serving(
+                    eng, predict, params, table_rows=args.table_rows,
+                    idle_timeout=3600 if args.shards < 1 else None,
+                    incremental=name == "incremental",
+                )
+            print(
+                f"# warmup in {time.perf_counter() - t0:.2f}s",
+                file=sys.stderr, flush=True,
+            )
+            warmed = True
+        for eng in engines.values():
+            eng.mark_tick()
+            eng.ingest_bytes(fill)
+            eng.step()
+        runs: dict = {name: [] for name in engines}
+        for rep, chunk in enumerate(chunks):
+            for name, eng in engines.items():
+                print(
+                    f"# sweep churn={lvl} repeat {rep} mode {name}",
+                    file=sys.stderr, flush=True,
+                )
+                runs[name].append(
+                    _run_serial(args, eng, predict, params, chunk,
+                                inc=incs[name])
+                )
+        ident = all(
+            rf == ri
+            for runf, runi in zip(runs["full"], runs["incremental"])
+            for rf, ri in zip(
+                runf["rendered_rows"], runi["rendered_rows"]
+            )
+        )
+        res = {
+            name: _mode_summary(args, runs[name], n_flows)
+            for name in runs
+        }
+        f = res["full"]["stage_p50_ms"]["tick"]
+        i = res["incremental"]["stage_p50_ms"]["tick"]
+        out_levels.append({
+            "churn": lvl,
+            "full": res["full"],
+            "incremental": res["incremental"],
+            "tick_p50_speedup": round(f / i, 3) if i else None,
+            "render_identical": ident,
+        })
+        del engines, incs, runs  # free two tables before the next level
+
+    out = {
+        "metric": "serve_dirty_sweep",
+        "capacity": args.capacity,
+        "tracked_flows": n_flows,
+        "ticks": args.ticks,
+        "repeat": args.repeat,
+        "table_rows_rendered": args.table_rows,
+        "predict_model": args.model,
+        "native_ingest": native,
+        **({"shards": args.shards} if args.shards >= 1 else {}),
+        "platform": jax.devices()[0].platform,
+        "warmup": args.warmup,
+        "levels": out_levels,
+    }
+    print(json.dumps(out), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--capacity", type=int, default=1 << 20)
+    ap.add_argument(
+        "--churn-fraction", type=float, default=1.0,
+        help="fraction of the synthetic flow population emitting "
+        "telemetry each tick (default 1.0 — every flow every tick); "
+        "the updated-row knob behind incremental serving: at 0.1 only "
+        "10%% of flows change per tick, so the dirty-set predict "
+        "touches 10%% of the table",
+    )
+    ap.add_argument(
+        "--churn-sweep", default=None, metavar="L0,L1,...",
+        help="run the dirty sweep instead of a single measurement: "
+        "for each comma-separated churn level, A/B incremental vs "
+        "full re-predict over identical payloads (serial chain, "
+        "repeats interleaved) and emit one serve_dirty_sweep JSON "
+        "object with per-level per-mode timings, speedups, and a "
+        "render-identity verdict (e.g. 0,0.01,0.1,1.0)",
+    )
+    ap.add_argument(
+        "--incremental", choices=("off", "on", "both"), default="off",
+        help="label source: off = full-table re-predict every render "
+        "(the historical bench), on = dirty-set prediction with the "
+        "device-resident label cache (serving/incremental.py), both = "
+        "A/B over identical payloads, one serve_incremental_ab JSON "
+        "object (requires --pipeline off or on, not both)",
+    )
+    ap.add_argument(
+        "--synth-trees", type=int, default=100,
+        help="tree count for --model forest-synth (default 100, the "
+        "flagship checkpoint's size)",
+    )
     ap.add_argument(
         "--flows-per-tick", type=int, default=0,
         help="synthetic conversations per tick (2 records each); "
@@ -343,7 +537,8 @@ def main() -> None:
     )
     ap.add_argument("--table-rows", type=int, default=64)
     ap.add_argument(
-        "--model", choices=("gnb", "forest", "knn"), default="gnb",
+        "--model", choices=("gnb", "forest", "knn", "forest-synth"),
+        default="gnb",
         help="predict stage: gnb (cheapest full-table predict; the CPU "
         "default), forest (the flagship 100-tree checkpoint), or knn "
         "(the KNeighbors checkpoint) — the latter two resolve through "
@@ -416,7 +611,9 @@ def main() -> None:
     if n_flows > cap:
         sys.exit("--flows-per-tick exceeds --capacity (every "
                  "conversation needs a slot)")
-    syn = SyntheticFlows(n_flows=n_flows, seed=0)
+    if args.pipeline == "both" and args.incremental == "both":
+        sys.exit("--pipeline both and --incremental both cannot "
+                 "combine — A/B one axis at a time")
 
     # init-first liveness: a wedged worker hangs the first device call,
     # and a silent run is indistinguishable from a slow compile
@@ -425,9 +622,26 @@ def main() -> None:
 
     predict, params, raw_fn = _build_model(args)
 
+    if args.churn_sweep is not None:
+        _run_sweep(args, native, predict, params, raw_fn, n_flows)
+        return
+
+    syn = SyntheticFlows(
+        n_flows=n_flows, seed=0, churn=args.churn_fraction
+    )
+    fill_payload = None
+    if args.churn_fraction < 1.0:
+        # populate the table before churn applies: the dirty fraction
+        # is only meaningful against a full tracked population
+        syn.churn = 1.0
+        fill_payload = syn.tick_bytes()
+        syn.churn = args.churn_fraction
+
     print(
         f"# generating {args.repeat} × {args.ticks} ticks × "
-        f"{2 * n_flows} records (capacity {cap}, native={native})",
+        f"~{int(2 * n_flows * args.churn_fraction)} records "
+        f"(capacity {cap}, native={native}, "
+        f"churn={args.churn_fraction})",
         file=sys.stderr, flush=True,
     )
     payload_chunks = [
@@ -436,53 +650,92 @@ def main() -> None:
     ]
     total_records = sum(p.count(b"\n") for p in payload_chunks[0])
 
-    modes = (
-        ("serial", "pipelined") if args.pipeline == "both"
-        else (("pipelined",) if args.pipeline == "on" else ("serial",))
-    )
-    if args.pipeline == "both" and not args.warmup:
+    # modes: (name, pipelined, incremental) — one A/B axis at a time
+    inc_on = args.incremental == "on"
+    if args.pipeline == "both":
+        modes = [("serial", False, inc_on), ("pipelined", True, inc_on)]
+    elif args.incremental == "both":
+        pipelined = args.pipeline == "on"
+        modes = [
+            ("full", pipelined, False),
+            ("incremental", pipelined, True),
+        ]
+    else:
+        pipelined = args.pipeline == "on"
+        modes = [("pipelined" if pipelined else "serial",
+                  pipelined, inc_on)]
+    mode_names = [name for name, _, _ in modes]
+    if len(modes) > 1 and not args.warmup:
         print(
-            "# NOTE: A/B without --warmup — the serial mode runs first "
-            "and pays every cold compile the pipelined mode then "
-            "inherits; pass --warmup for a clean comparison",
+            "# NOTE: A/B without --warmup — the first mode pays every "
+            "cold compile the second mode then inherits; pass --warmup "
+            "for a clean comparison",
             file=sys.stderr, flush=True,
         )
 
     engines = {
-        mode: _make_engine(args, native, raw_fn, params)
-        for mode in modes
+        name: _make_engine(args, native, raw_fn, params,
+                           incremental=inc_flag)
+        for name, _, inc_flag in modes
     }
+    incs: dict = {}
+    for name, _, inc_flag in modes:
+        if inc_flag and args.shards < 1:
+            from traffic_classifier_sdn_tpu.serving.incremental import (
+                IncrementalLabels,
+            )
+
+            incs[name] = IncrementalLabels(
+                engines[name], predict, params
+            )
+        else:
+            incs[name] = None
     if args.warmup:
         from traffic_classifier_sdn_tpu.serving.warmup import (
             warmup_serving,
         )
 
         t0 = time.perf_counter()
-        stats = warmup_serving(
-            engines[modes[0]], predict, params,
-            table_rows=args.table_rows,
-            idle_timeout=3600 if args.shards < 1 else None,
-        )
+        # one warm per engine kind: a dirty-tracking engine scatters
+        # through the fused apply+mark program, the plain one doesn't —
+        # both must be hot for a clean A/B
+        warmed_kinds = set()
+        for name, _, inc_flag in modes:
+            if inc_flag in warmed_kinds:
+                continue
+            warmed_kinds.add(inc_flag)
+            stats = warmup_serving(
+                engines[name], predict, params,
+                table_rows=args.table_rows,
+                idle_timeout=3600 if args.shards < 1 else None,
+                incremental=inc_flag,
+            )
         print(
             f"# warmup: {len(stats['warmed'])} programs in "
             f"{time.perf_counter() - t0:.2f}s",
             file=sys.stderr, flush=True,
         )
-    runs: dict = {mode: [] for mode in modes}
+    if fill_payload is not None:
+        for eng in engines.values():
+            eng.mark_tick()
+            eng.ingest_bytes(fill_payload)
+            eng.step()
+    runs: dict = {name: [] for name in mode_names}
     for rep, chunk in enumerate(payload_chunks):
-        for mode in modes:
-            print(f"# repeat {rep} mode: {mode}",
+        for name, pipelined, _inc_flag in modes:
+            print(f"# repeat {rep} mode: {name}",
                   file=sys.stderr, flush=True)
-            run = _run_serial if mode == "serial" else _run_pipelined
-            runs[mode].append(
-                run(args, engines[mode], predict, params, chunk)
+            run = _run_pipelined if pipelined else _run_serial
+            runs[name].append(
+                run(args, engines[name], predict, params, chunk,
+                    inc=incs[name])
             )
     results = {
-        mode: _mode_summary(args, runs[mode], n_flows)
-        for mode in modes
+        name: _mode_summary(args, runs[name], n_flows)
+        for name in mode_names
     }
 
-    eng = engines[modes[-1]]
+    eng = engines[mode_names[-1]]
     # Per-tick host->device wire bytes actually moved for the update
     # batches (padded flow_table.pack_wire matrices, counted by the
     # engine) and the measured link bandwidth — on a slow device link the
@@ -519,6 +772,8 @@ def main() -> None:
         "platform": jax.devices()[0].platform,
         "predict_model": args.model,
         "table_rows_rendered": args.table_rows,
+        "churn_fraction": args.churn_fraction,
+        "incremental_mode": args.incremental,
         "warmup": args.warmup,
     }
 
@@ -532,8 +787,28 @@ def main() -> None:
             "speedup_flows_per_sec": round(p / s, 3) if s else None,
             **common,
         }
+    elif args.incremental == "both":
+        # identical payloads, identical render expected: the A/B is a
+        # correctness gate as much as a perf one
+        ident = all(
+            rf == ri
+            for runf, runi in zip(runs["full"], runs["incremental"])
+            for rf, ri in zip(
+                runf["rendered_rows"], runi["rendered_rows"]
+            )
+        )
+        f = results["full"]["stage_p50_ms"]["tick"]
+        i = results["incremental"]["stage_p50_ms"]["tick"]
+        out = {
+            "metric": "serve_incremental_ab",
+            "full": results["full"],
+            "incremental": results["incremental"],
+            "tick_p50_speedup": round(f / i, 3) if i else None,
+            "render_identical": ident,
+            **common,
+        }
     else:
-        mode = modes[0]
+        mode = mode_names[0]
         r = results[mode]
         out = {
             "metric": "serve_tick_p50_ms_at_capacity",

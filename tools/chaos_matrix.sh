@@ -51,6 +51,7 @@ GROUPS_KEYS=(
   "pipeline:pipeline_handoff or pipeline_coalesce"
   "degrade:degrade_dispatch or degrade_probe"
   "drift:drift_window or retrain_fit or promote_swap or promote_rollback or drift_loop"
+  "dirty:serve_dirty_mask or serve_label_cache"
 )
 
 fail=0

@@ -257,6 +257,18 @@ def _build_parser() -> argparse.ArgumentParser:
         "instead of rotting)",
     )
     p.add_argument(
+        "--incremental", choices=("auto", "off"), default="auto",
+        help="incremental active-set serving (serving/incremental.py): "
+        "track which table rows each ingest scatter touched and "
+        "re-predict ONLY those, merging fresh labels into a persistent "
+        "device-resident label cache — prediction cost scales with "
+        "per-tick churn instead of table capacity. Output is "
+        "byte-identical to the full re-predict at every churn level "
+        "(the cache invalidates wholesale on model promotions and "
+        "degrade-rung changes); 'off' restores the full-table "
+        "re-predict every render tick",
+    )
+    p.add_argument(
         "--pipeline", choices=("auto", "on", "off"), default="auto",
         help="pipelined serving (serving/pipeline.py): overlap host "
         "poll/parse/scatter with device predict/render through a "
@@ -543,6 +555,10 @@ def _run_classify_armed(args, lock_witness) -> None:
         from .io import serving_checkpoint as _sc
 
         engine = _sc.restore(args.restore_serve_state, recorder=recorder)
+        if args.incremental != "off":
+            # restored rows predate the label cache: everything starts
+            # dirty, so the first render re-predicts the whole table
+            engine.enable_dirty_tracking()
         if engine.table.capacity != args.capacity:
             print(
                 f"WARNING: --capacity {args.capacity} ignored — the "
@@ -579,9 +595,13 @@ def _run_classify_armed(args, lock_witness) -> None:
             args.capacity, predict_fn=serve_fn, params=serve_params,
             table_rows=args.table_rows,
             native=use_native,
+            incremental=args.incremental != "off",
         )
     else:
-        engine = FlowStateEngine(args.capacity, native=use_native)
+        engine = FlowStateEngine(
+            args.capacity, native=use_native,
+            track_dirty=args.incremental != "off",
+        )
 
     # Degradation ladder (serving/degrade.py): wraps the device predict
     # so a wedged/erroring dispatch demotes to a host fallback instead
@@ -624,6 +644,7 @@ def _run_classify_armed(args, lock_witness) -> None:
             engine, predict, serve_params,
             table_rows=args.table_rows,
             idle_timeout=args.idle_timeout,
+            incremental=args.incremental != "off",
         )
         print(
             f"warmup: compiled {len(wstats['warmed'])} serving "
@@ -707,6 +728,22 @@ def _run_classify_armed(args, lock_witness) -> None:
             # gate's CURRENT ladder, not the boot object
             degrade_surface = GateLadderView(gate, degrade)
 
+    # Incremental active-set serving (serving/incremental.py): wraps
+    # the FINAL predict composition (ladder- and gate-wrapped) so its
+    # label cache watches the composed label_epoch — a promotion
+    # hot-swap or degrade rung change invalidates the whole cache.
+    # Built AFTER warmup primed the boot model and AFTER the drift
+    # gate exists; the single-device serial and pipelined loops both
+    # read their labels from it.
+    inc = None
+    if args.incremental != "off" and not sharded:
+        from .serving.incremental import IncrementalLabels
+
+        inc = IncrementalLabels(
+            engine, predict, serve_params, degrade=degrade_surface,
+            metrics=m, recorder=recorder, tracer=tracer,
+        )
+
     server = None
     health = None
     probe_out: dict = {}
@@ -731,6 +768,10 @@ def _run_classify_armed(args, lock_witness) -> None:
             # promoted" by model_age_s alone
             health.set_drift(drift.status)
             drift.set_health(health)
+        if inc is not None:
+            # label-cache coverage: how much of the table the last
+            # render served from cache vs re-predicted
+            health.set_label_cache(inc.status)
         server = ExpositionServer(
             m, recorder=recorder, health=health, port=args.obs_port,
             host=args.obs_host,
@@ -772,7 +813,7 @@ def _run_classify_armed(args, lock_witness) -> None:
                         sharded, use_native, dropped_seen=0,
                         tracer=tracer, recorder=recorder, health=health,
                         probe_out=probe_out, degrade=degrade_surface,
-                        drift=drift)
+                        drift=drift, inc=inc)
     except BaseException as e:
         # the crash-forensics moment: record the terminal exception and
         # freeze the ring — safely outside any signal-handler frame.
@@ -923,7 +964,7 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
 def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 use_native, dropped_seen, tracer, recorder=None,
                 health=None, probe_out=None, degrade=None,
-                drift=None) -> None:
+                drift=None, inc=None) -> None:
     from .utils.profiling import trace
 
     # Pipelined serving (serving/pipeline.py): the host stage (this
@@ -947,9 +988,11 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
         ).start()
         host_busy = pipe.host_stage
         host_span = functools.partial(tracer.span, "stage.host")
-        if (not sharded and args.table_rows > 0
+        if (not sharded and args.table_rows > 0 and inc is None
                 and not getattr(predict, "host_native", False)):
             # donated double-buffers pin the per-render feature matrix
+            # (full re-predict only: the incremental path gathers
+            # per-bucket dirty rows instead of projecting the table)
             feature_stage = FeatureStage(engine.table.capacity)
 
     ticks = 0
@@ -1028,7 +1071,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 serve_params, m, tracer, pipe,
                                 feature_stage, sharded,
                                 evict_state=evict_state,
-                                degrade=degrade, drift=drift,
+                                degrade=degrade, drift=drift, inc=inc,
                             )
                         elif sharded:
                             # the sharded tick's whole read side
@@ -1062,7 +1105,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 _print_table(
                                     engine, model, predict,
                                     serve_params, args, tracer,
-                                    degrade=degrade,
+                                    degrade=degrade, inc=inc,
                                 )
                             if drift is not None:
                                 # off the hot path: the tick's labels
@@ -1097,7 +1140,8 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
 
 def _dispatch_render(args, engine, model, predict, serve_params, m,
                      tracer, pipe, feature_stage, sharded,
-                     evict_state=None, degrade=None, drift=None) -> None:
+                     evict_state=None, degrade=None, drift=None,
+                     inc=None) -> None:
     """Host-stage half of one pipelined render tick: dispatch the read
     side against THIS tick's table and stage the device-stage job.
     Output is byte-identical to the serial render of the same tick —
@@ -1169,7 +1213,7 @@ def _dispatch_render(args, engine, model, predict, serve_params, m,
     with tracer.span("dispatch"):
         read = dispatch_read(
             engine, predict, serve_params, args.table_rows,
-            feature_stage,
+            feature_stage, inc=inc,
         )
 
     def job(read=read):
@@ -1224,7 +1268,7 @@ def _print_full(model, rows, stale=False) -> None:
 
 
 def _print_table(engine, model, predict, serve_params, args,
-                 tracer, degrade=None) -> None:
+                 tracer, degrade=None, inc=None) -> None:
     import jax
 
     from .utils.table import CLASSIFIER_FIELDS, render_table, status_str
@@ -1232,14 +1276,23 @@ def _print_table(engine, model, predict, serve_params, args,
     # The device flow table produces float32 features natively, so the
     # SVC/KNN hi/lo precise mode is moot here (lo would be identically
     # zero); it applies to float64 feature sources like the CSV pipeline.
-    with tracer.span("feature"):
-        X = engine.features()
-    with tracer.span("predict"):
-        labels = predict(serve_params, X)  # stays device-resident
-        # the dispatch is async; block here so the predict span carries
-        # the device compute instead of smearing it into render (the
-        # degrade ladder returns host arrays — a no-op pass-through)
-        jax.block_until_ready(labels)
+    if inc is not None:
+        # incremental path: labels come from the persistent cache, with
+        # only this tick's dirty rows re-predicted (the compact span
+        # inside carries the count/compact/gather cost)
+        with tracer.span("predict"):
+            labels = inc.labels()
+            jax.block_until_ready(labels)
+    else:
+        with tracer.span("feature"):
+            X = engine.features()
+        with tracer.span("predict"):
+            labels = predict(serve_params, X)  # stays device-resident
+            # the dispatch is async; block here so the predict span
+            # carries the device compute instead of smearing it into
+            # render (the degrade ladder returns host arrays — a no-op
+            # pass-through)
+            jax.block_until_ready(labels)
     # the stale verdict postdates the predict attempt: a ladder trip
     # during THIS call marks this tick's render
     stale = degrade is not None and degrade.render_stale

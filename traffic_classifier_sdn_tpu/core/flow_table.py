@@ -211,6 +211,29 @@ def apply_wire(table: FlowTable, w: jax.Array) -> FlowTable:
     return apply_batch(table, unpack_wire(w))
 
 
+def mark_dirty_wire(dirty: jax.Array, w: jax.Array) -> jax.Array:
+    """Set the dirty bit for every slot a packed wire batch touches.
+
+    ``dirty`` is the per-slot (capacity+1,) bool mask behind incremental
+    prediction (serving/incremental.py): the ingest scatter is the ONLY
+    thing that changes a row's 12 serving features, so the slots in the
+    wire are exactly the rows whose cached labels went stale. Padding
+    rows carry the scratch slot and land on the scratch bit, which no
+    reader consults."""
+    slot = (w[:, 0] & jnp.uint32(0x3FFFFFFF)).astype(jnp.int32)
+    return dirty.at[slot].set(True, mode="drop")
+
+
+def apply_wire_dirty(
+    table: FlowTable, dirty: jax.Array, w: jax.Array
+) -> tuple[FlowTable, jax.Array]:
+    """``apply_wire`` fused with the dirty-bit scatter: ONE wire
+    transfer and one dispatch cover both the table update and the
+    staleness bookkeeping (a separate jit would ship the packed batch
+    across the link twice)."""
+    return apply_batch(table, unpack_wire(w)), mark_dirty_wire(dirty, w)
+
+
 def _inverse_index(mask, slot, n: int):
     """(n,) int32 map: table row → index of the batch row addressing it
     under ``mask``, or B (sentinel) for rows no batch row addresses.
@@ -397,6 +420,67 @@ def clear_slots(table: FlowTable, slot: jax.Array) -> FlowTable:
     )
 
 
+def clear_slots_dirty(
+    table: FlowTable, dirty: jax.Array, slot: jax.Array
+) -> tuple[FlowTable, jax.Array]:
+    """``clear_slots`` fused with cache invalidation: an evicted slot's
+    features drop to zero, so its cached label is stale — the dirty bit
+    comes up with the clear in one dispatch (one slot-batch transfer).
+    A reassigned slot would be marked by its create scatter anyway; this
+    covers the window where the slot sits empty."""
+    return clear_slots(table, slot), dirty.at[slot].set(True, mode="drop")
+
+
+def mark_dirty_slots(dirty: jax.Array, slot: jax.Array) -> jax.Array:
+    """Set the dirty bit for an explicit slot batch (padded with the
+    scratch slot) — the re-invalidation path: rows whose subset predict
+    was discarded (a degrade trip served stale labels mid-flight) must
+    be re-predicted once the ladder recovers."""
+    return dirty.at[slot].set(True, mode="drop")
+
+
+def dirty_count(dirty: jax.Array) -> jax.Array:
+    """Number of set dirty bits outside the scratch row — the one
+    scalar the host fetches per render tick to pick a compaction
+    bucket."""
+    return jnp.sum(dirty[:-1].astype(jnp.int32))
+
+
+def compact_dirty(dirty: jax.Array, bucket: int) -> jax.Array:
+    """(bucket,) int32 indices of the dirty rows (scratch excluded),
+    padded with ``capacity`` — the static-shape compaction step.
+    ``bucket`` is static: serving picks the smallest warmed bucket that
+    admits this tick's dirty count (serving/incremental.dirty_buckets),
+    so retrace hazard stays one compile per bucket, exactly the
+    ingest-scatter discipline."""
+    n = dirty.shape[0] - 1
+    return jnp.nonzero(
+        dirty[:-1], size=bucket, fill_value=n
+    )[0].astype(jnp.int32)
+
+
+def features12_at(table: FlowTable, idx: jax.Array) -> jax.Array:
+    """(len(idx), 12) feature rows for exactly the given slots — the
+    dirty-set gather. Elementwise-identical to ``features12(table)[idx]``
+    (the SAME ``_feature12_cols`` list, same per-element ops: int32→f32
+    casts, in_use zeroing), which is what keeps dirty-set prediction
+    byte-identical to a full-table re-predict. Padding entries
+    (``idx == capacity``) read the scratch row: never in use, so they
+    project to zeros and their (garbage) labels are dropped by the
+    ``mode="drop"`` cache scatter."""
+    X = jnp.stack([c[idx] for c in _feature12_cols(table)], axis=1)
+    return jnp.where(table.in_use[idx, None], X, 0.0)
+
+
+def merge_labels(cache, idx: jax.Array, labels) -> jax.Array:
+    """Scatter the dirty rows' fresh labels into the (capacity,) label
+    cache. Padding entries carry ``idx == capacity`` — out of bounds
+    for the cache, dropped. Jitted with the cache donated by the caller
+    (serving/incremental.py) so the persistent device-resident cache
+    updates in place."""
+    return cache.at[idx].set(labels, mode="drop")
+
+
 @jax.jit
 def stale_mask(table: FlowTable, now, idle_seconds) -> jax.Array:
     """(capacity+1,) bool: in-use slots with no telemetry in either
@@ -517,17 +601,26 @@ def top_active_render(table: FlowTable, labels, n: int, floor):
     )
 
 
-def features12(table: FlowTable) -> jax.Array:
-    """(capacity, 12) online feature matrix, order of
-    traffic_classifier.py:104 — rows for unused slots are zero."""
+def _feature12_cols(table: FlowTable) -> list:
+    """The 12 serving-feature columns, (capacity+1,) each, order of
+    traffic_classifier.py:104 — THE single source for both the
+    full-table projection (``features12``) and the dirty-set gather
+    (``features12_at``): incremental serving's byte-identity guarantee
+    is exactly that the two consume the same column list with the same
+    per-element ops."""
     f, r = table.fwd, table.rev
-    cols = [
+    return [
         f.delta_pkts.astype(jnp.float32), f.delta_bytes.astype(jnp.float32),
         f.inst_pps, f.avg_pps, f.inst_bps, f.avg_bps,
         r.delta_pkts.astype(jnp.float32), r.delta_bytes.astype(jnp.float32),
         r.inst_pps, r.avg_pps, r.inst_bps, r.avg_bps,
     ]
-    X = jnp.stack(cols, axis=1)[:-1]  # drop the scratch row
+
+
+def features12(table: FlowTable) -> jax.Array:
+    """(capacity, 12) online feature matrix, order of
+    traffic_classifier.py:104 — rows for unused slots are zero."""
+    X = jnp.stack(_feature12_cols(table), axis=1)[:-1]  # drop scratch row
     X = jnp.where(table.in_use[:-1, None], X, 0.0)
     assert X.shape[1] == NUM_FEATURES
     return X

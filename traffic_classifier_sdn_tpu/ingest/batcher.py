@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from collections.abc import Iterable, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core import flow_table as ft
@@ -200,6 +201,14 @@ class Batcher:
 # different cache and leave the first-tick stall in place.
 apply_wire_jit = jax.jit(ft.apply_wire, donate_argnums=0)
 
+# The dirty-tracking variant (incremental serving): the same scatter plus
+# the per-slot dirty-bit update, fused so the packed wire crosses the
+# link once. Both donated — table and dirty mask update in place in HBM.
+apply_wire_dirty_jit = jax.jit(ft.apply_wire_dirty, donate_argnums=(0, 1))
+
+# Eviction with cache invalidation fused in (see flow_table.clear_slots_dirty).
+clear_slots_dirty_jit = jax.jit(ft.clear_slots_dirty, donate_argnums=1)
+
 
 class HostSpine:
     """The shared host half of a serving spine — batcher/index wiring,
@@ -326,9 +335,20 @@ class FlowStateEngine(HostSpine):
     """
 
     def __init__(self, capacity: int, buckets=DEFAULT_BUCKETS,
-                 native: bool = False):
+                 native: bool = False, track_dirty: bool = False):
         self.table = ft.make_table(capacity)
+        self.dirty = None
         self._init_spine(capacity, buckets, native)
+        if track_dirty:
+            self.enable_dirty_tracking()
+
+    def enable_dirty_tracking(self) -> None:
+        """Start maintaining the per-slot dirty mask the incremental
+        predict path consumes (serving/incremental.py). Initialized
+        ALL-dirty: whatever the table already holds (a restored
+        checkpoint, pre-enable ingest) predates the label cache, so the
+        first incremental render must re-predict everything."""
+        self.dirty = jnp.ones(self.table.capacity + 1, bool)
 
     def top_slots(self, n: int) -> list[int]:
         """Slots of the ≤n most active flows this tick, most active first
@@ -402,7 +422,12 @@ class FlowStateEngine(HostSpine):
         while (batch := self.batcher.flush()) is not None:
             w = ft.pack_wire(batch)
             self.wire_bytes += w.nbytes  # padded, i.e. what actually moves
-            self.table = apply_wire_jit(self.table, w)
+            if self.dirty is None:
+                self.table = apply_wire_jit(self.table, w)
+            else:
+                self.table, self.dirty = apply_wire_dirty_jit(
+                    self.table, self.dirty, w
+                )
             applied = True
         return applied
 
@@ -436,7 +461,14 @@ class FlowStateEngine(HostSpine):
             size = bucket_size(chunk.size, self.batcher.buckets)
             padded = np.full(size, capacity, np.int32)
             padded[: chunk.size] = chunk
-            self.table = ft.clear_slots(self.table, padded)
+            if self.dirty is None:
+                self.table = ft.clear_slots(self.table, padded)
+            else:
+                # eviction invalidates the label cache: the cleared
+                # rows' features are zeros now, their cached labels lie
+                self.table, self.dirty = clear_slots_dirty_jit(
+                    self.table, self.dirty, padded
+                )
         # one bulk call: the native path crosses ctypes once for the whole
         # eviction batch instead of once per slot
         (self.batcher if self.native else self.index).release_slots(slots)

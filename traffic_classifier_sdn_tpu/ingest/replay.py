@@ -46,11 +46,20 @@ class SyntheticFlows:
     Each conversation produces two records per tick (one per direction),
     mimicking what the monitor logs for the two learned-switch flow entries
     of a host pair (simple_monitor_13.py:49-66).
+
+    ``churn`` controls the per-tick updated-flow fraction: each tick a
+    seeded random subset of ``round(churn * n_flows)`` conversations
+    emits telemetry (counters advance), the rest stay silent — the knob
+    behind the incremental-serving dirty sweep
+    (tools/bench_serve.py --churn-fraction). At the default 1.0 the
+    emission order and RNG consumption are unchanged from the
+    historical all-flows-every-tick behavior.
     """
 
     n_flows: int
     seed: int = 0
     start_time: int = 1
+    churn: float = 1.0
 
     def __post_init__(self):
         rng = np.random.RandomState(self.seed)
@@ -69,15 +78,25 @@ class SyntheticFlows:
         b = (i * 2 + side).to_bytes(6, "big")
         return ":".join(f"{x:02x}" for x in b)
 
+    def _active(self) -> np.ndarray:
+        """This tick's emitting conversations (sorted, seeded)."""
+        if self.churn >= 1.0:
+            return np.arange(self.n_flows)
+        k = int(round(self.churn * self.n_flows))
+        if k <= 0:
+            return np.empty(0, np.int64)
+        return np.sort(self._rng.choice(self.n_flows, k, replace=False))
+
     def tick(self) -> list[TelemetryRecord]:
-        dp = np.int64(self.pps_fwd * self._rng.poisson(1.0, self.n_flows))
-        self.cum_pkts_fwd += dp
-        self.cum_bytes_fwd += np.int64(dp * self.bpp_fwd)
-        dr = np.int64(self.pps_rev * self._rng.poisson(1.0, self.n_flows))
-        self.cum_pkts_rev += dr
-        self.cum_bytes_rev += np.int64(dr * self.bpp_rev)
+        act = self._active()
+        dp = np.int64(self.pps_fwd[act] * self._rng.poisson(1.0, act.size))
+        self.cum_pkts_fwd[act] += dp
+        self.cum_bytes_fwd[act] += np.int64(dp * self.bpp_fwd[act])
+        dr = np.int64(self.pps_rev[act] * self._rng.poisson(1.0, act.size))
+        self.cum_pkts_rev[act] += dr
+        self.cum_bytes_rev[act] += np.int64(dr * self.bpp_rev[act])
         out = []
-        for i in range(self.n_flows):
+        for i in (int(j) for j in act):
             src, dst = self._mac(i, 0), self._mac(i, 1)
             out.append(TelemetryRecord(
                 time=self.t, datapath="1", in_port="1", eth_src=src,
@@ -99,12 +118,13 @@ class SyntheticFlows:
         bulk path for scale tests (2²⁰ flows): building TelemetryRecord
         objects per flow would dominate; this emits one bytes blob for
         ``FlowStateEngine.ingest_bytes``/the C++ engine."""
-        dp = np.int64(self.pps_fwd * self._rng.poisson(1.0, self.n_flows))
-        self.cum_pkts_fwd += dp
-        self.cum_bytes_fwd += np.int64(dp * self.bpp_fwd)
-        dr = np.int64(self.pps_rev * self._rng.poisson(1.0, self.n_flows))
-        self.cum_pkts_rev += dr
-        self.cum_bytes_rev += np.int64(dr * self.bpp_rev)
+        act = self._active()
+        dp = np.int64(self.pps_fwd[act] * self._rng.poisson(1.0, act.size))
+        self.cum_pkts_fwd[act] += dp
+        self.cum_bytes_fwd[act] += np.int64(dp * self.bpp_fwd[act])
+        dr = np.int64(self.pps_rev[act] * self._rng.poisson(1.0, act.size))
+        self.cum_pkts_rev[act] += dr
+        self.cum_bytes_rev[act] += np.int64(dr * self.bpp_rev[act])
         if not hasattr(self, "_mac_cache"):
             self._mac_cache = [
                 (self._mac(i, 0), self._mac(i, 1))
@@ -114,7 +134,8 @@ class SyntheticFlows:
         parts = []
         pf, bf = self.cum_pkts_fwd, self.cum_bytes_fwd
         pr, br = self.cum_pkts_rev, self.cum_bytes_rev
-        for i, (src, dst) in enumerate(self._mac_cache):
+        for i in act:
+            src, dst = self._mac_cache[i]
             parts.append(
                 f"data\t{t}\t1\t1\t{src}\t{dst}\t2\t{pf[i]}\t{bf[i]}\n"
                 f"data\t{t}\t1\t2\t{dst}\t{src}\t1\t{pr[i]}\t{br[i]}\n"

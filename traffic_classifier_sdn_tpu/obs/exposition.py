@@ -124,6 +124,7 @@ class HealthState:
         self._probe = None
         self._degrade = None
         self._drift = None
+        self._label_cache = None
 
     def model_loaded(self) -> None:
         """The serve registered its boot model — the ``model_age_s``
@@ -158,6 +159,15 @@ class HealthState:
         with self._lock:
             self._degrade = status_fn
 
+    def set_label_cache(self, status_fn) -> None:
+        """``status_fn() -> dict`` (serving/incremental.IncrementalLabels
+        .status): the incremental label cache's self-report — mode,
+        cache coverage (fraction of the table served from cache at the
+        last render), rows re-predicted, and invalidation count —
+        folded into /healthz as a ``label_cache`` object."""
+        with self._lock:
+            self._label_cache = status_fn
+
     def set_collector_probe(self, probe) -> None:
         """``probe() -> bool | None`` (None = no collector, e.g. replay
         sources — reported but never unhealthy)."""
@@ -183,6 +193,7 @@ class HealthState:
             probe = self._probe
             degrade = self._degrade
             drift = self._drift
+            label_cache = self._label_cache
             model_loaded = self._model_loaded_at
             model_promoted = self._model_promoted_at
             started = self._started_at
@@ -258,6 +269,13 @@ class HealthState:
                 report["drift"] = drift()
             except Exception as e:  # noqa: BLE001 — health must not crash
                 report["drift"] = {"state": "unknown", "error": str(e)}
+        if label_cache is not None:
+            try:
+                report["label_cache"] = label_cache()
+            except Exception as e:  # noqa: BLE001 — health must not crash
+                report["label_cache"] = {
+                    "mode": "unknown", "error": str(e),
+                }
         return healthy, report
 
 

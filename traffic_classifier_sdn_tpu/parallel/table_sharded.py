@@ -118,6 +118,100 @@ def make_tick_outputs(mesh, predict_fn, n_rows: int):
     return tick
 
 
+def make_apply_dirty(mesh):
+    """``make_apply`` fused with the per-slot dirty-bit scatter
+    (incremental serving): jit'd (tables, dirty, wire) →
+    (tables, dirty), both sharded leaves donated where safe."""
+
+    @functools.partial(jax.jit, **donate_argnums_if_safe(0, 1))
+    def apply(tables, dirty, wire):
+        def local(t, d, w):
+            t1 = jax.tree.map(lambda a: a[0], t)
+            out, d1 = ft.apply_wire_dirty(t1, d[0], w[0])
+            return jax.tree.map(lambda a: a[None], out), d1[None]
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        )(tables, dirty, wire)
+
+    return apply
+
+
+def make_dirty_counts(mesh):
+    """jit'd (dirty) → (n_shards,) int32 per-shard dirty-row counts,
+    replicated — the one small fetch the host needs to pick this
+    tick's compaction bucket (the max across shards, because one
+    shard_map dispatch compiles one static bucket for every shard)."""
+
+    @jax.jit
+    def counts(dirty):
+        def local(d):
+            c = ft.dirty_count(d[0])[None]
+            return jax.lax.all_gather(c, DATA_AXIS)
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS),),
+            out_specs=P(),
+            check_vma=False,
+        )(dirty)
+
+    return counts
+
+
+def make_tick_outputs_inc(mesh, predict_fn, n_rows: int):
+    """The incremental read side (serving/incremental.py's dirty-set
+    discipline, per shard): compact each shard's dirty rows to one
+    STATIC ``bucket`` shape, gather exactly those rows' features,
+    predict the subset, scatter the fresh labels into the shard's
+    persistent label cache, and render the candidates from the CACHE —
+    byte-identical to the full-shard predict because unchanged rows
+    project unchanged features (flow_table.features12_at). Returns the
+    same gathered 7-tuple as ``make_tick_outputs`` plus the updated
+    (donated) caches and cleared dirty masks. ``bucket`` may equal
+    ``local_capacity + 1``'s row count minus one (the rebuild bucket):
+    that variant re-predicts whole shards and is what primes the cache
+    on the first tick and at over-bucket churn."""
+
+    @functools.partial(
+        jax.jit, static_argnames=("bucket",),
+        **donate_argnums_if_safe(1, 2),
+    )
+    def tick(tables, caches, dirty, params, floor, now, idle_seconds,
+             bucket: int):
+        def local(t, c, d, p, fl, nw, idl):
+            t1 = jax.tree.map(lambda a: a[0], t)
+            d1 = d[0]
+            idx = ft.compact_dirty(d1, bucket)
+            labels = predict_fn(p, ft.features12_at(t1, idx))
+            c1 = ft.merge_labels(c[0], idx, labels)
+            outs = ft.top_active_scored(t1, c1, n_rows, fl[0, 0])
+            bits = ft.stale_bits(t1, nw[0, 0], idl[0, 0])
+            gathered = tuple(
+                jax.lax.all_gather(o, DATA_AXIS) for o in (*outs, bits)
+            )
+            return gathered + (c1[None], jnp.zeros_like(d1)[None])
+
+        scalar = lambda v: jnp.broadcast_to(  # noqa: E731
+            jnp.int32(v), (_n_shards(mesh), 1)
+        )
+        outs = shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(),
+                      P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(
+                (P(),) * 7 + (P(DATA_AXIS), P(DATA_AXIS))
+            ),
+            check_vma=False,
+        )(tables, caches, dirty, params, scalar(floor), scalar(now),
+          scalar(idle_seconds))
+        return outs
+
+    return tick
+
+
 def make_clear(mesh):
     """jit'd (tables, slots) → tables: per-shard ``clear_slots``; ``slots``
     is (n_shards, E) LOCAL slot ids padded with local_capacity."""
@@ -138,6 +232,27 @@ def make_clear(mesh):
     return clear
 
 
+def make_clear_dirty(mesh):
+    """``make_clear`` fused with label-cache invalidation: evicted
+    slots' features drop to zero, so their cached labels must be
+    re-predicted (flow_table.clear_slots_dirty, per shard)."""
+
+    @functools.partial(jax.jit, **donate_argnums_if_safe(1))
+    def clear(tables, dirty, slots):
+        def local(t, d, s):
+            t1 = jax.tree.map(lambda a: a[0], t)
+            out, d1 = ft.clear_slots_dirty(t1, d[0], s[0])
+            return jax.tree.map(lambda a: a[None], out), d1[None]
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        )(tables, dirty, slots)
+
+    return clear
+
+
 class ShardedFlowEngine(HostSpine):
     """Host spine for the sharded table: ONE global flow index (slots
     [0, capacity_total)), shard routing by slot, shard_map device ops —
@@ -154,7 +269,7 @@ class ShardedFlowEngine(HostSpine):
 
     def __init__(self, mesh, capacity_total: int, buckets=DEFAULT_BUCKETS,
                  predict_fn=None, params=None, table_rows: int = 64,
-                 native: bool = False):
+                 native: bool = False, incremental: bool = False):
         self.mesh = mesh
         self.n_shards = _n_shards(mesh)
         if capacity_total % self.n_shards:
@@ -176,6 +291,44 @@ class ShardedFlowEngine(HostSpine):
             if predict_fn is not None else None
         )
         self.params = params
+        # incremental active-set serving (serving/incremental.py's
+        # dirty-set discipline, applied per shard): a sharded dirty
+        # mask fed by the apply scatter, a sharded persistent label
+        # cache, and a bucketed compact-predict-merge read side. The
+        # rebuild bucket (== local_capacity) doubles as the full-table
+        # path, so the cache primes on the first tick.
+        self.incremental = bool(incremental and predict_fn is not None)
+        self.dirty = None
+        self.caches = None
+        if self.incremental:
+            sharding = NamedSharding(mesh, P(DATA_AXIS))
+            self.dirty = jax.device_put(
+                np.ones(
+                    (self.n_shards, self.local_capacity + 1), bool
+                ),
+                sharding,
+            )
+            label_dtype = jax.eval_shape(
+                predict_fn, params,
+                jax.ShapeDtypeStruct((1, 12), jnp.float32),
+            ).dtype
+            self.caches = jax.device_put(
+                np.zeros(
+                    (self.n_shards, self.local_capacity), label_dtype
+                ),
+                sharding,
+            )
+            self._apply_dirty = make_apply_dirty(mesh)
+            self._clear_dirty = make_clear_dirty(mesh)
+            self._dirty_counts = make_dirty_counts(mesh)
+            self._tick_outputs_inc = make_tick_outputs_inc(
+                mesh, predict_fn, min(table_rows, self.local_capacity)
+            )
+            from ..serving.incremental import dirty_buckets
+
+            self.dirty_buckets = dirty_buckets(self.local_capacity) + (
+                self.local_capacity,
+            )
 
     # -- device ops --------------------------------------------------------
     def _route_chunks(self, w: np.ndarray):
@@ -270,7 +423,12 @@ class ShardedFlowEngine(HostSpine):
                 # chunk passes as host numpy (uncommitted): identical on
                 # every process, so jit treats it as replicated —
                 # multi-host safe
-                self.tables = self._apply(self.tables, chunk)
+                if self.incremental:
+                    self.tables, self.dirty = self._apply_dirty(
+                        self.tables, self.dirty, chunk
+                    )
+                else:
+                    self.tables = self._apply(self.tables, chunk)
         return True
 
     def tick_read_dispatch(self, now: int,
@@ -287,10 +445,44 @@ class ShardedFlowEngine(HostSpine):
         if self._tick_outputs is None:
             raise ValueError("engine built without a predict_fn")
         self.step()
+        horizon = idle_seconds if idle_seconds is not None else (1 << 30)
+        if self.incremental:
+            outs = self._dispatch_incremental(now, horizon)
+            if outs is not None:
+                return outs
         return self._tick_outputs(
-            self.tables, self.params, self._tick_floor, now,
-            idle_seconds if idle_seconds is not None else (1 << 30),
+            self.tables, self.params, self._tick_floor, now, horizon,
         )
+
+    def _dispatch_incremental(self, now: int, horizon: int):
+        """The incremental read dispatch: pick this tick's compaction
+        bucket from the per-shard dirty counts (the max — one shard_map
+        compiles one static shape for every shard) and run the
+        compact-predict-merge-render program; the updated cache/dirty
+        pair is committed at dispatch (host thread), so the pipelined
+        and serial callers share the path. Returns None to fall back to
+        the plain full-shard read (the ABSORBED fault sites: that tick
+        re-predicts everything directly and the cache/mask pair is
+        rebuilt at the next render — never a stale label as fresh)."""
+        from ..utils import faults as _faults
+
+        try:
+            _faults.fault_point("serve.dirty_mask")
+            _faults.fault_point("serve.label_cache")
+        except _faults.FaultInjected:
+            self.dirty = jax.device_put(
+                np.ones((self.n_shards, self.local_capacity + 1), bool),
+                NamedSharding(self.mesh, P(DATA_AXIS)),
+            )
+            return None
+        n = int(np.asarray(self._dirty_counts(self.dirty)).max())
+        bucket = next(b for b in self.dirty_buckets if n <= b)
+        outs = self._tick_outputs_inc(
+            self.tables, self.caches, self.dirty, self.params,
+            self._tick_floor, now, horizon, bucket=bucket,
+        )
+        self.caches, self.dirty = outs[-2], outs[-1]
+        return tuple(outs[:-2])
 
     def tick_read_finish(self, outs) -> list[tuple]:
         """Sync the dispatched read side and merge the per-shard
@@ -369,8 +561,62 @@ class ShardedFlowEngine(HostSpine):
             padded = np.full((self.n_shards, E), local_cap, np.int32)
             for s, c in enumerate(chunks):
                 padded[s, : c.size] = c
-            self.tables = self._clear(self.tables, padded)
+            if self.incremental:
+                # eviction invalidates the per-shard label cache rows
+                self.tables, self.dirty = self._clear_dirty(
+                    self.tables, self.dirty, padded
+                )
+            else:
+                self.tables = self._clear(self.tables, padded)
         return rows, evicted
+
+    def warmup_incremental(self) -> list[str]:
+        """AOT-compile the incremental read program for EVERY dirty
+        bucket (serving/warmup.py's sharded branch): one
+        ``tick_read_dispatch`` only exercises the bucket the current
+        dirty counts select, so the other shapes would compile at their
+        first serving hit. Scratch state throughout — on jax lines
+        where shard_map donation is live the priming calls consume
+        their operands, and the real cache/dirty must never be warmup
+        fodder."""
+        if not self.incremental:
+            return []
+        warmed = []
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        scratch_t = make_sharded_table(self.mesh, self.capacity)
+        scratch_c = jax.device_put(
+            np.zeros(
+                (self.n_shards, self.local_capacity),
+                self.caches.dtype,
+            ),
+            sharding,
+        )
+        scratch_d = jax.device_put(
+            np.ones((self.n_shards, self.local_capacity + 1), bool),
+            sharding,
+        )
+        jax.block_until_ready(self._dirty_counts(scratch_d))
+        for b in self.dirty_buckets:
+            self._tick_outputs_inc.lower(
+                scratch_t, scratch_c, scratch_d, self.params,
+                0, 0, 1 << 30, bucket=b,
+            ).compile()
+            outs = self._tick_outputs_inc(
+                scratch_t, scratch_c, scratch_d, self.params,
+                0, 0, 1 << 30, bucket=b,
+            )
+            # donated on native-shard_map jax lines: chain the returned
+            # cache so one allocation covers every bucket; the dirty
+            # mask comes back cleared, so re-seed it all-dirty (the
+            # next bucket's priming must compact a real population)
+            scratch_c = outs[-2]
+            scratch_d = jax.device_put(
+                np.ones((self.n_shards, self.local_capacity + 1), bool),
+                sharding,
+            )
+            warmed.append(f"sharded.dirty[{b}]")
+        jax.block_until_ready(scratch_c)
+        return warmed
 
     def slot_metadata(self, slots):
         return self._slot_meta_for(slots)

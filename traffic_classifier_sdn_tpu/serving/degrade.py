@@ -269,6 +269,7 @@ class DegradeLadder:
         )
         self._lock = threading.Lock()
         self._rung = HEALTHY
+        self._label_epoch = 0
         self._probing = False
         self._device_tried = False  # first-ATTEMPT grace consumed
         self._fetch_wedged = False  # last host feature fetch timed out
@@ -298,6 +299,16 @@ class DegradeLadder:
         (the BROKEN rung) — the render adds the STALE column."""
         with self._lock:
             return self._last_stale
+
+    @property
+    def label_epoch(self) -> int:
+        """Monotonic counter of RUNG changes — the label-source epoch
+        the incremental predict path (serving/incremental.py) watches:
+        a rung move means subsequently served labels come from a
+        different evaluator, so every cached label is suspect and the
+        whole label cache must be invalidated."""
+        with self._lock:
+            return self._label_epoch
 
     def status(self) -> dict:
         """The /healthz self-report (obs.HealthState.set_degrade)."""
@@ -556,6 +567,11 @@ class DegradeLadder:
         old_rung = self._rung
         if rung is not None:
             self._rung = rung
+            if rung != old_rung:
+                # the label SOURCE moved (device kernel ↔ fallback ↔
+                # stale) — bump the epoch so incremental label caches
+                # built on the old rung's output invalidate themselves
+                self._label_epoch += 1
             if rung != HEALTHY and old == HEALTHY:
                 # entering the ladder: first probe after one base
                 # interval, fresh success chain

@@ -367,6 +367,7 @@ class DriftGate:
         self._fn = predict
         self._params = None
         self._swapped = False
+        self._swap_count = 0
         self._capture = None
 
     def __call__(self, params, X):
@@ -395,6 +396,7 @@ class DriftGate:
             self._fn = fn
             self._params = params
             self._swapped = True
+            self._swap_count += 1
             return prev
 
     @property
@@ -408,6 +410,19 @@ class DriftGate:
     def swapped(self) -> bool:
         with self._lock:
             return self._swapped
+
+    @property
+    def label_epoch(self) -> tuple:
+        """Label-source epoch for the incremental predict path
+        (serving/incremental.py): any promotion or rollback
+        (``install``) bumps the swap count, and a wrapped ladder's own
+        rung epoch rides along — comparing the pair detects BOTH swap
+        kinds, so a model hot-swap always invalidates the whole label
+        cache (wrong-but-cached must never survive a promotion)."""
+        with self._lock:
+            fn = self._fn
+            count = self._swap_count
+        return (count, getattr(fn, "label_epoch", 0))
 
 
 class GateLadderView:

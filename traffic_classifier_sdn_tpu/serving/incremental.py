@@ -1,0 +1,478 @@
+"""Incremental active-set serving: dirty-row prediction with a
+persistent device-resident label cache.
+
+The flagship serving cost was never the traffic — it was the TABLE: at
+2²⁰ capacity the serve tick re-predicted every row every tick (1.52 s
+of a 1.79 s tick through the native forest,
+docs/artifacts/serve_2m_cpu_native_forest.json) even when almost
+nothing changed. The reference's own INACTIVE rule
+(traffic_classifier.py:75-78) freezes a flow's 12 features whenever its
+byte/packet deltas are zero — so a row with no telemetry this tick
+projects the SAME feature vector it projected last tick, and a
+row-independent classifier must give it the same label. Prediction cost
+should scale with per-tick churn, not capacity. This module makes it
+so:
+
+- the ingest scatter already knows which slots it touched: with dirty
+  tracking on, ``FlowStateEngine.step`` routes each packed wire batch
+  through ``flow_table.apply_wire_dirty`` — the same scatter, fused
+  with a per-slot dirty-bit update (one transfer, one dispatch) — and
+  eviction invalidates through ``clear_slots_dirty``;
+- each render tick, ``IncrementalLabels`` fetches ONE scalar (the
+  dirty count), picks the smallest warmed bucket that admits it
+  (``dirty_buckets`` — static shapes, so the retrace discipline matches
+  the ingest scatter's and ``--warmup`` can AOT-compile every variant),
+  compacts the dirty row indices on device (``compact_dirty``), gathers
+  exactly those rows' features (``features12_at`` — elementwise
+  identical to ``features12(table)[idx]``), predicts the subset, and
+  scatters the fresh labels into a persistent donated label cache
+  (``merge_labels``) that ``top_active_render``/the ranked read paths
+  consume in place of a full-table predict;
+- byte-identity with the full re-predict holds because the cache
+  invariant is "``cache[i]`` equals what a full-table predict would
+  label row ``i`` today": rows change features only through the scatter
+  (marked dirty) or eviction (marked dirty), and the serving families
+  are row-independent, so unchanged features ⇒ unchanged label.
+
+Composition rules (every serve-loop consumer routes through here when
+``--incremental`` is on):
+
+- **promotion hot-swaps** (serving/drift.DriftGate) and **degrade rung
+  changes** (serving/degrade.DegradeLadder) change what the predict
+  callable MEANS — the wrapped callable exposes a ``label_epoch`` and
+  any change invalidates the whole cache (wrong-but-cached must never
+  survive a promotion; a DEGRADED serve must label the whole table on
+  the fallback rung, exactly like the full re-predict path);
+- while the ladder is off its HEALTHY rung the tick runs full-table
+  (through the ladder — its fallback/probe machinery must keep
+  running), and a tick whose predict came back STALE (the BROKEN rung's
+  last-known-good path) NEVER commits: the label cache itself is the
+  true last-known-good full vector, so it is served as-is and the
+  attempted rows are re-marked dirty for the recovery tick — the
+  stale-label path cannot alias the fresh-label cache;
+- fault sites ``serve.dirty_mask`` and ``serve.label_cache``
+  (utils/faults.SITES) are ABSORBED: a fire degrades that tick to a
+  full-table re-predict served directly (cache and dirty mask left
+  untouched), never a stale label served as fresh.
+
+Threading: the host stage owns the dirty mask and the decide/dispatch
+half; in the pipelined host-native composition the device-stage worker
+runs the predict and commits the host-side cache. The small shared
+state (host cache handle, re-dirty queue, invalidation flag) is guarded
+by ``_lock``; it is never held across a predict.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flow_table as ft
+from ..utils import faults
+
+# One compiled program per (shape-family): count, compaction (per
+# bucket), dirty-row feature gather (per bucket), cache scatter (per
+# bucket, cache donated so the persistent buffer updates in place), and
+# re-invalidation marks. Shared across instances like the batcher's
+# apply_wire_jit so --warmup primes the caches serving actually hits.
+dirty_count_jit = jax.jit(ft.dirty_count)
+compact_dirty_jit = jax.jit(ft.compact_dirty, static_argnames=("bucket",))
+features12_at_jit = jax.jit(ft.features12_at)
+merge_labels_jit = jax.jit(ft.merge_labels, donate_argnums=0)
+mark_dirty_slots_jit = jax.jit(ft.mark_dirty_slots, donate_argnums=0)
+
+
+def dirty_buckets(capacity: int) -> tuple[int, ...]:
+    """The static compaction shapes for a table of ``capacity`` rows:
+    powers of four from 16 up to (exclusive) capacity. Geometric with
+    factor 4 keeps the compile count small (8 buckets at 2²⁰) while
+    bounding padding waste at 4×; a dirty count above the largest
+    bucket falls back to the full-table re-predict, which at that
+    churn is the cheaper program anyway. Shared with warmup so serving
+    can never pick an un-warmed shape."""
+    out = []
+    b = 16
+    while b < capacity:
+        out.append(b)
+        b *= 4
+    return tuple(out)
+
+
+class _Pending:
+    """One dispatched-but-uncommitted incremental update (the pipelined
+    host-native split): the host stage fixed the device handles against
+    tick N's table; ``IncrementalLabels.finish`` (device-stage worker)
+    runs the host predict and commits."""
+
+    __slots__ = ("kind", "idx", "X", "n_dirty", "labels")
+
+    def __init__(self, kind: str, idx=None, X=None, n_dirty: int = 0,
+                 labels=None):
+        self.kind = kind  # "none" | "subset" | "full" | "full-nocommit"
+        self.idx = idx  # (bucket,) device indices, padded with capacity
+        self.X = X  # dirty-row (or full) feature matrix, device
+        self.n_dirty = n_dirty
+        self.labels = labels  # device mode: already-final label vector
+
+
+class IncrementalLabels:
+    """The serve loop's label source when ``--incremental`` is on: a
+    persistent (capacity,) label vector maintained by dirty-set
+    prediction.
+
+    ``labels()`` is the serial entry point (and the pipelined DEVICE
+    path's dispatch — everything it launches is async). The pipelined
+    host-native path splits it: ``dispatch()`` on the host stage fixes
+    the tick-N read side, ``finish()`` on the device-stage worker runs
+    the (GIL-dropping) host predict and commits the cache.
+    """
+
+    def __init__(self, engine, predict, params, *, degrade=None,
+                 metrics=None, recorder=None, tracer=None):
+        if engine.dirty is None:
+            engine.enable_dirty_tracking()
+        self._engine = engine
+        self._predict = predict
+        self._params = params
+        self._degrade = degrade
+        self._metrics = metrics
+        self._recorder = recorder
+        self._tracer = tracer
+        self.capacity = engine.table.capacity
+        self.buckets = dirty_buckets(self.capacity)
+        self.host_native = bool(getattr(predict, "host_native", False))
+        # shared between the host stage and the device-stage worker
+        # (pipelined host-native composition); never held across a
+        # predict call
+        self._lock = threading.Lock()
+        self._cache = None  # device mode: jax.Array (capacity,)
+        self._host_cache: np.ndarray | None = None
+        self._pending_redirty: list[np.ndarray] = []
+        self._invalidate = False
+        self._epoch = self._current_epoch()
+        self._last_dirty = 0
+        self._invalidations = 0
+        self._full_predicts = 0
+        self._subset_predicts = 0
+
+    # -- public surface ----------------------------------------------------
+    def invalidate(self, reason: str = "explicit") -> None:
+        """Mark the whole cache stale: the next render tick re-predicts
+        the full table. Called internally on label-epoch changes
+        (promotion hot-swap, degrade rung change) and by anything else
+        that swaps label semantics out from under the cache."""
+        with self._lock:
+            self._invalidate = True
+            self._invalidations += 1
+        if self._metrics is not None:
+            self._metrics.inc("label_cache_invalidations")
+        if self._recorder is not None:
+            self._recorder.record("label_cache.invalidate", reason=reason)
+
+    def status(self) -> dict:
+        """The /healthz self-report (obs.HealthState.set_label_cache)."""
+        with self._lock:
+            dirty = self._last_dirty
+            inv = self._invalidations
+            full = self._full_predicts
+            subset = self._subset_predicts
+        return {
+            "mode": "host" if self.host_native else "device",
+            "coverage": round(1.0 - dirty / max(1, self.capacity), 6),
+            "dirty_rows": dirty,
+            "invalidations": inv,
+            "full_predicts": full,
+            "subset_predicts": subset,
+        }
+
+    def labels(self):
+        """This tick's full-table label vector (device array in device
+        mode, host ndarray in host-native mode), refreshed by dirty-set
+        prediction. Serial path and pipelined-device dispatch."""
+        return self.finish(self.dispatch())
+
+    # -- host-stage half ---------------------------------------------------
+    def dispatch(self) -> _Pending:
+        """Fix this render tick's read side against the CURRENT table
+        (host stage; device work is dispatched, never synced — except
+        the one dirty-count scalar). Returns the pending update for
+        ``finish``."""
+        span = (
+            self._tracer.span("compact") if self._tracer is not None
+            else contextlib.nullcontext()
+        )
+        with span:
+            plan = self._plan()
+        if plan.kind in ("full", "full-nocommit"):
+            plan.X = ft.features12(self._engine.table)
+            with self._lock:
+                self._full_predicts += 1
+        if self.host_native or plan.kind == "none":
+            return plan
+        # device mode: predict + commit now — all async dispatch
+        return self._device_run(plan)
+
+    def _plan(self) -> _Pending:
+        """Decide none/subset/full for this tick and dispatch the
+        compaction. Host stage only. Committing plans ("subset",
+        "full") clear the dirty mask HERE: the next tick's scatter
+        re-marks what it touches, and a later discarded commit (stale
+        predict) re-marks through the redirty queue / invalidation."""
+        eng = self._engine
+        # label-source changes (promotion hot-swap, degrade rung move)
+        # invalidate everything: wrong-but-cached must not survive them
+        epoch = self._current_epoch()
+        if epoch != self._epoch:
+            self._epoch = epoch
+            self.invalidate("label-epoch")
+        with self._lock:
+            invalidate = self._invalidate
+            self._invalidate = False
+            redirty, self._pending_redirty = self._pending_redirty, []
+            primed = (
+                self._cache is not None or self._host_cache is not None
+            )
+        try:
+            faults.fault_point("serve.dirty_mask")
+        except faults.FaultInjected:
+            # ABSORBED: the dirty bookkeeping is suspect — serve this
+            # tick from a direct full-table re-predict (no cache or
+            # mask mutation on the fault path) and rebuild both from
+            # scratch next tick; never a stale label served as fresh
+            self._record_fault("serve.dirty_mask")
+            self.invalidate("fault:serve.dirty_mask")
+            with self._lock:
+                self._pending_redirty = redirty + self._pending_redirty
+            self._note(self.capacity)
+            return _Pending("full-nocommit", n_dirty=self.capacity)
+        for slots in redirty:
+            eng.dirty = mark_dirty_slots_jit(eng.dirty, slots)
+        if invalidate or not primed:
+            eng.dirty = jnp.zeros_like(eng.dirty)
+            self._note(self.capacity)
+            return _Pending("full", n_dirty=self.capacity)
+        if self._ladder_rung() not in (None, "HEALTHY"):
+            # off the healthy rung the whole table must carry the
+            # fallback's labels (what the full re-predict path serves);
+            # routing the full matrix through the ladder also keeps its
+            # per-tick retry/probe machinery live on idle streams
+            eng.dirty = jnp.zeros_like(eng.dirty)
+            self._note(self.capacity)
+            return _Pending("full", n_dirty=self.capacity)
+        n = int(dirty_count_jit(eng.dirty))
+        self._note(n)
+        if n == 0:
+            if self._metrics is not None:
+                self._metrics.inc("predict_rows_saved", self.capacity)
+            return _Pending("none", n_dirty=0)
+        bucket = next((b for b in self.buckets if n <= b), None)
+        if bucket is None:
+            # churn above the largest compaction bucket: the full
+            # program is the cheaper one — predict everything, commit
+            # (the gauge reports the full-table re-predict)
+            eng.dirty = jnp.zeros_like(eng.dirty)
+            self._note(self.capacity)
+            return _Pending("full", n_dirty=n)
+        try:
+            faults.fault_point("serve.label_cache")
+        except faults.FaultInjected:
+            # ABSORBED: the cache merge seam is suspect — this tick is
+            # served from a direct full re-predict, the cache and dirty
+            # mask are left untouched (the dirty rows re-predict next
+            # tick), and no stale label is ever served as fresh. The
+            # gauge reports what the tick actually re-predicts: all of it
+            self._record_fault("serve.label_cache")
+            self._note(self.capacity)
+            return _Pending("full-nocommit", n_dirty=n)
+        idx = compact_dirty_jit(eng.dirty, bucket=bucket)
+        Xd = features12_at_jit(eng.table, idx)
+        eng.dirty = jnp.zeros_like(eng.dirty)
+        if self._metrics is not None:
+            self._metrics.inc("predict_rows_saved", self.capacity - n)
+        with self._lock:
+            self._subset_predicts += 1
+        return _Pending("subset", idx=idx, X=Xd, n_dirty=n)
+
+    def _note(self, n: int) -> None:
+        """Record this tick's predicted-row count (gauge + /healthz)."""
+        self._set_last_dirty(n)
+        if self._metrics is not None:
+            self._metrics.set("dirty_rows", n)
+
+    def _device_run(self, plan: _Pending) -> _Pending:
+        """Device-mode predict+commit (async; host stage)."""
+        labels = self._predict(self._params, plan.X)
+        if plan.kind == "subset":
+            with self._lock:
+                cache = self._cache
+            cache = merge_labels_jit(cache, plan.idx, labels)
+        elif plan.kind == "full-nocommit":
+            # serve the fresh labels; leave cache+dirty for next tick
+            plan.labels = labels
+            return plan
+        else:
+            cache = labels
+        with self._lock:
+            self._cache = cache
+        plan.labels = cache
+        return plan
+
+    # -- device-stage half -------------------------------------------------
+    def finish(self, plan: _Pending):
+        """Commit the pending update and return the full label vector.
+        In the pipelined host-native composition this runs on the
+        device-stage worker (the predict drops the GIL there); jobs are
+        consumed serially, so commits land in dispatch order."""
+        if not self.host_native:
+            if plan.labels is not None:
+                return plan.labels
+            with self._lock:
+                return self._cache
+        if plan.kind == "none":
+            with self._lock:
+                return self._host_cache
+        labels = np.asarray(self._predict(self._params, plan.X))
+        if self._stale_now():
+            # the ladder served last-known-good (BROKEN) — possibly
+            # zero-padded to this batch's shape. NEVER commit: the
+            # cache is the true last-known-good vector; re-mark the
+            # attempted rows so recovery re-predicts them
+            if plan.kind == "subset":
+                with self._lock:
+                    self._pending_redirty.append(np.asarray(plan.idx))
+            else:
+                self.invalidate("stale-predict")
+            with self._lock:
+                cached = self._host_cache
+            if cached is not None:
+                return cached
+            # broken from boot: nothing cached — the ladder's own
+            # zero-label stale vector is exactly what the full path
+            # serves here
+            return np.zeros(self.capacity, labels.dtype)
+        if self._current_epoch() != self._epoch:
+            # the label source changed UNDER this predict (mid-call
+            # trip/promotion): the returned labels are fresh on the NEW
+            # source, so committing them is sound, but the rest of the
+            # cache predates the change — rebuild next tick
+            self.invalidate("epoch-mid-flight")
+        if plan.kind == "full-nocommit":
+            return labels
+        if plan.kind == "subset":
+            idx = np.asarray(plan.idx)
+            valid = idx < self.capacity
+            with self._lock:
+                cache = self._host_cache
+                if cache is not None and cache.dtype == labels.dtype:
+                    cache[idx[valid]] = labels[valid]
+                    return cache
+            # cache lost under an in-flight subset (invalidated by a
+            # stale full predict ahead of us): serve zeros-consistent
+            # behavior by re-marking and falling back to the ladder's
+            # stale semantics
+            with self._lock:
+                self._pending_redirty.append(idx)
+                cached = self._host_cache
+            return (
+                cached if cached is not None
+                else np.zeros(self.capacity, labels.dtype)
+            )
+        cache = np.array(labels)  # own it: the cache outlives the tick
+        with self._lock:
+            self._host_cache = cache
+        return cache
+
+    # -- helpers -----------------------------------------------------------
+    def _current_epoch(self):
+        return getattr(self._predict, "label_epoch", None)
+
+    def _ladder_rung(self) -> str | None:
+        if self._degrade is None:
+            return None
+        try:
+            return self._degrade.status().get("rung")
+        except Exception:  # noqa: BLE001 — health probes must not serve
+            return None
+
+    def _stale_now(self) -> bool:
+        return (
+            self._degrade is not None
+            and bool(getattr(self._degrade, "render_stale", False))
+        )
+
+    def _set_last_dirty(self, n: int) -> None:
+        with self._lock:
+            self._last_dirty = n
+
+    def _record_fault(self, site: str) -> None:
+        if self._recorder is not None:
+            self._recorder.record(
+                "label_cache.fault_absorbed", site=site
+            )
+
+
+# ---------------------------------------------------------------------------
+# Pipelined read-side objects (serving/pipeline.dispatch_read builds these
+# when the serve is incremental; same contract as RankedRead/FullRead)
+# ---------------------------------------------------------------------------
+
+
+class IncRankedRead:
+    """Tick-N ranked read side through the label cache: the host stage
+    dispatched the incremental update (``pending``) and the ranked
+    flags against tick N's table; ``rows()`` (device-stage worker)
+    commits the cache and joins labels by slot. Used for the
+    host-native composition — the device-kernel path reads the cache
+    through the ordinary ``RankedRead`` (labels gathered device-side,
+    O(rows) crossing)."""
+
+    __slots__ = ("_inc", "_pending", "_flags", "n_flows")
+
+    def __init__(self, inc: IncrementalLabels, pending: _Pending,
+                 flags, n_flows: int):
+        self._inc = inc
+        self._pending = pending
+        self._flags = flags
+        self.n_flows = n_flows
+
+    def rows(self) -> list[tuple]:
+        labels = np.asarray(self._inc.finish(self._pending))
+        idx, valid, fa, ra = (np.asarray(o) for o in self._flags)
+        return [
+            (int(s), int(labels[int(s)]), bool(f), bool(r))
+            for s, v, f, r in zip(idx, valid, fa, ra)
+            if v
+        ]
+
+
+class IncFullRead:
+    """Unbounded (``--table-rows 0``) read side through the label
+    cache: the full render is O(N) by definition, so the worker syncs
+    the whole cached label vector (device or host mode) and joins the
+    dispatch-time metadata snapshot — the ``FullRead`` contract."""
+
+    __slots__ = ("_inc", "_pending", "_fa", "_ra", "_meta", "n_flows")
+
+    def __init__(self, inc: IncrementalLabels, pending: _Pending,
+                 fa, ra, meta, n_flows: int):
+        self._inc = inc
+        self._pending = pending
+        self._fa = fa
+        self._ra = ra
+        self._meta = meta
+        self.n_flows = n_flows
+
+    def rows(self) -> list[tuple]:
+        labels = np.asarray(self._inc.finish(self._pending))
+        fa = np.asarray(self._fa)
+        ra = np.asarray(self._ra)
+        return [
+            (slot, src, dst, int(labels[slot]), bool(fa[slot]),
+             bool(ra[slot]))
+            for slot, (src, dst) in sorted(self._meta.items())
+        ]

@@ -481,7 +481,8 @@ class FullRead:
 
 
 def dispatch_read(engine, predict, params, table_rows: int,
-                  feature_stage: FeatureStage | None = None):
+                  feature_stage: FeatureStage | None = None,
+                  inc=None):
     """Dispatch one render tick's whole read side against the engine's
     CURRENT (tick-N) table and return the un-synced read object —
     the host-stage half of the pipeline's render path, shared by
@@ -492,12 +493,30 @@ def dispatch_read(engine, predict, params, table_rows: int,
     buffers) or a host value captured here (``n_flows``); slot
     metadata for ranked rows is resolved by the device stage per slot
     — safe because ranked slots are in-use at tick N and the serve
-    loop defers eviction while renders are in flight."""
+    loop defers eviction while renders are in flight.
+
+    ``inc`` (serving/incremental.IncrementalLabels) swaps the
+    full-table predict for the dirty-set/label-cache path: the
+    device-kernel ranked read still flows through ``RankedRead`` (the
+    cache is a device label vector — ``top_active_render`` gathers it
+    device-side), the host-native and full-table reads route through
+    the incremental read objects so the (GIL-dropping) predict still
+    lands on the device-stage worker."""
     host_native = getattr(predict, "host_native", False)
     floor = np.int32(engine.tick_floor)
     n_flows = engine.num_flows()
     if table_rows > 0:
         n = min(table_rows, engine.table.capacity)
+        if inc is not None:
+            if inc.host_native:
+                from .incremental import IncRankedRead
+
+                pending = inc.dispatch()
+                flags = ft.top_active_flags(engine.table, n, floor)
+                return IncRankedRead(inc, pending, flags, n_flows)
+            labels = inc.labels()  # dispatched; cache stays on device
+            outs = ft.top_active_render(engine.table, labels, n, floor)
+            return RankedRead(outs, n_flows)
         if host_native:
             X = engine.features()
             flags = ft.top_active_flags(engine.table, n, floor)
@@ -509,10 +528,15 @@ def dispatch_read(engine, predict, params, table_rows: int,
         labels = predict(params, X)
         outs = ft.top_active_render(engine.table, labels, n, floor)
         return RankedRead(outs, n_flows)
-    X = engine.features()
-    labels = None if host_native else predict(params, X)
     # [:-1] slices are fresh derived arrays — donation-safe snapshots
     fa = engine.table.fwd.active[:-1]
     ra = engine.table.rev.active[:-1]
     meta = dict(engine.slot_metadata())
+    if inc is not None:
+        from .incremental import IncFullRead
+
+        pending = inc.dispatch()
+        return IncFullRead(inc, pending, fa, ra, meta, n_flows)
+    X = engine.features()
+    labels = None if host_native else predict(params, X)
     return FullRead(X, labels, fa, ra, meta, predict, params, n_flows)

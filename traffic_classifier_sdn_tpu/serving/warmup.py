@@ -67,7 +67,8 @@ def _warm_jitted(fn, *args) -> None:
 
 
 def warmup_serving(engine, predict, params, *, table_rows: int,
-                   idle_timeout: int | None = None) -> dict:
+                   idle_timeout: int | None = None,
+                   incremental: bool = False) -> dict:
     """Precompile the serve loop's device programs for ``engine``'s
     shapes. Returns ``{"warmed": [...], "seconds": float}``.
 
@@ -84,6 +85,10 @@ def warmup_serving(engine, predict, params, *, table_rows: int,
         outs = engine.tick_read_dispatch(now=0)
         jax.block_until_ready(outs)
         warmed.append("sharded.tick_read")
+        if incremental and getattr(engine, "incremental", False):
+            # every dirty-bucket variant of the incremental read side
+            # (one tick_read_dispatch only hit one bucket)
+            warmed.extend(engine.warmup_incremental())
         return {"warmed": warmed, "seconds": time.perf_counter() - t0}
 
     from ..ingest import batcher as batcher_mod
@@ -98,11 +103,27 @@ def warmup_serving(engine, predict, params, *, table_rows: int,
     limit = batcher_mod.bucket_size(
         min(2 * capacity, engine.buckets[-1]), engine.buckets
     )
+    track_dirty = incremental and getattr(engine, "dirty", None) is not None
+    dirty_scratch = (
+        jnp.ones(capacity + 1, bool) if track_dirty else None
+    )
     for b in engine.buckets:
         if b > limit:
             break
         wire = np.zeros((b, 4), np.uint32)
         wire[:, 0] = np.uint32(capacity)  # all-padding rows: a clean no-op
+        if track_dirty:
+            # the dirty-tracking serve scatters through the FUSED
+            # apply+mark program — warming the plain one would leave
+            # the first tick's compile stall in place
+            batcher_mod.apply_wire_dirty_jit.lower(
+                scratch, dirty_scratch, wire
+            ).compile()
+            scratch, dirty_scratch = batcher_mod.apply_wire_dirty_jit(
+                scratch, dirty_scratch, wire
+            )
+            warmed.append(f"apply_wire_dirty[{b}]")
+            continue
         batcher_mod.apply_wire_jit.lower(scratch, wire).compile()
         # the priming call donates its input table; chain the returned
         # scratch so one table's worth of HBM covers every bucket
@@ -130,6 +151,44 @@ def warmup_serving(engine, predict, params, *, table_rows: int,
         labels = predict(params, X)
         warmed.append("predict")
 
+    # -- incremental dirty path (serving/incremental.py) -------------------
+    # One program per dirty-bucket shape: compaction, dirty-row feature
+    # gather, subset predict, and cache scatter — the serve picks its
+    # bucket from the same dirty_buckets list, so the first
+    # nonzero-churn tick can never hit an un-warmed shape.
+    if track_dirty:
+        from . import incremental as inc_mod
+
+        _warm_jitted(inc_mod.dirty_count_jit, dirty_scratch)
+        cache = jnp.zeros(capacity, jnp.asarray(labels).dtype)
+        for b in inc_mod.dirty_buckets(capacity):
+            inc_mod.compact_dirty_jit.lower(
+                dirty_scratch, bucket=b
+            ).compile()
+            idx = inc_mod.compact_dirty_jit(dirty_scratch, bucket=b)
+            _warm_jitted(inc_mod.features12_at_jit, scratch, idx)
+            Xd = inc_mod.features12_at_jit(scratch, idx)
+            if host_native:
+                sub = jnp.asarray(predict(params, Xd))
+            else:
+                _warm_jitted(predict, params, Xd)
+                sub = predict(params, Xd)
+                # cache scatter (cache donated): chain the returned
+                # buffer so one cache's worth of HBM covers all buckets
+                inc_mod.merge_labels_jit.lower(
+                    cache, idx, sub
+                ).compile()
+                cache = inc_mod.merge_labels_jit(cache, idx, sub)
+            # re-invalidation marks arrive bucket-shaped (donated)
+            inc_mod.mark_dirty_slots_jit.lower(
+                dirty_scratch, idx
+            ).compile()
+            dirty_scratch = inc_mod.mark_dirty_slots_jit(
+                dirty_scratch, idx
+            )
+            warmed.append(f"dirty[{b}]")
+        jax.block_until_ready((cache, dirty_scratch))
+
     # -- ranked render gather ---------------------------------------------
     floor = np.int32(0)
     if table_rows > 0:
@@ -146,7 +205,18 @@ def warmup_serving(engine, predict, params, *, table_rows: int,
                      np.int32(idle_timeout))
         smallest = engine.buckets[0]
         pad = np.full(smallest, capacity, np.int32)
-        _warm_jitted(ft.clear_slots, scratch, pad)
+        if track_dirty:
+            # dirty-tracking eviction clears through the fused
+            # clear+invalidate program (dirty donated: chain it)
+            batcher_mod.clear_slots_dirty_jit.lower(
+                scratch, dirty_scratch, pad
+            ).compile()
+            _, dirty_scratch = batcher_mod.clear_slots_dirty_jit(
+                scratch, dirty_scratch, pad
+            )
+            jax.block_until_ready(dirty_scratch)
+        else:
+            _warm_jitted(ft.clear_slots, scratch, pad)
         warmed.append("evict")
 
     return {"warmed": warmed, "seconds": time.perf_counter() - t0}

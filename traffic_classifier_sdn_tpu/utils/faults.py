@@ -106,6 +106,22 @@ SITES: dict[str, str] = {
         "backoff (chaos must cover the failed-recovery path, not just "
         "the clean re-promotion)"
     ),
+    "serve.dirty_mask": (
+        "serving/incremental.IncrementalLabels dirty-mask consult — the "
+        "per-slot dirty bookkeeping behind incremental prediction is "
+        "suspect this tick; ABSORBED: the tick degrades to a direct "
+        "full-table re-predict (served fresh, cache and mask untouched "
+        "on the fault path) and the mask/cache pair is rebuilt from "
+        "scratch at the next render — a stale label is never served as "
+        "fresh"
+    ),
+    "serve.label_cache": (
+        "serving/incremental.IncrementalLabels cache-merge seam — the "
+        "device-resident label cache cannot accept this tick's dirty-"
+        "row labels; ABSORBED: the tick degrades to a direct full-table "
+        "re-predict served fresh, the cache and dirty mask are left "
+        "untouched, and the dirty rows re-predict at the next render"
+    ),
     "drift.window": (
         "serving/drift.DriftController window observation — the "
         "off-hot-path materialization/stats update for one observed "
