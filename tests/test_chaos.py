@@ -1169,3 +1169,160 @@ def test_serve_dirty_mask_fault_sharded_engine_absorbed():
     rf, _ = full.tick_render(now=full.last_time, idle_seconds=3600)
     ri, _ = inc.tick_render(now=inc.last_time, idle_seconds=3600)
     assert rf == ri
+
+
+# ------------------------------------------------------------------- fan-in
+
+
+def _fanin_tier(n_sources=3, n_flows=4, quarantine_s=0.1, metrics=None):
+    from traffic_classifier_sdn_tpu.ingest import fanin
+
+    specs = [
+        fanin.SourceSpec(kind="synthetic", sid=i, n_flows=n_flows,
+                         seed=i, mac_base=i * n_flows, lockstep=True)
+        for i in range(n_sources)
+    ]
+    return fanin.FanInIngest(
+        specs, quarantine_s=quarantine_s, metrics=metrics,
+    )
+
+
+def _fanin_drive(tier, eng, gen, ticks):
+    """Serve-side drive: ingest fan-in batches and apply expired
+    quarantines, exactly like cli._evict_dead_namespaces."""
+    evicted = {}
+    for _ in range(ticks):
+        batch = next(gen, None)
+        if batch is None:
+            break
+        eng.mark_tick()
+        eng.ingest(batch)
+        eng.step()
+        for sid in tier.take_evictions():
+            evicted[sid] = eng.evict_source(sid)
+    return evicted
+
+
+def test_fanin_put_drop_burst_absorbed_per_source():
+    """ingest.fanin_put fires == a queue-full drop burst: the batch is
+    dropped and counted against ITS source, the producer never sees an
+    exception, and later puts flow again — a noisy seam costs its own
+    telemetry, not the tier."""
+    from traffic_classifier_sdn_tpu.ingest import fanin
+
+    q = fanin.FanInQueue(max_records=1 << 10)
+    r = TelemetryRecord(
+        time=1, datapath="1", in_port="1", eth_src="aa", eth_dst="bb",
+        out_port="2", packets=1, bytes=10,
+    )
+    plan = faults.FaultPlan(
+        [faults.FaultRule("ingest.fanin_put", after=1, times=2)], SEED
+    )
+    with faults.installed(plan):
+        assert q.put(0, [r] * 3)          # hit 1: clean
+        assert not q.put(1, [r] * 5)      # hit 2: fires — burst dropped
+        assert not q.put(2, [r] * 7)      # hit 3: fires
+        assert q.put(1, [r] * 2)          # hit 4: recovered
+    assert plan.fires == [
+        ("ingest.fanin_put", 2), ("ingest.fanin_put", 3),
+    ]
+    assert q.drops() == {1: 5, 2: 7}
+    assert q.accepted() == {0: 3, 1: 2}
+    assert q.pending == 5
+
+
+def test_fanin_put_probabilistic_accounting_any_seed():
+    """Probability-scheduled enqueue failures (any TCSDN_CHAOS_SEED):
+    whatever subset fires, put never raises and every record is
+    accounted exactly once — accepted + dropped == emitted, per
+    source."""
+    from traffic_classifier_sdn_tpu.ingest import fanin
+
+    q = fanin.FanInQueue(max_records=1 << 20)
+    r = TelemetryRecord(
+        time=1, datapath="1", in_port="1", eth_src="aa", eth_dst="bb",
+        out_port="2", packets=1, bytes=10,
+    )
+    emitted = {0: 0, 1: 0, 2: 0}
+    with faults.installed(faults.FaultPlan(
+        [faults.FaultRule("ingest.fanin_put", times=None, p=0.3)], SEED
+    )):
+        for i in range(60):
+            sid = i % 3
+            q.put(sid, [r] * (1 + i % 4))
+            emitted[sid] += 1 + i % 4
+    drops, acc = q.drops(), q.accepted()
+    for sid in emitted:
+        assert acc.get(sid, 0) + drops.get(sid, 0) == emitted[sid]
+
+
+def test_fanin_source_dead_quarantines_only_its_namespace():
+    """ingest.source_dead fires mid-stream in ONE of three pumps: that
+    source goes DEAD (unclean), its namespace quarantines and evicts,
+    and the other two keep serving fresh telemetry every tick — the
+    blast radius is one namespace, never the tier."""
+    tier = _fanin_tier(n_sources=3, n_flows=4, quarantine_s=0.1)
+    eng = FlowStateEngine(64)
+    gen = tier.ticks(tick_timeout=5.0)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("ingest.source_dead", after=7)], SEED
+    )
+    try:
+        with faults.installed(plan):
+            _fanin_drive(tier, eng, gen, 2)
+            assert eng.num_flows() == 12
+            evicted = {}
+            deadline = time.monotonic() + 30.0
+            while not evicted and time.monotonic() < deadline:
+                evicted.update(_fanin_drive(tier, eng, gen, 1))
+        assert plan.fires, "the death rule never fired"
+        # exactly one source died — whichever pump drew hit 8
+        states = {r["id"]: r["state"] for r in tier.roster()}
+        dead = [sid for sid, s in states.items() if s == "DEAD"]
+        assert len(dead) == 1
+        assert evicted == {dead[0]: 4}
+        assert eng.index.slots_for_source(dead[0]) == []
+        for sid in set(states) - set(dead):
+            assert len(eng.index.slots_for_source(sid)) == 4
+        # survivors still deliver: the tick clock keeps advancing
+        t0 = int(eng.last_time)
+        _fanin_drive(tier, eng, gen, 2)
+        assert int(eng.last_time) > t0
+    finally:
+        gen.close()
+
+
+def test_fanin_source_dead_probabilistic_survival_any_seed():
+    """Probability-scheduled source deaths (any TCSDN_CHAOS_SEED):
+    whatever subset of the three pumps dies, the serve side never sees
+    an exception, every evicted namespace belongs to a dead source, and
+    live namespaces keep their flows."""
+    tier = _fanin_tier(n_sources=3, n_flows=3, quarantine_s=0.05)
+    eng = FlowStateEngine(64)
+    gen = tier.ticks(tick_timeout=2.0)
+    evicted = {}
+    try:
+        with faults.installed(faults.FaultPlan(
+            [faults.FaultRule("ingest.source_dead", after=3,
+                              times=None, p=0.15)], SEED
+        )):
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                got = _fanin_drive(tier, eng, gen, 1)
+                evicted.update(got)
+                if not tier.running:
+                    break
+            # drain any quarantine that expired after the stream ended
+            for sid in tier.take_evictions():
+                evicted[sid] = eng.evict_source(sid)
+    finally:
+        gen.close()
+    states = {r["id"]: r["state"] for r in tier.roster()}
+    clean = {r["id"]: r["clean"] for r in tier.roster()}
+    for sid in evicted:
+        assert states[sid] == "DEAD" and not clean[sid]
+        assert eng.index.slots_for_source(sid) == []
+    for sid, state in states.items():
+        if state != "DEAD" and eng.num_flows():
+            # a live source's namespace was never collateral damage
+            assert len(eng.index.slots_for_source(sid)) in (0, 3)

@@ -1,0 +1,550 @@
+"""Fan-in ingest tier (ingest/fanin.py): bounded MPSC semantics,
+per-source namespacing, blast radius, and serve-loop identity.
+
+The contract under test: N sources feed one serve loop, each in its own
+flow-table namespace (source id folded into the flow key), producers
+never block, drops are accounted per source, and a dead source costs
+exactly its own namespace — nothing else. Single-source fan-in must be
+byte-identical to the direct collector path, and the SAME records
+produce the SAME per-flow labels whether they arrive through one source
+or split across two (namespace-stripped render identity).
+"""
+
+import contextlib
+import io
+import time
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu import cli
+from traffic_classifier_sdn_tpu.ingest import fanin
+from traffic_classifier_sdn_tpu.ingest.batcher import (
+    FlowIndex,
+    FlowStateEngine,
+)
+from traffic_classifier_sdn_tpu.ingest.protocol import (
+    TelemetryRecord,
+    format_line,
+    stable_flow_key,
+)
+from traffic_classifier_sdn_tpu.obs import HealthState
+from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+
+def _rec(t, src, dst, pkts, bts, source=0):
+    return TelemetryRecord(
+        time=t, datapath="1", in_port="1", eth_src=src, eth_dst=dst,
+        out_port="2", packets=pkts, bytes=bts, source=source,
+    )
+
+
+# ---------------------------------------------------------------------------
+# key namespacing
+# ---------------------------------------------------------------------------
+
+def test_stable_flow_key_source_zero_is_legacy():
+    """Source 0 must produce the historical digest bit-for-bit —
+    pre-fan-in serving checkpoints restore into the default namespace."""
+    assert stable_flow_key("1", "aa", "bb") == stable_flow_key(
+        "1", "aa", "bb", source=0
+    )
+
+
+def test_stable_flow_key_namespaces_are_disjoint():
+    keys = {
+        stable_flow_key("1", "aa", "bb", source=s) for s in range(8)
+    }
+    assert len(keys) == 8
+
+
+def test_flow_index_tracks_slot_source():
+    idx = FlowIndex(capacity=16)
+    a0 = idx.assign(_rec(1, "aa", "bb", 1, 10))
+    a1 = idx.assign(_rec(1, "aa", "bb", 1, 10, source=1))
+    a2 = idx.assign(_rec(1, "cc", "dd", 1, 10, source=2))
+    # identical tuples in different namespaces take different slots
+    assert a0.slot != a1.slot
+    assert sorted(idx.slots_for_source(1)) == [a1.slot]
+    assert sorted(idx.slots_for_source(2)) == [a2.slot]
+    assert sorted(idx.slots_for_source(0)) == [a0.slot]
+    # reverse-direction folding stays inside the namespace
+    rev = idx.assign(_rec(2, "bb", "aa", 1, 10, source=1))
+    assert rev.slot == a1.slot and not rev.is_fwd
+    idx.release_slot(a1.slot)
+    assert idx.slots_for_source(1) == []
+
+
+# ---------------------------------------------------------------------------
+# the MPSC queue
+# ---------------------------------------------------------------------------
+
+def test_queue_bound_drops_incoming_per_source():
+    q = fanin.FanInQueue(max_records=5)
+    assert q.put(0, [_rec(1, "a", "b", 1, 1)] * 3)
+    # source 1's oversized batch drops — and is counted against source 1
+    assert not q.put(1, [_rec(1, "c", "d", 1, 1)] * 4)
+    assert q.put(0, [_rec(2, "a", "b", 2, 2)] * 2)
+    assert q.drops() == {1: 4}
+    assert q.accepted() == {0: 5}
+    assert q.pending == 5
+
+
+def test_queue_take_one_batch_per_source_in_arrival_order():
+    q = fanin.FanInQueue(max_records=100)
+    q.put(0, [_rec(1, "a", "b", 1, 1)])
+    q.put(1, [_rec(1, "c", "d", 1, 1)])
+    q.put(0, [_rec(2, "a", "b", 2, 2)])  # source 0's SECOND poll tick
+    got = q.take()
+    assert [sid for sid, _ in got] == [0, 1]
+    assert got[0][1][0].time == 1  # the oldest batch, not the newest
+    # the backlogged batch surfaces on the next take
+    got2 = q.take()
+    assert [(sid, recs[0].time) for sid, recs in got2] == [(0, 2)]
+    assert q.pending == 0
+
+
+def test_queue_take_exclude_skips_sources():
+    q = fanin.FanInQueue(max_records=100)
+    q.put(0, [_rec(1, "a", "b", 1, 1)])
+    q.put(1, [_rec(1, "c", "d", 1, 1)])
+    got = q.take(exclude={0})
+    assert [sid for sid, _ in got] == [1]
+    assert q.pending == 1  # source 0's batch stays queued
+
+
+# ---------------------------------------------------------------------------
+# specs
+# ---------------------------------------------------------------------------
+
+def test_parse_source_spec_kinds():
+    s = fanin.parse_source_spec("cmd:python x.py", 3)
+    assert s.kind == "cmd" and s.cmd == "python x.py" and s.sid == 3
+    s = fanin.parse_source_spec("capture:/tmp/c.tsv", 1)
+    assert s.kind == "capture" and s.path == "/tmp/c.tsv"
+    s = fanin.parse_source_spec("synthetic:64", 2)
+    assert s.kind == "synthetic" and s.n_flows == 64
+    assert s.mac_base == 2 * 64  # disjoint MAC space per namespace
+    with pytest.raises(ValueError):
+        fanin.parse_source_spec("noarg", 0)
+    with pytest.raises(ValueError):
+        fanin.parse_source_spec("weird:thing", 0)
+    with pytest.raises(ValueError):
+        fanin.parse_source_spec("synthetic:notanint", 0)
+
+
+def test_specs_from_cli_synthetic_split_disjoint():
+    specs = fanin.specs_from_cli(
+        "synthetic", 4, None, synthetic_flows=64,
+    )
+    assert [s.sid for s in specs] == [0, 1, 2, 3]
+    assert all(s.n_flows == 16 for s in specs)
+    bases = [s.mac_base for s in specs]
+    assert bases == [0, 16, 32, 48]  # disjoint host populations
+
+
+def test_specs_from_cli_rejects_duplicates_and_workload():
+    with pytest.raises(ValueError):
+        fanin.specs_from_cli("workload", 2, None)
+    with pytest.raises(ValueError):
+        fanin.FanInIngest([
+            fanin.SourceSpec(kind="synthetic", sid=0, n_flows=1),
+            fanin.SourceSpec(kind="synthetic", sid=0, n_flows=1),
+        ])
+
+
+# ---------------------------------------------------------------------------
+# blast radius: kill one of three, others keep serving
+# ---------------------------------------------------------------------------
+
+def _drive(tier, eng, gen, ticks, on_tick=None):
+    """Advance the serve side: ingest `ticks` fan-in batches, applying
+    expired quarantines exactly like cli._evict_dead_namespaces."""
+    evicted = {}
+    for _ in range(ticks):
+        batch = next(gen, None)
+        if batch is None:
+            break
+        eng.mark_tick()
+        eng.ingest(batch)
+        eng.step()
+        for sid in tier.take_evictions():
+            evicted[sid] = eng.evict_source(sid)
+        if on_tick is not None:
+            on_tick()
+    return evicted
+
+
+def test_kill_one_of_three_evicts_only_its_namespace():
+    specs = [
+        fanin.SourceSpec(kind="synthetic", sid=i, n_flows=4, seed=i,
+                         mac_base=i * 4, lockstep=True)
+        for i in range(3)
+    ]
+    tier = fanin.FanInIngest(specs, quarantine_s=0.1)
+    eng = FlowStateEngine(64)
+    gen = tier.ticks(tick_timeout=5.0)
+    try:
+        _drive(tier, eng, gen, 3)
+        assert eng.num_flows() == 12
+        before = {
+            sid: sorted(eng.index.slots_for_source(sid))
+            for sid in range(3)
+        }
+        assert all(len(s) == 4 for s in before.values())
+
+        tier.kill_source(1)
+        evicted = {}
+        deadline = time.monotonic() + 20.0
+        while not evicted and time.monotonic() < deadline:
+            evicted.update(_drive(tier, eng, gen, 1))
+        assert evicted == {1: 4}
+        # blast radius: namespace 1 gone, 0 and 2 byte-untouched
+        assert eng.index.slots_for_source(1) == []
+        assert sorted(eng.index.slots_for_source(0)) == before[0]
+        assert sorted(eng.index.slots_for_source(2)) == before[2]
+        assert eng.num_flows() == 8
+        # survivors still FRESH: their counters keep advancing
+        t_before = int(eng.last_time)
+        _drive(tier, eng, gen, 2)
+        assert int(eng.last_time) > t_before
+        states = {r["id"]: r["state"] for r in tier.roster()}
+        assert states == {0: "HEALTHY", 1: "DEAD", 2: "HEALTHY"}
+
+        # a restarted source re-registers into its OLD namespace
+        tier.restart_source(1)
+        deadline = time.monotonic() + 20.0
+        while (len(eng.index.slots_for_source(1)) < 4
+               and time.monotonic() < deadline):
+            _drive(tier, eng, gen, 1)
+        assert len(eng.index.slots_for_source(1)) == 4
+        states = {r["id"]: r["state"] for r in tier.roster()}
+        assert states[1] == "HEALTHY"
+    finally:
+        gen.close()
+
+
+def test_restart_within_quarantine_cancels_eviction():
+    """A source restarted before its quarantine expires keeps its flows:
+    the namespace is live again, evicting it would throw away state the
+    restart just reclaimed."""
+    specs = [
+        fanin.SourceSpec(kind="synthetic", sid=i, n_flows=2, seed=i,
+                         mac_base=i * 2, lockstep=True)
+        for i in range(2)
+    ]
+    tier = fanin.FanInIngest(specs, quarantine_s=60.0)
+    eng = FlowStateEngine(16)
+    gen = tier.ticks(tick_timeout=5.0)
+    try:
+        _drive(tier, eng, gen, 2)
+        tier.kill_source(1)
+        deadline = time.monotonic() + 20.0
+        while (not tier.roster()[1]["state"] == "DEAD"
+               and time.monotonic() < deadline):
+            _drive(tier, eng, gen, 1)
+        assert "quarantine_expires_s" in tier.roster()[1]
+        tier.restart_source(1)
+        assert "quarantine_expires_s" not in tier.roster()[1]
+        evicted = _drive(tier, eng, gen, 3)
+        assert evicted == {}  # the pending eviction was cancelled
+        assert len(eng.index.slots_for_source(1)) == 2
+    finally:
+        gen.close()
+
+
+def test_evict_source_requires_python_batcher():
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
+
+    if not native_engine.available():
+        pytest.skip("C++ engine unavailable")
+    eng = FlowStateEngine(16, native=True)
+    with pytest.raises(RuntimeError, match="Python batcher"):
+        eng.evict_source(1)
+
+
+# ---------------------------------------------------------------------------
+# /healthz roster + metrics catalog
+# ---------------------------------------------------------------------------
+
+def test_healthz_source_roster_and_backcompat():
+    h = HealthState(clock=lambda: 100.0, max_tick_age_s=30.0)
+    h.tick()
+    healthy, report = h.check()
+    assert healthy and "sources" not in report  # single-source shape
+
+    roster = [
+        {"id": 0, "state": "HEALTHY", "lag_s": 0.5, "drops": 0},
+        {"id": 1, "state": "DEAD", "lag_s": 9.0, "drops": 17},
+    ]
+    h.set_source_roster(lambda: roster)
+    h.set_collector_probe(lambda: True)
+    healthy, report = h.check()
+    assert healthy  # one dead source degrades, it does not page
+    assert report["sources"] == roster
+    assert report["collector_alive"] is True  # the legacy boolean holds
+    # a broken roster must never crash /healthz
+    h.set_source_roster(lambda: 1 / 0)
+    _, report = h.check()
+    assert report["sources"][0]["state"] == "unknown"
+
+
+def test_fanin_publishes_per_source_metrics():
+    m = Metrics()
+    specs = [
+        fanin.SourceSpec(kind="synthetic", sid=i, n_flows=2, seed=i,
+                         mac_base=i * 2, lockstep=True)
+        for i in range(2)
+    ]
+    tier = fanin.FanInIngest(specs, quarantine_s=60.0, metrics=m)
+    gen = tier.ticks(tick_timeout=5.0)
+    try:
+        next(gen)
+        assert m.gauges["fanin_sources"] == 2
+        for sid in (0, 1):
+            assert f"source_{sid}_state" in m.gauges
+            assert f"source_{sid}_drops" in m.gauges
+        assert m.gauges["source_0_state"] == 0  # HEALTHY
+    finally:
+        gen.close()
+
+
+# ---------------------------------------------------------------------------
+# serve-loop identity (CLI level)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gnb_checkpoint(tmp_path_factory):
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (2, 12)),
+        "var": rng.gamma(2.0, 50.0, (2, 12)) + 1.0,
+        "class_prior": np.full(2, 0.5),
+    })
+    path = str(tmp_path_factory.mktemp("ckpt") / "gnb")
+    ck.save_model(path, "gnb", params, classes=("ping", "voice"))
+    return path
+
+
+def _serve(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli.main(argv)
+    return buf.getvalue()
+
+
+def _base_args(gnb_checkpoint):
+    return [
+        "gaussiannb", "--native-checkpoint", gnb_checkpoint,
+        "--capacity", "64", "--print-every", "2", "--max-ticks", "6",
+        "--table-rows", "8",
+    ]
+
+
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+def test_single_source_fanin_byte_identical(gnb_checkpoint, pipeline):
+    """Acceptance: --sources 1 must produce byte-identical CLI output to
+    the direct collector path — the fan-in tier is a transparent wrapper
+    until there is more than one source."""
+    common = _base_args(gnb_checkpoint) + [
+        "--source", "synthetic", "--synthetic-flows", "8",
+        "--pipeline", pipeline,
+    ]
+    direct = _serve(common)
+    through_fanin = _serve(
+        common + ["--sources", "1", "--source-lockstep"]
+    )
+    assert "Flow ID" in direct
+    assert through_fanin == direct
+
+
+def _parse_tables(out):
+    """Rendered tables → list of {(src, dst): (label, fwd, rev)} — the
+    namespace-stripped view (slot ids deliberately dropped: namespacing
+    relocates flows, labels must not move with them)."""
+    tables, current = [], None
+    for line in out.splitlines():
+        if line.startswith("| Flow ID"):
+            current = {}
+            tables.append(current)
+            continue
+        if current is None or not line.startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        if len(cells) == 6 and cells[0] != "Flow ID":
+            slot, src, dst, label, fwd, rev = cells
+            current[(src, dst)] = (label, fwd, rev)
+    return tables
+
+
+def _partitioned_captures(tmp_path):
+    """One capture with 8 conversations over 6 ticks, plus the same
+    records partitioned into two 4-conversation captures with identical
+    timestamps — the split-across-sources identity fixture."""
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+
+    syn = SyntheticFlows(n_flows=8, seed=7)
+    ticks = [syn.tick() for _ in range(6)]
+    whole = tmp_path / "whole.tsv"
+    part_a = tmp_path / "part_a.tsv"
+    part_b = tmp_path / "part_b.tsv"
+    macs_a = {syn._mac(i, 0) for i in range(4)}
+    with open(whole, "wb") as fw, open(part_a, "wb") as fa, \
+            open(part_b, "wb") as fb:
+        for tick in ticks:
+            for r in tick:
+                fw.write(format_line(r))
+                if r.eth_src in macs_a or r.eth_dst in macs_a:
+                    fa.write(format_line(r))
+                else:
+                    fb.write(format_line(r))
+    return str(whole), str(part_a), str(part_b)
+
+
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+@pytest.mark.parametrize("incremental", ["auto", "off"])
+def test_namespace_identity_one_vs_two_sources(
+    gnb_checkpoint, tmp_path, pipeline, incremental
+):
+    """The SAME records through one source vs split across two sources
+    must produce byte-identical per-flow labels at every render, once
+    the render is namespace-stripped (slots relocate across namespaces;
+    labels, directions, and activity flags must not)."""
+    whole, part_a, part_b = _partitioned_captures(tmp_path)
+    base = _base_args(gnb_checkpoint) + [
+        "--pipeline", pipeline, "--incremental", incremental,
+        "--source-lockstep",
+    ]
+    one = _serve(base + ["--source-spec", f"capture:{whole}"])
+    two = _serve(base + [
+        "--source-spec", f"capture:{part_a}",
+        "--source-spec", f"capture:{part_b}",
+    ])
+    t_one, t_two = _parse_tables(one), _parse_tables(two)
+    assert t_one and len(t_one) == len(t_two)
+    for i, (a, b) in enumerate(zip(t_one, t_two)):
+        assert a == b, f"render {i} diverged between 1 and 2 sources"
+    # every conversation must actually appear (8 flows, 8-row table)
+    assert len(t_one[-1]) == 8
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+def test_queue_purge_counts_drops_against_the_dead_source():
+    q = fanin.FanInQueue(max_records=100)
+    q.put(0, [_rec(1, "a", "b", 1, 1)])
+    q.put(1, [_rec(1, "c", "d", 1, 1)] * 3)
+    q.put(1, [_rec(2, "c", "d", 2, 2)] * 2)
+    assert q.purge(1) == 5
+    assert q.drops() == {1: 5}
+    assert q.pending == 1  # source 0's batch untouched
+    assert [sid for sid, _ in q.take()] == [0]
+
+
+def test_eviction_purges_dead_sources_queued_backlog():
+    """A dead source's still-queued batches must NOT be ingested after
+    its namespace was evicted — they would re-create slots in a
+    namespace nothing will ever quarantine again (take() pops one batch
+    per source per tick, so a burst can outlive the quarantine)."""
+    clock = {"t": 0.0}
+    tier = fanin.FanInIngest(
+        [fanin.SourceSpec(kind="synthetic", sid=i, n_flows=2, seed=i,
+                          mac_base=i * 2, lockstep=True)
+         for i in range(2)],
+        quarantine_s=5.0, clock=lambda: clock["t"],
+    )
+    # no threads: script the death + backlog directly
+    w = tier._workers[1]
+    with w._state_lock:
+        w._state = fanin.SOURCE_DEAD
+        w._clean = False
+    for t in (1, 2, 3):
+        tier.queue.put(1, [_rec(t, "x", "y", t, t, source=1)])
+    tier._supervise()  # starts the quarantine clock at t=0
+    assert tier.take_evictions() == []  # not expired yet
+    clock["t"] = 6.0
+    assert tier.take_evictions() == [1]
+    # the backlog is gone WITH the namespace, counted as drops
+    assert tier.queue.take(exclude=()) == []
+    assert tier.queue.drops()[1] == 3
+    # and the sid is never re-offered (nothing left to re-create slots)
+    clock["t"] = 60.0
+    assert tier.take_evictions() == []
+
+
+def test_specs_from_cli_rejects_identical_live_commands():
+    """N copies of one monitor command fight over the same port — the
+    homogeneous live mode must refuse unless the command is templated
+    per source ('{sid}')."""
+    with pytest.raises(ValueError, match="sid"):
+        fanin.specs_from_cli("controller", 3, None,
+                            monitor_cmd="python -m ctrl --port 6653")
+    specs = fanin.specs_from_cli(
+        "controller", 3, None,
+        monitor_cmd="python -m ctrl --port 66{sid}",
+    )
+    assert [s.cmd for s in specs] == [
+        "python -m ctrl --port 660",
+        "python -m ctrl --port 661",
+        "python -m ctrl --port 662",
+    ]
+    # single live source needs no template
+    one = fanin.specs_from_cli("ryu", 1, None, monitor_cmd="mon")
+    assert one[0].cmd == "mon"
+
+
+def test_evict_dead_namespaces_skips_native_engine():
+    """Single-source fan-in keeps the C++ engine; a dead source must
+    degrade to idle-timeout reclamation, never crash the serve on the
+    native evict_source guard."""
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    class _Tier:
+        def take_evictions(self):
+            return [0]
+
+    class _NativeEngine:
+        native = True
+
+        def evict_source(self, sid):  # pragma: no cover - must not run
+            raise AssertionError("native evict_source must be skipped")
+
+    m = Metrics()
+    cli._evict_dead_namespaces(_Tier(), _NativeEngine(), m, None, None)
+    assert m.counters["source_evictions_skipped"] == 1
+    assert "source_evictions" not in m.counters
+
+
+def test_train_multisource_forces_python_batcher(tmp_path, capsys):
+    """The train subcommand shares the classify rule: multi-source
+    fan-in routes through the Python batcher (the C++ keyer round-trips
+    the wire format, which has no source field — namespaces would
+    collapse into shared slots)."""
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+
+    syn = SyntheticFlows(n_flows=4, seed=3)
+    cap = tmp_path / "cap.tsv"
+    with open(cap, "wb") as f:
+        for _ in range(3):
+            for r in syn.tick():
+                f.write(format_line(r))
+    out = tmp_path / "train.csv"
+    cli.main([
+        "train", "ping", "--source", "replay", "--capture", str(cap),
+        "--sources", "2", "--source-lockstep", "--capacity", "64",
+        "--duration", "999", "--max-ticks", "3", "--out", str(out),
+    ])
+    lines = out.read_text().splitlines()
+    # both namespaces collected: 4 conversations x 2 sources, written
+    # for every in-use slot at each of the 3 ticks, plus the header
+    assert len(lines) == 1 + 8 * 3
+    err = capsys.readouterr().err
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
+
+    if native_engine.available():
+        assert "Python batcher" in err

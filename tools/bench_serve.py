@@ -488,6 +488,170 @@ def _run_sweep(args, native, predict, params, raw_fn,
     print(json.dumps(out), flush=True)
 
 
+def _run_fanin_sweep(args, predict, params, n_flows: int) -> None:
+    """The fan-in source sweep (docs/artifacts/serve_fanin_sources_cpu
+    .json): for each source count N, drive the REAL fan-in tier
+    (ingest/fanin.py — per-source pump threads, the bounded MPSC queue,
+    per-source supervision) with the aggregate flow population split
+    into N synthetic sources at a 1 Hz emission cadence, and measure
+    whether the serve chain holds the 1 s tick budget: per-tick
+    processing p50 (ingest+scatter+predict+render+evict — the work that
+    must fit under the cadence) plus per-source drop/lag numbers from
+    the tier's roster. A level 'holds' when processing p50 <= 1 s and
+    no source dropped records; the knee is the largest holding level.
+
+    Multi-source fan-in routes through the Python batcher (per-slot
+    source namespacing — same rule as the CLI), so every level pays the
+    same per-record routing cost and the sweep isolates the tier's own
+    scaling."""
+    import numpy as np
+
+    import jax
+
+    from traffic_classifier_sdn_tpu.ingest import fanin
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+
+    levels = [int(x) for x in args.sources_sweep.split(",")]
+    out_levels = []
+    warmed = False
+    for n_sources in levels:
+        per = max(1, n_flows // n_sources)
+        specs = [
+            fanin.SourceSpec(
+                kind="synthetic", sid=sid, n_flows=per, seed=sid,
+                mac_base=sid * per, max_ticks=args.ticks,
+                interval=args.source_interval,
+            )
+            for sid in range(n_sources)
+        ]
+        tier = fanin.FanInIngest(specs, quarantine_s=5.0)
+        eng = FlowStateEngine(capacity=args.capacity, native=False)
+        if args.warmup and not warmed:
+            from traffic_classifier_sdn_tpu.serving.warmup import (
+                warmup_serving,
+            )
+
+            t0 = time.perf_counter()
+            warmup_serving(
+                eng, predict, params, table_rows=args.table_rows,
+                idle_timeout=3600,
+            )
+            print(f"# warmup in {time.perf_counter() - t0:.2f}s",
+                  file=sys.stderr, flush=True)
+            warmed = True
+        timings = {k: [] for k in ("drain", "ingest", "step", "predict",
+                                   "render", "evict", "tick")}
+        n_records = 0
+        roster = []
+        gen = tier.ticks(tick_timeout=max(10.0,
+                                          4 * args.source_interval))
+        t_wall0 = time.perf_counter()
+        try:
+            for ti in range(args.ticks * 2):  # coalesce-split headroom
+                t_w = time.perf_counter()
+                batch = next(gen, None)
+                if batch is None:
+                    break
+                t0 = time.perf_counter()
+                eng.mark_tick()
+                n_records += eng.ingest(batch)
+                t1 = time.perf_counter()
+                eng.step()
+                t2 = time.perf_counter()
+                for sid in tier.take_evictions():
+                    eng.evict_source(sid)
+                labels = predict(params, eng.features())
+                jax.block_until_ready(labels)
+                t3 = time.perf_counter()
+                ranked = eng.render_sample(labels, args.table_rows)
+                sample = eng.slot_metadata(
+                    slots=[s for s, *_ in ranked]
+                )
+                rows = [
+                    (s, *sample[s], c)
+                    for s, c, _fa, _ra in ranked if s in sample
+                ]
+                t4 = time.perf_counter()
+                eng.evict_idle(now=eng.last_time, idle_seconds=3600)
+                t5 = time.perf_counter()
+                assert len(rows) <= args.table_rows
+                timings["drain"].append(t0 - t_w)
+                timings["ingest"].append(t1 - t0)
+                timings["step"].append(t2 - t1)
+                timings["predict"].append(t3 - t2)
+                timings["render"].append(t4 - t3)
+                timings["evict"].append(t5 - t4)
+                timings["tick"].append(t5 - t0)
+                # refreshed per tick: the artifact's per-source numbers
+                # are the last MID-SERVE state, not the post-stream
+                # teardown (bounded sources end DEAD-clean by design)
+                roster = tier.roster()
+        finally:
+            gen.close()
+        wall = time.perf_counter() - t_wall0
+        # steady state: the first serve tick carries thread spin-up (and,
+        # un-warmed, the compiles)
+        steady = timings["tick"][1:] or timings["tick"]
+        p50 = float(np.median(steady))
+        total_drops = sum(r["drops"] for r in roster)
+        lags = [r["lag_s"] for r in roster if r["lag_s"] is not None]
+        holds = p50 <= 1.0 and total_drops == 0
+        level = {
+            "sources": n_sources,
+            "flows_per_source": per,
+            "records_ingested": n_records,
+            "serve_ticks": len(timings["tick"]),
+            "wall_s": round(wall, 3),
+            "tick_processing_p50_ms": round(p50 * 1e3, 2),
+            "tick_processing_p95_ms": round(
+                float(np.percentile(steady, 95)) * 1e3, 2
+            ),
+            "stage_p50_ms": {
+                k: round(float(np.median(v)) * 1e3, 2)
+                for k, v in timings.items() if v
+            },
+            "tracked_flows": eng.num_flows(),
+            "total_drops": total_drops,
+            "max_lag_s": round(max(lags), 3) if lags else None,
+            "holds_1s_cadence": holds,
+            "per_source": [
+                {k: r[k] for k in
+                 ("id", "state", "records", "drops", "lag_s")}
+                for r in roster
+            ],
+        }
+        out_levels.append(level)
+        print(
+            f"# sources={n_sources} tick_p50="
+            f"{level['tick_processing_p50_ms']} ms drops={total_drops} "
+            f"holds={holds}",
+            file=sys.stderr, flush=True,
+        )
+        del tier, eng
+    holding = [lv["sources"] for lv in out_levels
+               if lv["holds_1s_cadence"]]
+    knee = max(holding) if holding else 0
+    out = {
+        "metric": "serve_fanin_sources",
+        "capacity": args.capacity,
+        "aggregate_flows_per_tick": n_flows,
+        "ticks_per_source": args.ticks,
+        "source_interval_s": args.source_interval,
+        "table_rows_rendered": args.table_rows,
+        "predict_model": args.model,
+        "native_ingest": False,
+        "platform": __import__("jax").devices()[0].platform,
+        "warmup": args.warmup,
+        "max_sources_holding_1s_p50": knee,
+        "knee_is_sweep_ceiling": bool(
+            out_levels and holding
+            and knee == out_levels[-1]["sources"]
+        ),
+        "levels": out_levels,
+    }
+    print(json.dumps(out), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--capacity", type=int, default=1 << 20)
@@ -498,6 +662,22 @@ def main() -> None:
         "the updated-row knob behind incremental serving: at 0.1 only "
         "10%% of flows change per tick, so the dirty-set predict "
         "touches 10%% of the table",
+    )
+    ap.add_argument(
+        "--sources-sweep", default=None, metavar="N0,N1,...",
+        help="run the fan-in source sweep instead of a single "
+        "measurement: for each comma-separated source count, split the "
+        "aggregate flow population (--flows-per-tick) across N real "
+        "fan-in sources (ingest/fanin.py pump threads + MPSC queue) at "
+        "--source-interval cadence and report per-tick processing p50, "
+        "per-source drops/lag, and the max source count holding the "
+        "1 s tick budget — one serve_fanin_sources JSON object "
+        "(e.g. 1,2,4,8,16,32)",
+    )
+    ap.add_argument(
+        "--source-interval", type=float, default=1.0, metavar="SECS",
+        help="fan-in sweep emission cadence per source (default 1.0, "
+        "the reference monitor's poll rate)",
     )
     ap.add_argument(
         "--churn-sweep", default=None, metavar="L0,L1,...",
@@ -621,6 +801,10 @@ def main() -> None:
     print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
 
     predict, params, raw_fn = _build_model(args)
+
+    if args.sources_sweep is not None:
+        _run_fanin_sweep(args, predict, params, n_flows)
+        return
 
     if args.churn_sweep is not None:
         _run_sweep(args, native, predict, params, raw_fn, n_flows)

@@ -52,6 +52,7 @@ GROUPS_KEYS=(
   "degrade:degrade_dispatch or degrade_probe"
   "drift:drift_window or retrain_fit or promote_swap or promote_rollback or drift_loop"
   "dirty:serve_dirty_mask or serve_label_cache"
+  "fanin:fanin_put or fanin_source_dead"
 )
 
 fail=0

@@ -97,6 +97,42 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--capture", help="capture file for --source replay")
     p.add_argument(
+        "--sources", type=int, default=0, metavar="N",
+        help="fan-in ingest tier (ingest/fanin.py): serve N "
+        "independently supervised telemetry sources of the base "
+        "--source kind through one bounded MPSC queue, each in its own "
+        "flow-table namespace (source id folded into the flow key). A "
+        "dead source quarantines and evicts only its own namespace; "
+        "every other source keeps serving. 0 (default) = the direct "
+        "single-collector path",
+    )
+    p.add_argument(
+        "--source-spec", action="append", metavar="KIND:ARG",
+        help="explicit fan-in source (repeatable; implies the fan-in "
+        "tier, source ids by position): cmd:<monitor command>, "
+        "capture:<path>, or synthetic:<n_flows> — mix live and replay "
+        "sources in one serve",
+    )
+    p.add_argument(
+        "--source-quarantine", type=float, default=5.0, metavar="SECS",
+        help="grace window between a source's unclean death and the "
+        "eviction of its namespace (default 5.0): a source restarted "
+        "within it re-registers into its old namespace with its flows "
+        "intact",
+    )
+    p.add_argument(
+        "--source-interval", type=float, default=1.0, metavar="SECS",
+        help="emission pacing for pull-paced fan-in sources "
+        "(capture/synthetic): one poll tick per SECS (default 1.0, "
+        "the reference monitor's cadence; 0 = flat out)",
+    )
+    p.add_argument(
+        "--source-lockstep", action="store_true",
+        help="pace pull-paced fan-in sources by consumer credit (one "
+        "emission per serve tick) instead of wall clock — "
+        "deterministic multi-source runs (tests, identity checks)",
+    )
+    p.add_argument(
         "--monitor-cmd",
         default=None,
         help="override the spawned monitor command (--source ryu or controller; for controller this replaces the built-in OpenFlow controller and --of-port is ignored)",
@@ -402,6 +438,27 @@ def _use_native(args) -> bool:
     return ok
 
 
+def _fanin_active(args) -> bool:
+    """The fan-in ingest tier engages on --sources N or any
+    --source-spec entry."""
+    return getattr(args, "sources", 0) > 0 or bool(
+        getattr(args, "source_spec", None)
+    )
+
+
+def _resolved_monitor_cmd(args) -> str:
+    """The monitor command a subprocess source spawns (--monitor-cmd
+    override, the built-in controller, or the reference's Ryu line)."""
+    from .ingest.collector import DEFAULT_MONITOR_CMD
+
+    if args.source == "controller":
+        return args.monitor_cmd or (
+            f"{sys.executable} -m traffic_classifier_sdn_tpu.controller "
+            f"--port {args.of_port}"
+        )
+    return args.monitor_cmd or DEFAULT_MONITOR_CMD
+
+
 def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
     """Yield one batch of telemetry per poll tick: a list of
     TelemetryRecords, or raw pipe bytes when ``raw`` (the native-engine
@@ -411,7 +468,35 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
     supervisor stack; ``probe_out`` (a dict) receives a ``"probe"``
     callable reporting collector liveness once a subprocess source
     starts — the /healthz collector-alive feed (replay/synthetic
-    sources set nothing: there is no collector to be dead)."""
+    sources set nothing: there is no collector to be dead). With the
+    fan-in tier (--sources/--source-spec) it also receives the
+    ``"fanin"`` tier object: the serve loop polls it for expired
+    quarantines and /healthz reads its per-source roster."""
+    if _fanin_active(args):
+        from .ingest import fanin
+        from .utils.metrics import global_metrics
+
+        try:
+            specs = fanin.specs_from_cli(
+                args.source, max(1, args.sources), args.source_spec,
+                capture=args.capture,
+                monitor_cmd=_resolved_monitor_cmd(args),
+                synthetic_flows=args.synthetic_flows,
+                max_restarts=args.monitor_restarts or 0,
+                interval=args.source_interval,
+                lockstep=args.source_lockstep,
+            )
+        except ValueError as e:
+            sys.exit(f"ERROR: {e}")
+        tier = fanin.FanInIngest(
+            specs, quarantine_s=args.source_quarantine,
+            metrics=global_metrics, recorder=recorder,
+        )
+        if probe_out is not None:
+            probe_out["probe"] = tier.alive
+            probe_out["fanin"] = tier
+        yield from tier.ticks()
+        return
     if args.source == "replay":
         if not args.capture:
             sys.exit("--source replay requires --capture FILE")
@@ -435,15 +520,9 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
         while True:
             yield wl.tick()
     else:
-        from .ingest.collector import DEFAULT_MONITOR_CMD, SubprocessCollector
+        from .ingest.collector import SubprocessCollector
 
-        if args.source == "controller":
-            cmd = args.monitor_cmd or (
-                f"{sys.executable} -m traffic_classifier_sdn_tpu.controller "
-                f"--port {args.of_port}"
-            )
-        else:
-            cmd = args.monitor_cmd or DEFAULT_MONITOR_CMD
+        cmd = _resolved_monitor_cmd(args)
         if args.monitor_restarts:
             from .ingest.supervisor import SupervisedCollector
             from .utils.metrics import global_metrics
@@ -509,6 +588,19 @@ def _run_classify_armed(args, lock_witness) -> None:
     if sharded and (args.restore_serve_state or args.save_serve_state
                     or args.serve_checkpoint_every):
         sys.exit("serving-state checkpoints are single-device (no --shards)")
+    fanin_n = (
+        len(args.source_spec) if args.source_spec else args.sources
+    )
+    if _fanin_active(args) and sharded:
+        # the sharded engine has no per-slot source map, so a dead
+        # source's namespace could not be quarantine-evicted
+        sys.exit("the fan-in ingest tier is single-device (no --shards)")
+    if _fanin_active(args) and fanin_n > 1 and args.native_ingest == "on":
+        sys.exit(
+            "multi-source fan-in routes through the Python batcher "
+            "(the C++ index has no per-slot source map for namespace "
+            "eviction) — drop --native-ingest on or serve one source"
+        )
     if args.serve_checkpoint_every and not args.serve_checkpoint_dir:
         sys.exit("--serve-checkpoint-every needs --serve-checkpoint-dir")
     if args.obs_dump_on_exit and not args.obs_dir:
@@ -551,6 +643,16 @@ def _run_classify_armed(args, lock_witness) -> None:
     tracer = Tracer(metrics=m, recorder=recorder)
 
     use_native = _use_native(args)
+    if _fanin_active(args) and fanin_n > 1 and use_native:
+        # namespace-scoped eviction needs FlowIndex.slot_source — the
+        # Python batcher's per-slot source map (validated above for an
+        # explicit --native-ingest on; 'auto' just falls back here)
+        use_native = False
+        print(
+            "fan-in: multi-source serve uses the Python batcher "
+            "(per-slot source namespacing)",
+            file=sys.stderr,
+        )
     if args.restore_serve_state:
         from .io import serving_checkpoint as _sc
 
@@ -1036,6 +1138,12 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                         # source generator has started — wire the
                         # /healthz liveness probe at first arrival
                         health.set_collector_probe(probe_out["probe"])
+                        if probe_out.get("fanin") is not None:
+                            # per-source roster rides alongside the
+                            # single collector_alive boolean
+                            health.set_source_roster(
+                                probe_out["fanin"].roster
+                            )
                         probe_wired = True
                 with tracer.span("tick"), host_busy(), host_span():
                     engine.mark_tick()  # freshness floor for the render
@@ -1048,6 +1156,12 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                         m.inc("records", n_rec)
                         with tracer.span("scatter"):
                             engine.step()
+                    if (probe_out is not None
+                            and probe_out.get("fanin") is not None):
+                        _evict_dead_namespaces(
+                            probe_out["fanin"], engine, m, pipe,
+                            recorder,
+                        )
                     ticks += 1
                     m.inc("ticks")
                     # every tick, not just render ticks: a /metrics
@@ -1136,6 +1250,44 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
         # collector) BEFORE the obs server goes down, so /healthz can
         # never observe a half-stopped source
         source.close()
+
+
+def _evict_dead_namespaces(tier, engine, m, pipe, recorder) -> None:
+    """Evict namespaces whose source-death quarantine expired (fan-in
+    tier, ingest/fanin.py). Deferred while a pipelined render is in
+    flight — a released slot's metadata must outlive its render, the
+    same ordering idle eviction enforces — and the tier re-offers the
+    pending sids next tick, so 'defer' never becomes 'never' while
+    ticks keep flowing."""
+    if pipe is not None and not pipe.idle():
+        return
+    for sid in tier.take_evictions():
+        if engine.native:
+            # single-source fan-in keeps the C++ engine, whose index
+            # has no per-slot source map — the dead source's flows are
+            # reclaimed by the ordinary idle timeout instead of a
+            # surgical namespace clear (its queued backlog was already
+            # purged by take_evictions, so nothing re-creates them)
+            m.inc("source_evictions_skipped")
+            print(
+                f"WARNING: telemetry source {sid} dead past quarantine "
+                f"— native index has no source map; its flows will be "
+                f"reclaimed by the idle timeout",
+                file=sys.stderr,
+            )
+            continue
+        n = engine.evict_source(sid)
+        m.inc("evicted", n)
+        m.inc("source_evictions")
+        if recorder is not None:
+            recorder.record(
+                "fanin.namespace_evicted", source=sid, flows=n,
+            )
+        print(
+            f"WARNING: telemetry source {sid} dead past quarantine — "
+            f"evicted {n} flows from its namespace",
+            file=sys.stderr,
+        )
 
 
 def _dispatch_render(args, engine, model, predict, serve_params, m,
@@ -1379,7 +1531,26 @@ def _run_train(args) -> None:
     if not args.traffic_type:
         sys.exit("ERROR: specify traffic type.")  # reference :225
     out_path = args.out or f"{args.traffic_type}_training_data.csv"
-    engine = FlowStateEngine(args.capacity, native=_use_native(args))
+    fanin_n = len(args.source_spec) if args.source_spec else args.sources
+    if _fanin_active(args) and fanin_n > 1 and args.native_ingest == "on":
+        sys.exit(
+            "multi-source fan-in routes through the Python batcher "
+            "(the C++ index has no per-slot source map) — drop "
+            "--native-ingest on or collect from one source"
+        )
+    use_native = _use_native(args)
+    if _fanin_active(args) and fanin_n > 1 and use_native:
+        # same rule as the classify path: the C++ keyer round-trips
+        # records through the wire format, which has no source field —
+        # N sources' identical flow tuples would collapse into ONE slot
+        # and interleave their cumulative counters into garbage deltas
+        use_native = False
+        print(
+            "fan-in: multi-source collection uses the Python batcher "
+            "(per-slot source namespacing)",
+            file=sys.stderr,
+        )
+    engine = FlowStateEngine(args.capacity, native=use_native)
     deadline = time.time() + args.duration
     ticks = 0
     with open(out_path, "w") as f:

@@ -43,23 +43,36 @@ class SlotAssignment:
 
 @dataclass
 class FlowIndex:
-    """key → slot map with direction folding (reference :157-165)."""
+    """key → slot map with direction folding (reference :157-165).
+
+    Keys are namespaced by the record's telemetry source
+    (``protocol.stable_flow_key(source=)``): the fan-in tier stamps
+    each record with its source id, so N sources reporting identical
+    flow tuples occupy N disjoint slot populations. ``slot_source``
+    remembers each slot's namespace — the reverse map behind
+    namespace-scoped eviction (a dead source's quarantine clears its
+    own slots and no one else's). Slots that predate source tracking
+    (restored checkpoints) read as source 0, the default namespace.
+    """
 
     capacity: int
     key_to_slot: dict = field(default_factory=dict)
     slot_to_key: dict = field(default_factory=dict)
     slot_meta: dict = field(default_factory=dict)  # slot → (src, dst) for UI
+    slot_source: dict = field(default_factory=dict)  # slot → source id
     free: list = field(default_factory=list)
     next_slot: int = 0
 
     def assign(self, r: TelemetryRecord) -> SlotAssignment | None:
         """Route one record; None when the table is full (the record is
         dropped, counted by the caller)."""
-        key = stable_flow_key(r.datapath, r.eth_src, r.eth_dst)
+        key = stable_flow_key(r.datapath, r.eth_src, r.eth_dst, r.source)
         slot = self.key_to_slot.get(key)
         if slot is not None:
             return SlotAssignment(slot, True, False)
-        rev_key = stable_flow_key(r.datapath, r.eth_dst, r.eth_src)
+        rev_key = stable_flow_key(
+            r.datapath, r.eth_dst, r.eth_src, r.source
+        )
         slot = self.key_to_slot.get(rev_key)
         if slot is not None:
             return SlotAssignment(slot, False, False)
@@ -73,13 +86,32 @@ class FlowIndex:
         self.key_to_slot[key] = slot
         self.slot_to_key[slot] = key
         self.slot_meta[slot] = (r.eth_src, r.eth_dst)
+        if r.source:
+            # sparse by design: the default namespace stays implicit so
+            # single-source serves pay nothing (and restored indexes,
+            # which predate source tracking, need no migration)
+            self.slot_source[slot] = r.source
         return SlotAssignment(slot, True, True)
+
+    def slots_for_source(self, source: int) -> list[int]:
+        """Every live slot in ``source``'s namespace — the eviction set
+        when that source's quarantine expires. O(tracked flows), but
+        only walked on a source-death event, never per tick. Source 0
+        (the default namespace) is the complement of the tagged slots."""
+        if source:
+            return [
+                s for s, sid in self.slot_source.items() if sid == source
+            ]
+        return [
+            s for s in self.slot_to_key if s not in self.slot_source
+        ]
 
     def release_slot(self, slot: int) -> None:
         key = self.slot_to_key.pop(slot, None)
         if key is not None:
             self.key_to_slot.pop(key, None)
             self.slot_meta.pop(slot, None)
+            self.slot_source.pop(slot, None)
             self.free.append(slot)
 
     def release_slots(self, slots) -> None:
@@ -454,6 +486,13 @@ class FlowStateEngine(HostSpine):
             count=self.table.capacity + 1,
         ).astype(bool)[:-1]
         slots = np.nonzero(stale)[0]
+        return self._clear_and_release(slots)
+
+    def _clear_and_release(self, slots: "np.ndarray") -> int:
+        """Clear + release an explicit slot batch — the shared device
+        half of idle eviction and namespace eviction (bucketed clears,
+        dirty-bit invalidation when the label cache is live, one bulk
+        index release)."""
         step = self.batcher.buckets[-1]
         capacity = self.table.capacity
         for i in range(0, slots.size, step):
@@ -473,3 +512,27 @@ class FlowStateEngine(HostSpine):
         # eviction batch instead of once per slot
         (self.batcher if self.native else self.index).release_slots(slots)
         return int(slots.size)
+
+    def evict_source(self, source: int) -> int:
+        """Evict every flow in one telemetry source's namespace — the
+        blast-radius boundary of the fan-in tier (ingest/fanin.py): a
+        source whose quarantine expired loses exactly its own slots
+        while every other namespace keeps serving untouched. Returns
+        the number of evicted flows.
+
+        Python-batcher only: the C++ index has no per-slot source map
+        (the CLI routes multi-source fan-in through the Python batcher
+        for exactly this reason)."""
+        if self.native:
+            raise RuntimeError(
+                "namespace eviction needs the Python batcher's "
+                "per-slot source map (fan-in disables --native-ingest)"
+            )
+        # flush first: a pending row for an about-to-clear slot would
+        # scatter stale counters into a freed (reassignable) row — the
+        # same ordering evict_idle enforces
+        self.step()
+        slots = np.asarray(
+            sorted(self.index.slots_for_source(source)), np.int64
+        )
+        return self._clear_and_release(slots)

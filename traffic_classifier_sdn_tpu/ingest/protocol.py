@@ -26,7 +26,15 @@ _I64_MAX = (1 << 63) - 1
 
 @dataclass(frozen=True)
 class TelemetryRecord:
-    """One parsed flow-stats line."""
+    """One parsed flow-stats line.
+
+    ``source`` is NOT on the wire: it is the fan-in tier's namespace tag
+    (ingest/fanin.py) stamped after parsing, folding the originating
+    telemetry source into the flow key so two switches reporting the
+    same (datapath, src, dst) tuple land in disjoint flow-table
+    namespaces. Source 0 is the legacy/default namespace — a record
+    that never crossed the fan-in tier keys exactly as before.
+    """
 
     time: int
     datapath: str
@@ -36,6 +44,7 @@ class TelemetryRecord:
     out_port: str
     packets: int
     bytes: int
+    source: int = 0
 
 
 def format_line(r: TelemetryRecord) -> bytes:
@@ -85,9 +94,19 @@ def parse_line(line: bytes) -> TelemetryRecord | None:
     return r
 
 
-def stable_flow_key(datapath: str, eth_src: str, eth_dst: str) -> int:
+def stable_flow_key(datapath: str, eth_src: str, eth_dst: str,
+                    source: int = 0) -> int:
     """Stable 64-bit key over (datapath, src, dst) — replaces the
-    reference's process-randomized ``hash()`` (traffic_classifier.py:157)."""
+    reference's process-randomized ``hash()`` (traffic_classifier.py:157).
+
+    ``source`` namespaces the key per telemetry source (fan-in ingest):
+    nonzero source ids are folded into the digest, so N sources
+    reporting the same flow tuple occupy N independent flow-table
+    slots and one source's eviction storm can never clear another's
+    rows. Source 0 produces the historical digest bit-for-bit —
+    serving checkpoints written before the fan-in tier restore into
+    the default namespace unchanged.
+    """
     h = hashlib.blake2b(digest_size=8)
     # \x00 separators prevent ambiguity between concatenated fields (the
     # reference's bare string concat would collide 'ab'+'c' with 'a'+'bc').
@@ -96,4 +115,9 @@ def stable_flow_key(datapath: str, eth_src: str, eth_dst: str) -> int:
     h.update(eth_src.encode())
     h.update(b"\x00")
     h.update(eth_dst.encode())
+    if source:
+        # appended (not prepended) and gated on nonzero: the source-0
+        # digest must stay byte-identical to the pre-fan-in key
+        h.update(b"\x00")
+        h.update(source.to_bytes(4, "little"))
     return int.from_bytes(h.digest(), "little")

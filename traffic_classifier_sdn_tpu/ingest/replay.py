@@ -54,12 +54,19 @@ class SyntheticFlows:
     (tools/bench_serve.py --churn-fraction). At the default 1.0 the
     emission order and RNG consumption are unchanged from the
     historical all-flows-every-tick behavior.
+
+    ``mac_base`` offsets the conversation index inside the 48-bit MAC
+    space: N fan-in sources with disjoint bases emit disjoint host
+    populations (ingest/fanin.py's multi-source load generator), so the
+    aggregate looks like N real switches, not N copies of one. The
+    default 0 reproduces the historical addresses exactly.
     """
 
     n_flows: int
     seed: int = 0
     start_time: int = 1
     churn: float = 1.0
+    mac_base: int = 0
 
     def __post_init__(self):
         rng = np.random.RandomState(self.seed)
@@ -75,7 +82,7 @@ class SyntheticFlows:
         self._rng = rng
 
     def _mac(self, i: int, side: int) -> str:
-        b = (i * 2 + side).to_bytes(6, "big")
+        b = ((self.mac_base + i) * 2 + side).to_bytes(6, "big")
         return ":".join(f"{x:02x}" for x in b)
 
     def _active(self) -> np.ndarray:

@@ -65,6 +65,12 @@ class SupervisedCollector:
         self._stopped = False  # explicit stop(): terminal, overrides all
         self._carryover: deque = deque()  # preserved across restarts
         self._dropped_prior = 0  # lines_dropped from dead incarnations
+        # why the supervision ended (None while live): "clean-exit" for
+        # a monitor that exited 0, "restart-budget" once the ladder is
+        # exhausted, "stopped" for an explicit stop(). The fan-in tier
+        # (ingest/fanin.py) reads this to tell a finished replay source
+        # from a crashed one — only the latter quarantines a namespace.
+        self.terminal_reason: str | None = None
 
     # -- lifecycle ---------------------------------------------------------
     def _spawn(self) -> SubprocessCollector:
@@ -84,6 +90,8 @@ class SupervisedCollector:
         ``wait_record`` would see a killed collector and restart it)."""
         self._done = True
         self._stopped = True
+        if self.terminal_reason is None:
+            self.terminal_reason = "stopped"
         if self._collector is not None:
             self._collector.stop()
 
@@ -106,6 +114,21 @@ class SupervisedCollector:
         if self._collector is not None and self._collector.running:
             return True
         return not self._done
+
+    @property
+    def phase(self) -> str:
+        """Coarse supervision phase for per-source state reporting
+        (fan-in roster, /healthz): ``running`` while the current monitor
+        incarnation is alive, ``backoff`` between a death and its
+        restart, ``done`` once supervision ended (clean exit, budget
+        exhaustion, or explicit stop — ``terminal_reason`` says which).
+        Reads only what the caller's own poll thread mutates, so it is
+        safe from the thread that drives wait_record/poll_records."""
+        if self._stopped or self._done:
+            return "done"
+        if self._collector is not None and self._collector.running:
+            return "running"
+        return "backoff"
 
     # -- supervision -------------------------------------------------------
     def _check(self) -> None:
@@ -138,6 +161,7 @@ class SupervisedCollector:
             self._collector = None
             if rc == 0:
                 self._done = True
+                self.terminal_reason = "clean-exit"
                 if self._recorder is not None:
                     self._recorder.record(
                         "monitor.clean_exit",
@@ -152,6 +176,7 @@ class SupervisedCollector:
                 )
             if self.restarts >= self.max_restarts:
                 self._done = True
+                self.terminal_reason = "restart-budget"
                 if self._recorder is not None:
                     self._recorder.record(
                         "supervisor.terminal",
@@ -201,6 +226,7 @@ class SupervisedCollector:
                 )
             if self.restarts >= self.max_restarts:
                 self._done = True
+                self.terminal_reason = "restart-budget"
                 if self._recorder is not None:
                     self._recorder.record(
                         "supervisor.terminal",

@@ -125,6 +125,7 @@ class HealthState:
         self._degrade = None
         self._drift = None
         self._label_cache = None
+        self._sources = None
 
     def model_loaded(self) -> None:
         """The serve registered its boot model — the ``model_age_s``
@@ -174,6 +175,18 @@ class HealthState:
         with self._lock:
             self._probe = probe
 
+    def set_source_roster(self, roster_fn) -> None:
+        """``roster_fn() -> list[dict]`` (ingest/fanin.FanInIngest
+        .roster): the fan-in tier's per-source status — id, state
+        (HEALTHY/RESTARTING/DEAD), lag since last delivery, drop and
+        record counters, pending quarantine — folded into /healthz as a
+        ``sources`` array. The single-boolean ``collector_alive`` keeps
+        reporting alongside it (the fan-in tier feeds it via the
+        collector probe), so pre-fan-in alerting rules survive the
+        multi-source upgrade unchanged."""
+        with self._lock:
+            self._sources = roster_fn
+
     def tick(self) -> None:
         with self._lock:
             self._last_tick_at = self._clock()
@@ -194,6 +207,7 @@ class HealthState:
             degrade = self._degrade
             drift = self._drift
             label_cache = self._label_cache
+            sources = self._sources
             model_loaded = self._model_loaded_at
             model_promoted = self._model_promoted_at
             started = self._started_at
@@ -276,6 +290,12 @@ class HealthState:
                 report["label_cache"] = {
                     "mode": "unknown", "error": str(e),
                 }
+        if sources is not None:
+            try:
+                report["sources"] = sources()
+            except Exception as e:  # noqa: BLE001 — health must not crash
+                report["sources"] = [{"state": "unknown",
+                                      "error": str(e)}]
         return healthy, report
 
 

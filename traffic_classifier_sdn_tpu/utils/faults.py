@@ -69,6 +69,20 @@ SITES: dict[str, str] = {
         "failure); consumes one restart-budget slot and re-enters "
         "backoff"
     ),
+    "ingest.fanin_put": (
+        "ingest/fanin.FanInQueue.put — the MPSC enqueue from a source "
+        "pump fails (a fire == a queue-full drop burst); ABSORBED: the "
+        "batch is dropped and counted against ITS source only — the "
+        "producer is never blocked, the serve loop never sees the "
+        "failure, and every other source's telemetry flows untouched"
+    ),
+    "ingest.source_dead": (
+        "ingest/fanin.SourceWorker pump — one telemetry source dies "
+        "mid-stream; ABSORBED by the fan-in tier: the source goes DEAD "
+        "(unclean), its namespace quarantines and after the quarantine "
+        "window exactly its own slots are evicted, while every other "
+        "source keeps serving fresh labels every tick"
+    ),
     "native.load": (
         "native/engine.available() — the C++ engine is unavailable "
         "(build/dlopen failure)"
