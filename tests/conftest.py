@@ -44,7 +44,8 @@ os.environ.setdefault(
 # Python side). TCSDN_LOCKTRACE=1 (tools/chaos_matrix.sh sets it)
 # widens the witness to every test module.
 LOCKTRACE_SUITES = {
-    "test_chaos", "test_degrade", "test_drift", "test_pipeline",
+    "test_chaos", "test_degrade", "test_drift", "test_latency",
+    "test_pipeline",
 }
 
 

@@ -1326,3 +1326,174 @@ def test_fanin_source_dead_probabilistic_survival_any_seed():
         if state != "DEAD" and eng.num_flows():
             # a live source's namespace was never collateral damage
             assert len(eng.index.slots_for_source(sid)) in (0, 3)
+
+
+# ---------------------------------------------------------------- obs.stamp
+
+
+def test_obs_stamp_fault_degrades_batch_to_unstamped_never_dropped():
+    """obs.stamp fires at the emit-stamping seam: the affected batch is
+    delivered UNSTAMPED (the latency plane skips it; counted in
+    latency_unstamped_batches) and telemetry is never dropped — a
+    broken observability plane must not cost a single record."""
+    from traffic_classifier_sdn_tpu.ingest import fanin
+    from traffic_classifier_sdn_tpu.obs.latency import LatencyProvenance
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    specs = [
+        fanin.SourceSpec(kind="synthetic", sid=i, n_flows=3, seed=i,
+                         mac_base=i * 3, lockstep=True)
+        for i in range(2)
+    ]
+    tier = fanin.FanInIngest(specs, quarantine_s=5.0, stamp=True)
+    eng = FlowStateEngine(64)
+    m = Metrics()
+    lat = LatencyProvenance(metrics=m)
+    gen = tier.ticks(tick_timeout=5.0)
+    # hit 1 clean, hits 2-3 fire: one whole serve tick (both sources'
+    # batches) degrades to unstamped
+    plan = faults.FaultPlan(
+        [faults.FaultRule("obs.stamp", after=1, times=2)], SEED
+    )
+    records = 0
+    try:
+        with faults.installed(plan):
+            for _ in range(3):
+                batch = next(gen, None)
+                assert batch is not None
+                lat.begin_tick(tier.pop_provenance())
+                eng.mark_tick()
+                records += eng.ingest(batch)
+                lat.mark_parse()
+                eng.step()
+                lat.mark_scatter()
+                s = lat.seal()
+                lat.mark_device(s)
+                lat.render_visible(s)
+    finally:
+        gen.close()
+    assert plan.fires == [("obs.stamp", 2), ("obs.stamp", 3)]
+    # every record arrived: 2 sources x 3 ticks x 3 conversations x 2
+    assert records == 2 * 3 * 3 * 2
+    # both directions fold into one slot: 2 sources x 3 conversations
+    assert eng.num_flows() == 6
+    assert tier.queue.drops() == {}
+    # the two unstamped batches were counted and excluded from e2e
+    assert m.counters["latency_unstamped_batches"] == 2
+    assert m.histograms["e2e_emit_to_render_s"].count == 4
+
+
+def test_obs_stamp_probabilistic_accounting_any_seed():
+    """Probability-scheduled stamp failures (any TCSDN_CHAOS_SEED):
+    whatever subset fires, every batch is accounted exactly once —
+    folded-stamped + counted-unstamped == batches delivered — and no
+    record is ever lost to the observability plane."""
+    from traffic_classifier_sdn_tpu.ingest import fanin
+    from traffic_classifier_sdn_tpu.obs.latency import LatencyProvenance
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    specs = [
+        fanin.SourceSpec(kind="synthetic", sid=i, n_flows=2, seed=i,
+                         mac_base=i * 2, lockstep=True)
+        for i in range(3)
+    ]
+    tier = fanin.FanInIngest(specs, quarantine_s=5.0, stamp=True)
+    eng = FlowStateEngine(64)
+    m = Metrics()
+    lat = LatencyProvenance(metrics=m)
+    gen = tier.ticks(tick_timeout=5.0)
+    batches = 0
+    records = 0
+    try:
+        with faults.installed(faults.FaultPlan(
+            [faults.FaultRule("obs.stamp", times=None, p=0.4)], SEED
+        )):
+            for _ in range(5):
+                batch = next(gen, None)
+                assert batch is not None
+                entries = tier.pop_provenance()
+                batches += len(entries)
+                lat.begin_tick(entries)
+                eng.mark_tick()
+                records += eng.ingest(batch)
+                lat.mark_parse()
+                eng.step()
+                lat.mark_scatter()
+                s = lat.seal()
+                lat.mark_device(s)
+                lat.render_visible(s)
+    finally:
+        gen.close()
+    assert records == 3 * 5 * 2 * 2  # nothing dropped, any seed
+    folded = m.histograms.get("e2e_emit_to_render_s")
+    folded_n = folded.count if folded is not None else 0
+    unstamped = int(m.counters.get("latency_unstamped_batches", 0))
+    assert folded_n + unstamped == batches == 15
+
+
+# ------------------------------------------------------------------ SIGUSR1
+
+
+def test_sigusr1_dumps_flight_recorder_and_metrics_without_exiting(
+    tmp_path, capsys
+):
+    """SIGUSR1 mid-serve triggers a live flight-recorder + metrics
+    snapshot dump into --obs-dir and the serve KEEPS RUNNING to its
+    normal end (flag + deferred dump: the handler never touches the
+    ring lock). The dump carries the signal.sigusr1 marker event."""
+    import json as _json
+    import signal
+    import threading
+
+    from traffic_classifier_sdn_tpu import cli
+    from traffic_classifier_sdn_tpu.io.checkpoint import save_model
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (4, 12)),
+        "var": rng.gamma(2.0, 50.0, (4, 12)) + 1.0,
+        "class_prior": np.full(4, 0.25),
+    })
+    ck = str(tmp_path / "gnb")
+    save_model(ck, "gnb", params, ["dns", "ping", "telnet", "voice"])
+    obs_dir = str(tmp_path / "dumps")
+
+    # paced fan-in source so the serve is still mid-run when the
+    # signal lands (raise_signal executes the handler on this thread
+    # at the next bytecode boundary of the main thread)
+    kicker = threading.Timer(
+        0.6, lambda: signal.raise_signal(signal.SIGUSR1)
+    )
+    kicker.start()
+    try:
+        cli.main([
+            "gaussiannb", "--source", "synthetic", "--sources", "1",
+            "--synthetic-flows", "16", "--source-interval", "0.05",
+            "--native-checkpoint", ck, "--capacity", "64",
+            "--print-every", "5", "--max-ticks", "60",
+            "--obs-dir", obs_dir,
+        ])
+    finally:
+        kicker.cancel()
+    capsys.readouterr()
+    flights = [f for f in os.listdir(obs_dir)
+               if f.endswith(".jsonl") and "sigusr1" in f]
+    snaps = [f for f in os.listdir(obs_dir)
+             if f.startswith("metrics-") and "sigusr1" in f]
+    assert len(flights) == 1, os.listdir(obs_dir)
+    assert len(snaps) == 1, os.listdir(obs_dir)
+    lines = [_json.loads(line)
+             for line in open(os.path.join(obs_dir, flights[0]))]
+    assert lines[0]["kind"] == "meta" and lines[0]["reason"] == "sigusr1"
+    assert any(e["kind"] == "signal.sigusr1" for e in lines[1:])
+    # the snapshot froze MID-RUN state, and the serve kept going to
+    # its normal end afterwards (the live registry reached max-ticks)
+    snap = _json.loads(
+        open(os.path.join(obs_dir, snaps[0])).read()
+    )
+    assert snap["kind"] == "metrics" and snap["reason"] == "sigusr1"
+    from traffic_classifier_sdn_tpu.utils.metrics import global_metrics
+
+    assert 0 < snap["snapshot"]["ticks"] < 60
+    assert global_metrics.counters["ticks"] == 60

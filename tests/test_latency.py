@@ -1,0 +1,482 @@
+"""Latency-provenance plane (obs/latency.py + the stamping seams):
+exact waterfall math under a fake clock, render-visibility (seal)
+semantics incl. coalescing, the per-source series lifecycle across
+quarantine/eviction (purged backlog must never poison the freshness
+quantiles), SLO-breach edge events, the /healthz latency block, the
+ephemeral obs port, and the CLI byte-transparency pin — renders with
+provenance on vs off are identical, serial and pipelined.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.ingest.batcher import (
+    FlowStateEngine,
+    batch_emit_ts,
+)
+from traffic_classifier_sdn_tpu.ingest.protocol import (
+    TelemetryRecord,
+    stamp_records,
+)
+from traffic_classifier_sdn_tpu.obs import FlightRecorder, HealthState
+from traffic_classifier_sdn_tpu.obs.latency import LatencyProvenance
+from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+
+def _rec(t=1, src="aa", dst="bb"):
+    return TelemetryRecord(
+        time=t, datapath="1", in_port="1", eth_src=src, eth_dst=dst,
+        out_port="2", packets=1, bytes=10,
+    )
+
+
+# ---------------------------------------------------------------------------
+# stamping
+
+
+def test_stamp_records_is_write_once_and_off_wire():
+    from traffic_classifier_sdn_tpu.ingest.protocol import (
+        format_line,
+        parse_line,
+    )
+
+    r = _rec()
+    assert r.emit_ts is None
+    assert stamp_records([r], 5.0)
+    assert r.emit_ts == 5.0
+    stamp_records([r], 9.0)  # write-once: the earlier stamp wins
+    assert r.emit_ts == 5.0
+    # never on the wire: the line round-trips without the stamp, and a
+    # stamped record still equals its unstamped telemetry twin
+    assert parse_line(format_line(r)).emit_ts is None
+    assert r == _rec()
+
+
+def test_batch_emit_ts_reads_the_lead_record():
+    batch = [_rec(src=f"s{i}") for i in range(4)]
+    assert batch_emit_ts(batch) is None
+    stamp_records(batch[:1], 3.25)
+    assert batch_emit_ts(batch) == 3.25
+    assert batch_emit_ts(b"raw bytes") is None
+    assert batch_emit_ts([]) is None
+
+
+def test_latency_module_is_host_only():
+    """The stamping/fold path must add ZERO traced ops — the whole
+    plane is host-side clock reads on plain Python objects, so the
+    module may not touch jax at all (the structural pin behind the
+    warmup contract in serving/warmup.py)."""
+    import traffic_classifier_sdn_tpu.obs.latency as mod
+
+    src = open(mod.__file__, encoding="utf-8").read()
+    assert "import jax" not in src and "from jax" not in src
+
+
+# ---------------------------------------------------------------------------
+# waterfall math (fake clock)
+
+
+def test_waterfall_fold_is_exact_under_fake_clock():
+    clk = [100.0]
+    m = Metrics()
+    lat = LatencyProvenance(metrics=m, clock=lambda: clk[0])
+    # batch emitted at t=100, enqueued 100.5, dequeued 101
+    lat.begin_tick([(3, 100.0, 100.5, 101.0, 8)])
+    clk[0] = 101.25
+    lat.mark_parse()
+    clk[0] = 101.75
+    lat.mark_scatter()
+    seal = lat.seal()
+    clk[0] = 102.5
+    lat.mark_device(seal)
+    clk[0] = 103.0
+    lat.render_visible(seal)
+    snap = m.snapshot()
+    assert snap["e2e_emit_to_render_s_p50"] == 3.0
+    assert snap["source_3_e2e_s_p50"] == 3.0
+    assert snap["queue_wait_s_p50"] == 0.5       # deq - enq
+    assert snap["batch_wait_s_p50"] == 0.75      # scatter - deq
+    # the cumulative waterfall since emit
+    assert snap["wf_queue_s_p50"] == 1.0
+    assert snap["wf_parse_s_p50"] == 1.25
+    assert snap["wf_scatter_s_p50"] == 1.75
+    assert snap["wf_device_s_p50"] == 2.5
+    assert snap["wf_render_s_p50"] == 3.0
+    # status: e2e + the dominant stage (queue, 1.0 s increment)
+    st = lat.status()
+    assert st["observed"] and st["e2e_p50_s"] == 3.0
+    assert st["dominant_stage"] == "queue"
+
+
+def test_unstamped_batches_flow_but_never_fold():
+    m = Metrics()
+    lat = LatencyProvenance(metrics=m, clock=lambda: 1.0)
+    lat.begin_tick([(0, None, None, None, 4)])
+    lat.mark_parse()
+    lat.mark_scatter()
+    s = lat.seal()
+    lat.mark_device(s)
+    lat.render_visible(s)
+    assert m.counters["latency_unstamped_batches"] == 1
+    assert "e2e_emit_to_render_s" not in m.histograms
+    assert lat.status() == {"observed": False}
+
+
+def test_coalesced_render_folds_at_the_printing_render():
+    """Two ticks scattered, two seals taken (two dispatched renders),
+    but only the SECOND render prints (the first coalesced away): both
+    generations fold at the printing render — visibility semantics,
+    not dispatch semantics."""
+    clk = [0.0]
+    m = Metrics()
+    lat = LatencyProvenance(metrics=m, clock=lambda: clk[0])
+    lat.begin_tick([(0, 0.0, None, None, 1)])
+    lat.mark_parse()
+    lat.mark_scatter()
+    s1 = lat.seal()
+    clk[0] = 1.0
+    lat.begin_tick([(0, 1.0, None, None, 1)])
+    lat.mark_parse()
+    lat.mark_scatter()
+    s2 = lat.seal()
+    assert s2 > s1
+    clk[0] = 5.0
+    lat.mark_device(s2)
+    lat.render_visible(s2)  # folds BOTH generations
+    h = m.histograms["e2e_emit_to_render_s"]
+    assert h.count == 2
+    assert sorted(h._samples) == [4.0, 5.0]
+    # nothing left pending: a later render folds nothing extra
+    lat.render_visible(lat.seal())
+    assert h.count == 2
+
+
+def test_entries_scattered_after_seal_wait_for_their_own_render():
+    clk = [0.0]
+    m = Metrics()
+    lat = LatencyProvenance(metrics=m, clock=lambda: clk[0])
+    lat.begin_tick([(0, 0.0, None, None, 1)])
+    lat.mark_parse()
+    lat.mark_scatter()
+    s1 = lat.seal()
+    # pipelined host keeps ingesting while the render is in flight
+    lat.begin_tick([(0, 0.5, None, None, 1)])
+    lat.mark_parse()
+    lat.mark_scatter()
+    clk[0] = 2.0
+    lat.render_visible(s1)
+    assert m.histograms["e2e_emit_to_render_s"].count == 1
+    s2 = lat.seal()
+    clk[0] = 3.0
+    lat.render_visible(s2)
+    assert m.histograms["e2e_emit_to_render_s"].count == 2
+
+
+def test_direct_path_unstamped_records_count_bytes_degrade():
+    """The direct-source entry builder keeps the obs.stamp contract: a
+    RECORD batch arriving unstamped (absorbed stamp fire) is counted
+    and excluded — never fabricated from arrival time — while raw BYTE
+    batches use arrival-time provenance by design and fold normally."""
+    from traffic_classifier_sdn_tpu.cli import _begin_tick_provenance
+
+    m = Metrics()
+    lat = LatencyProvenance(metrics=m, clock=lambda: 5.0)
+    _begin_tick_provenance(lat, [_rec()], {})  # unstamped records
+    _begin_tick_provenance(lat, b"data\t...", {})  # raw bytes
+    lat.mark_parse()
+    lat.mark_scatter()
+    s = lat.seal()
+    lat.mark_device(s)
+    lat.render_visible(s)
+    assert m.counters["latency_unstamped_batches"] == 1
+    # only the byte batch folded (arrival-time emit == clock)
+    assert m.histograms["e2e_emit_to_render_s"].count == 1
+
+
+# ---------------------------------------------------------------------------
+# per-source lifecycle: quarantine → evict
+
+
+def test_drop_source_discards_pending_entries():
+    m = Metrics()
+    lat = LatencyProvenance(metrics=m, clock=lambda: 1.0)
+    lat.begin_tick([(1, 0.5, None, None, 4), (2, 0.5, None, None, 4)])
+    lat.mark_parse()
+    lat.mark_scatter()
+    assert lat.drop_source(1) == 1
+    s = lat.seal()
+    lat.render_visible(s)
+    assert "source_1_e2e_s" not in m.histograms
+    assert m.histograms["source_2_e2e_s"].count == 1
+    assert m.counters["latency_entries_discarded"] == 1
+
+
+def test_evicted_source_series_stops_and_purged_backlog_is_excluded():
+    """The tier-level lifecycle pin: kill one of two sources with
+    batches still QUEUED; after quarantine expiry the backlog is
+    purged (FanInQueue.purge) and the namespace evicted — the dead
+    source's e2e histogram must stop accumulating, and the purged
+    records must never appear in any provenance entry (dropped
+    telemetry must not poison the freshness quantiles)."""
+    from traffic_classifier_sdn_tpu.ingest import fanin
+
+    specs = [
+        fanin.SourceSpec(kind="synthetic", sid=i, n_flows=3, seed=i,
+                         mac_base=i * 3, lockstep=True)
+        for i in range(2)
+    ]
+    tier = fanin.FanInIngest(specs, quarantine_s=0.05, stamp=True)
+    eng = FlowStateEngine(64)
+    m = Metrics()
+    lat = LatencyProvenance(metrics=m)
+    gen = tier.ticks(tick_timeout=5.0)
+
+    def drive_tick():
+        batch = next(gen, None)
+        if batch is None:
+            return False
+        lat.begin_tick(tier.pop_provenance())
+        eng.mark_tick()
+        eng.ingest(batch)
+        lat.mark_parse()
+        eng.step()
+        lat.mark_scatter()
+        for sid in tier.take_evictions():
+            eng.evict_source(sid)
+            lat.drop_source(sid)
+        s = lat.seal()
+        lat.mark_device(s)
+        lat.render_visible(s)
+        return True
+
+    try:
+        for _ in range(2):
+            assert drive_tick()
+        assert m.histograms["source_0_e2e_s"].count == 2
+        assert m.histograms["source_1_e2e_s"].count == 2
+        # kill source 1, then let its pump leave a QUEUED backlog the
+        # serve never consumed before the quarantine expires
+        tier.kill_source(1)
+        deadline = time.monotonic() + 30.0
+        count_before = None
+        while time.monotonic() < deadline:
+            drive_tick()
+            roster = {r["id"]: r["state"] for r in tier.roster()}
+            if roster.get(1) == "DEAD" and not eng.index.slots_for_source(1):
+                if tier.queue.drops().get(1, 0) >= 0:
+                    count_before = m.histograms["source_1_e2e_s"].count
+                    break
+        assert count_before is not None, "source 1 never evicted"
+        # drive on: source 0 keeps folding, source 1 stays frozen
+        h0_before = m.histograms["source_0_e2e_s"].count
+        for _ in range(3):
+            drive_tick()
+        assert m.histograms["source_1_e2e_s"].count == count_before
+        assert m.histograms["source_0_e2e_s"].count > h0_before
+    finally:
+        gen.close()
+
+
+def test_purged_batches_produce_no_provenance_entries():
+    """Unit-level pin for the exclusion: a batch purged from the queue
+    (dead source's backlog) must not surface via pop_provenance — only
+    TAKEN batches carry entries into the e2e fold."""
+    from traffic_classifier_sdn_tpu.ingest import fanin
+
+    q = fanin.FanInQueue(max_records=1 << 10, collect_provenance=True)
+    r0, r1 = [_rec(src="aa")], [_rec(src="bb")]
+    stamp_records(r0, 1.0)
+    stamp_records(r1, 2.0)
+    assert q.put(0, r0)
+    assert q.put(1, r1)
+    assert q.purge(1) == 1
+    taken = q.take()
+    assert [sid for sid, _ in taken] == [0]
+    entries = q.pop_provenance()
+    assert [e[0] for e in entries] == [0]
+    assert entries[0][1] == 1.0  # emit of the surviving batch
+    assert q.pop_provenance() == []  # drained
+
+
+# ---------------------------------------------------------------------------
+# SLO breach
+
+
+def test_slo_breach_is_an_edge_event_with_dominant_stage():
+    clk = [0.0]
+    m = Metrics()
+    rec = FlightRecorder()
+    lat = LatencyProvenance(metrics=m, recorder=rec,
+                            clock=lambda: clk[0], slo_s=1.0)
+
+    def tick(emit, render):
+        clk[0] = emit
+        lat.begin_tick([(0, emit, None, None, 1)])
+        lat.mark_parse()
+        lat.mark_scatter()
+        s = lat.seal()
+        clk[0] = render
+        lat.mark_device(s)
+        lat.render_visible(s)
+
+    tick(0.0, 0.5)  # healthy
+    assert m.gauges.get("latency_slo_breached", 0.0) == 0.0
+    for i in range(4):
+        tick(10.0 + i, 12.0 + i)  # 2 s e2e: p99 over the 1 s SLO
+    assert m.gauges["latency_slo_breached"] == 1.0
+    assert m.counters["latency_slo_breaches"] == 1
+    events = [e for e in rec.tail() if e["kind"] == "latency.slo_breach"]
+    assert len(events) == 1  # edge, not per-tick spam
+    assert events[0]["e2e_p99_s"] == 2.0
+    # the wait landed between scatter and the device sync (the fake
+    # clock jumps before mark_device), so device dominates the budget
+    assert events[0]["dominant_stage"] == "device"
+    assert lat.status()["slo_breached"] is True
+
+
+# ---------------------------------------------------------------------------
+# /healthz latency block + ephemeral obs port
+
+
+def test_healthz_carries_latency_block_and_obs_port():
+    h = HealthState(clock=lambda: 0.0)
+    m = Metrics()
+    lat = LatencyProvenance(metrics=m, clock=lambda: 0.0)
+    h.set_latency(lat.status)
+    h.set_obs_port(43210)
+    _, report = h.check()
+    assert report["latency"] == {"observed": False}
+    assert report["obs_port"] == 43210
+    # a crashing status fn degrades, never 500s health
+    h.set_latency(lambda: 1 / 0)
+    _, report = h.check()
+    assert report["latency"]["observed"] is False
+    assert "error" in report["latency"]
+
+
+# ---------------------------------------------------------------------------
+# CLI integration: byte transparency + the live plane end-to-end
+
+
+@pytest.fixture(scope="module")
+def capture_file(tmp_path_factory):
+    from traffic_classifier_sdn_tpu.ingest.protocol import format_line
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+
+    path = tmp_path_factory.mktemp("lat_cap") / "capture.tsv"
+    syn = SyntheticFlows(n_flows=12, seed=11)
+    with open(path, "wb") as f:
+        for _ in range(12):
+            for r in syn.tick():
+                f.write(format_line(r))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def gnb_checkpoint(tmp_path_factory):
+    from traffic_classifier_sdn_tpu.io.checkpoint import save_model
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (4, 12)),
+        "var": rng.gamma(2.0, 50.0, (4, 12)) + 1.0,
+        "class_prior": np.full(4, 0.25),
+    })
+    path = str(tmp_path_factory.mktemp("lat_model") / "gnb")
+    save_model(path, "gnb", params, ["dns", "ping", "telnet", "voice"])
+    return path
+
+
+def _serve_stdout(capsys, capture_file, gnb_checkpoint, *extra):
+    from traffic_classifier_sdn_tpu import cli
+
+    capsys.readouterr()
+    cli.main([
+        "gaussiannb", "--source", "replay", "--capture", capture_file,
+        "--native-checkpoint", gnb_checkpoint, "--capacity", "64",
+        "--print-every", "3", "--max-ticks", "12", *extra,
+    ])
+    return capsys.readouterr().out
+
+
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+def test_render_byte_identical_provenance_on_vs_off(
+    capsys, capture_file, gnb_checkpoint, pipeline
+):
+    """The byte-transparency acceptance pin: stamps must never leak
+    into output — serial and pipelined renders are identical with the
+    plane armed vs --latency-provenance off."""
+    on = _serve_stdout(capsys, capture_file, gnb_checkpoint,
+                       "--pipeline", pipeline,
+                       "--latency-provenance", "auto")
+    off = _serve_stdout(capsys, capture_file, gnb_checkpoint,
+                        "--pipeline", pipeline,
+                        "--latency-provenance", "off")
+    assert on == off
+    assert on.count("+") > 0  # sanity: tables actually rendered
+
+
+def test_cli_live_plane_end_to_end_with_ephemeral_port(
+    capsys, capture_file, gnb_checkpoint
+):
+    """Fan-in serve with --obs-port 0: the plane binds an ephemeral
+    port (reported via the obs_port gauge and the /healthz
+    self-reference), /metrics carries the waterfall and per-source e2e
+    series, and /healthz carries the latency block."""
+    from traffic_classifier_sdn_tpu import cli
+    from traffic_classifier_sdn_tpu.utils.metrics import global_metrics
+
+    got: dict = {}
+
+    def probe():
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            port = int(global_metrics.gauges.get("obs_port", 0))
+            if not port:
+                time.sleep(0.02)
+                continue
+            base = f"http://127.0.0.1:{port}"
+            try:
+                text = urllib.request.urlopen(
+                    base + "/metrics", timeout=2).read().decode()
+                if "tcsdn_e2e_emit_to_render_s" not in text:
+                    time.sleep(0.02)
+                    continue
+                got["metrics"] = text
+                got["healthz"] = json.loads(urllib.request.urlopen(
+                    base + "/healthz", timeout=2).read())
+                got["port"] = port
+                return
+            except OSError:
+                time.sleep(0.02)
+
+    t = threading.Thread(target=probe)
+    t.start()
+    cli.main([
+        "gaussiannb", "--source", "synthetic", "--sources", "2",
+        "--synthetic-flows", "32", "--source-lockstep",
+        "--native-checkpoint", gnb_checkpoint, "--capacity", "128",
+        "--print-every", "2", "--max-ticks", "30",
+        "--obs-port", "0",
+    ])
+    t.join(timeout=30)
+    capsys.readouterr()
+    metrics_text = got.get("metrics", "")
+    assert "tcsdn_e2e_emit_to_render_s" in metrics_text
+    for series in ("wf_queue_s", "wf_render_s", "queue_wait_s",
+                   "source_0_e2e_s", "source_1_e2e_s"):
+        assert f"tcsdn_{series}" in metrics_text, series
+    hz = got["healthz"]
+    assert hz["obs_port"] == got["port"]
+    assert hz["latency"]["observed"] is True
+    assert hz["latency"]["e2e_p50_s"] > 0
+    assert hz["latency"]["dominant_stage"] in (
+        "queue", "parse", "scatter", "device", "render"
+    )

@@ -7,7 +7,6 @@ firing must leave a valid JSONL post-mortem naming the failing span.
 
 import json
 import os
-import socket
 import sys
 import threading
 import time
@@ -357,12 +356,15 @@ def test_collector_read_fault_leaves_terminal_post_mortem(tmp_path):
 # CLI integration: the acceptance scenario
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _obs_port_gauge() -> int:
+    """The serve publishes its ACTUAL bound port in the obs_port gauge
+    (--obs-port 0 binds ephemerally — parallel test runs never race a
+    pre-picked free port). Callers must RE-READ this every retry: a
+    prior in-process run's gauge survives until cli.main's registry
+    reset, so a latched first read can be a dead port."""
+    from traffic_classifier_sdn_tpu.utils.metrics import global_metrics
+
+    return int(global_metrics.gauges.get("obs_port", 0))
 
 
 @pytest.fixture(scope="module")
@@ -405,14 +407,19 @@ def test_cli_serve_exposes_obs_plane_during_replay(
     /events while a replay-driven run is live."""
     from traffic_classifier_sdn_tpu import cli
 
-    port = _free_port()
     obs_dir = str(tmp_path / "dumps")
     got: dict = {}
 
     def probe():
-        base = f"http://127.0.0.1:{port}"
         deadline = time.time() + 60
         while time.time() < deadline:
+            # re-read every attempt: before cli.main resets the global
+            # registry this can briefly be a PRIOR run's dead port
+            port = _obs_port_gauge()
+            if not port:
+                time.sleep(0.02)
+                continue
+            base = f"http://127.0.0.1:{port}"
             try:
                 text = urllib.request.urlopen(
                     base + "/metrics", timeout=2).read().decode()
@@ -421,6 +428,7 @@ def test_cli_serve_exposes_obs_plane_during_replay(
                     # scrape again until the stage series exist
                     time.sleep(0.02)
                     continue
+                got["port"] = port
                 got["metrics"] = text
                 got["healthz"] = json.loads(urllib.request.urlopen(
                     base + "/healthz", timeout=2).read())
@@ -441,7 +449,7 @@ def test_cli_serve_exposes_obs_plane_during_replay(
         "--print-every", "5",
         "--max-ticks", "24",
         "--metrics-every", "4",
-        "--obs-port", str(port),
+        "--obs-port", "0",  # ephemeral: parallel runs never collide
         "--obs-dir", obs_dir,
         "--obs-dump-on-exit",
     ])
@@ -449,6 +457,8 @@ def test_cli_serve_exposes_obs_plane_during_replay(
     capsys.readouterr()  # drain the rendered tables
     metrics_text = got.get("metrics", "")
     assert "# TYPE tcsdn_ticks counter" in metrics_text
+    # the /healthz self-reference names the actual ephemeral port
+    assert got["healthz"]["obs_port"] == got["port"]
     # the per-stage latency series the tentpole promises
     for stage in ("poll", "parse", "scatter", "tick"):
         assert f"# TYPE tcsdn_stage_{stage}_s summary" in metrics_text

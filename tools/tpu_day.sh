@@ -63,6 +63,19 @@ if [ -f tools/bench_e2e.py ]; then
   fi
 fi
 
+# the live counterpart: the latency-provenance waterfall through the
+# REAL fan-in serve path (short kernels, ~1 min) — lands beside the
+# microbench budget so the chip window carries both views
+if [ -f tools/bench_e2e_live.py ]; then
+  run_step 1200 /tmp/tpu_day_e2e_live.log python tools/bench_e2e_live.py \
+    --platform default
+  if [ "$STEP_OK" = 1 ] && grep '^{' /tmp/tpu_day_e2e_live.log | tail -1 \
+      | grep -q '"platform": "tpu"'; then
+    grep '^{' /tmp/tpu_day_e2e_live.log | tail -1 \
+      > docs/artifacts/e2e_budget_live_tpu.json
+  fi
+fi
+
 # chip-day allowance: one warm process gets time for every race stage
 # (the driver's own end-of-round run keeps bench.py's 560 s default)
 TCSDN_BENCH_BUDGET=1500

@@ -253,11 +253,14 @@ def _build_parser() -> argparse.ArgumentParser:
         "poll ticks (0 disables)",
     )
     p.add_argument(
-        "--obs-port", type=int, default=0, metavar="PORT",
-        help="serve the observability plane on this port (0 disables): "
-        "/metrics (Prometheus text with per-stage stage_* latency "
-        "series), /healthz (collector alive, last-tick age, checkpoint "
-        "freshness), /events (flight-recorder tail)",
+        "--obs-port", type=int, default=None, metavar="PORT",
+        help="serve the observability plane on this port (omit to "
+        "disable; 0 binds an EPHEMERAL port — parallel runs never "
+        "collide — reported in the startup line, the obs_port gauge, "
+        "and the /healthz obs_port self-reference): /metrics "
+        "(Prometheus text with per-stage stage_* latency series), "
+        "/healthz (collector alive, last-tick age, checkpoint "
+        "freshness, latency budget), /events (flight-recorder tail)",
     )
     p.add_argument(
         "--obs-dir", default=None, metavar="DIR",
@@ -291,6 +294,29 @@ def _build_parser() -> argparse.ArgumentParser:
         "is older than this many seconds (0 disables; pair with "
         "--serve-checkpoint-every so silent checkpoint failure pages "
         "instead of rotting)",
+    )
+    p.add_argument(
+        "--latency-provenance", choices=("auto", "on", "off"),
+        default="auto",
+        help="record-level latency provenance (obs/latency.py): "
+        "emit-stamp every telemetry batch host-side at its pump-read "
+        "moment and fold per-hop boundaries (fan-in queue wait, parse, "
+        "scatter dispatch, device completion, render visibility) into "
+        "the e2e_emit_to_render_s / queue_wait_s / batch_wait_s / "
+        "wf_* waterfall histograms and the /healthz latency block. "
+        "Stamps never touch the wire format or the rendered output "
+        "(byte-identical on vs off) and add zero traced ops. 'auto' "
+        "enables it for single-device serves (the sharded read side "
+        "has no single render-visibility point yet); 'off' disables "
+        "stamping entirely",
+    )
+    p.add_argument(
+        "--latency-slo", type=float, default=0.0, metavar="SECS",
+        help="end-to-end latency SLO: when the running "
+        "e2e_emit_to_render_s p99 crosses this, the breach transition "
+        "is recorded to the flight recorder (latency.slo_breach, with "
+        "the dominant stage) and the latency_slo_breached gauge flips "
+        "(0 disables — the default)",
     )
     p.add_argument(
         "--incremental", choices=("auto", "off"), default="auto",
@@ -446,6 +472,18 @@ def _fanin_active(args) -> bool:
     )
 
 
+def _provenance_on(args, sharded: bool = False) -> bool:
+    """--latency-provenance resolution: 'auto' arms the latency plane
+    for single-device serves (the sharded read side has no single
+    render-visibility point to close an e2e measurement at)."""
+    mode = getattr(args, "latency_provenance", "off")
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return not sharded
+
+
 def _resolved_monitor_cmd(args) -> str:
     """The monitor command a subprocess source spawns (--monitor-cmd
     override, the built-in controller, or the reference's Ryu line)."""
@@ -459,7 +497,22 @@ def _resolved_monitor_cmd(args) -> str:
     return args.monitor_cmd or DEFAULT_MONITOR_CMD
 
 
-def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
+def _stamped_ticks(gen):
+    """Emit-stamp each pull-paced direct-source batch as it is
+    generated — the unpumped counterpart of the fan-in pump's
+    ``_deliver`` stamp (replay injection / synthetic generation), and
+    like it stamps only the batch's LEAD record: one generation moment
+    per batch. An absorbed ``obs.stamp`` fire leaves that batch
+    unstamped; the batch still flows."""
+    from .ingest.protocol import stamp_records
+
+    for batch in gen:
+        stamp_records(batch[:1])
+        yield batch
+
+
+def _tick_source(args, raw: bool = False, recorder=None, probe_out=None,
+                 stamp: bool = False):
     """Yield one batch of telemetry per poll tick: a list of
     TelemetryRecords, or raw pipe bytes when ``raw`` (the native-engine
     fast path — no per-line Python anywhere between the pipe and C++).
@@ -471,7 +524,13 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
     sources set nothing: there is no collector to be dead). With the
     fan-in tier (--sources/--source-spec) it also receives the
     ``"fanin"`` tier object: the serve loop polls it for expired
-    quarantines and /healthz reads its per-source roster."""
+    quarantines and /healthz reads its per-source roster.
+
+    ``stamp`` arms latency-provenance emit stamping (obs/latency.py):
+    fan-in pumps stamp at ``_deliver``, subprocess collectors at pipe
+    parse on the reader thread, pull-paced direct sources at
+    generation; raw byte sources cannot stamp (no records host-side)
+    and the serve loop degrades them to arrival-time provenance."""
     if _fanin_active(args):
         from .ingest import fanin
         from .utils.metrics import global_metrics
@@ -490,7 +549,7 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
             sys.exit(f"ERROR: {e}")
         tier = fanin.FanInIngest(
             specs, quarantine_s=args.source_quarantine,
-            metrics=global_metrics, recorder=recorder,
+            metrics=global_metrics, recorder=recorder, stamp=stamp,
         )
         if probe_out is not None:
             probe_out["probe"] = tier.alive
@@ -502,13 +561,18 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
             sys.exit("--source replay requires --capture FILE")
         from .ingest.replay import iter_capture
 
-        yield from iter_capture(args.capture)
+        gen = iter_capture(args.capture)
+        yield from (_stamped_ticks(gen) if stamp else gen)
     elif args.source == "synthetic":
         from .ingest.replay import SyntheticFlows
 
         syn = SyntheticFlows(n_flows=args.synthetic_flows)
-        while True:
-            yield syn.tick()
+
+        def _syn():
+            while True:
+                yield syn.tick()
+
+        yield from (_stamped_ticks(_syn()) if stamp else _syn())
     elif args.source == "workload":
         from .ingest.workload import ClassWorkload, class_delta_pools
 
@@ -517,8 +581,12 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
             pools,
             flows_per_class=max(1, args.synthetic_flows // len(pools)),
         )
-        while True:
-            yield wl.tick()
+
+        def _wl():
+            while True:
+                yield wl.tick()
+
+        yield from (_stamped_ticks(_wl()) if stamp else _wl())
     else:
         from .ingest.collector import SubprocessCollector
 
@@ -529,10 +597,11 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None):
 
             coll = SupervisedCollector(
                 cmd, raw=raw, max_restarts=args.monitor_restarts,
-                metrics=global_metrics, recorder=recorder,
+                metrics=global_metrics, recorder=recorder, stamp=stamp,
             )
         else:
-            coll = SubprocessCollector(cmd, raw=raw, recorder=recorder)
+            coll = SubprocessCollector(cmd, raw=raw, recorder=recorder,
+                                       stamp=stamp)
         if probe_out is not None:
             probe_out["probe"] = lambda: coll.running
         coll.start()
@@ -605,6 +674,12 @@ def _run_classify_armed(args, lock_witness) -> None:
         sys.exit("--serve-checkpoint-every needs --serve-checkpoint-dir")
     if args.obs_dump_on_exit and not args.obs_dir:
         sys.exit("--obs-dump-on-exit needs --obs-dir (the dump target)")
+    if args.latency_provenance == "on" and sharded:
+        sys.exit(
+            "--latency-provenance on is single-device: the sharded "
+            "read side has no single render-visibility point to close "
+            "an end-to-end measurement at (auto skips it)"
+        )
     if args.drift != "off" and not sharded and not args.drift_dir:
         sys.exit(
             "--drift auto needs --drift-dir (the candidate checkpoint "
@@ -634,13 +709,29 @@ def _run_classify_armed(args, lock_witness) -> None:
     # is ALWAYS on — per-tick spans cost microseconds and give
     # --metrics-every its stage_* latency attribution unconditionally
     recorder = (
-        FlightRecorder() if (args.obs_port or args.obs_dir) else None
+        FlightRecorder()
+        if (args.obs_port is not None or args.obs_dir) else None
     )
     if lock_witness is not None and recorder is not None:
         # live attachment: a violation lands in the ring the moment the
         # offending edge is observed, so post-mortem dumps carry it
         lock_witness.recorder = recorder
     tracer = Tracer(metrics=m, recorder=recorder)
+
+    # Latency provenance (obs/latency.py): the record-level end-to-end
+    # budget plane. Like the tracer it is always on (auto) for
+    # single-device serves — stamps are host-side only, add zero
+    # traced ops, and the fold costs microseconds per render tick; the
+    # rendered output is byte-identical on vs off (pinned in
+    # tests/test_latency.py) and the bench A/B bounds stamping under
+    # 3% of tick p50 (tools/bench_e2e_live.py).
+    lat = None
+    if _provenance_on(args, sharded):
+        from .obs import LatencyProvenance
+
+        lat = LatencyProvenance(
+            metrics=m, recorder=recorder, slo_s=args.latency_slo,
+        )
 
     use_native = _use_native(args)
     if _fanin_active(args) and fanin_n > 1 and use_native:
@@ -849,7 +940,7 @@ def _run_classify_armed(args, lock_witness) -> None:
     server = None
     health = None
     probe_out: dict = {}
-    if args.obs_port:
+    if args.obs_port is not None:
         from .obs import ExpositionServer, HealthState
 
         health = HealthState(
@@ -874,11 +965,20 @@ def _run_classify_armed(args, lock_witness) -> None:
             # label-cache coverage: how much of the table the last
             # render served from cache vs re-predicted
             health.set_label_cache(inc.status)
+        if lat is not None:
+            # the live e2e budget: p50/p99 since emit + dominant stage
+            health.set_latency(lat.status)
         server = ExpositionServer(
             m, recorder=recorder, health=health, port=args.obs_port,
             host=args.obs_host,
         )
         server.start()
+        # --obs-port 0 binds ephemerally: report the ACTUAL port on
+        # every self-describing surface — the startup line, the
+        # obs_port gauge (scrapable and readable in-process before any
+        # stderr parsing), and the /healthz self-reference
+        health.set_obs_port(server.port)
+        m.set("obs_port", server.port)
         print(
             f"observability plane on port {server.port} "
             f"(/metrics /healthz /events)",
@@ -896,6 +996,14 @@ def _run_classify_armed(args, lock_witness) -> None:
     prev_sigterm = None
     sigterm_hooked = False
     sigterm_seen = False
+    # SIGUSR1: live flight-recorder + metrics-snapshot dump WITHOUT
+    # exiting — the on-demand mid-incident snapshot. Same flag+deferred
+    # discipline as SIGTERM: the handler only flips a dict flag (it
+    # must never touch the non-reentrant ring lock from a signal
+    # frame); the serve loop performs the dump between ticks.
+    usr1 = {"due": False}
+    prev_sigusr1 = None
+    sigusr1_hooked = False
     if (recorder is not None and args.obs_dir
             and threading.current_thread() is threading.main_thread()):
         def _on_sigterm(signum, frame):
@@ -905,6 +1013,12 @@ def _run_classify_armed(args, lock_witness) -> None:
 
         prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
         sigterm_hooked = True
+        if hasattr(signal, "SIGUSR1"):
+            def _on_sigusr1(signum, frame):
+                usr1["due"] = True  # flag only — dump deferred to loop
+
+            prev_sigusr1 = signal.signal(signal.SIGUSR1, _on_sigusr1)
+            sigusr1_hooked = True
     obs_faults = (
         recorder.observing_faults() if recorder is not None
         else contextlib.nullcontext()
@@ -915,7 +1029,7 @@ def _run_classify_armed(args, lock_witness) -> None:
                         sharded, use_native, dropped_seen=0,
                         tracer=tracer, recorder=recorder, health=health,
                         probe_out=probe_out, degrade=degrade_surface,
-                        drift=drift, inc=inc)
+                        drift=drift, inc=inc, lat=lat, usr1=usr1)
     except BaseException as e:
         # the crash-forensics moment: record the terminal exception and
         # freeze the ring — safely outside any signal-handler frame.
@@ -961,6 +1075,8 @@ def _run_classify_armed(args, lock_witness) -> None:
             drift.close()
         if sigterm_hooked:
             signal.signal(signal.SIGTERM, prev_sigterm)
+        if sigusr1_hooked:
+            signal.signal(signal.SIGUSR1, prev_sigusr1)
         # the checkpoint must survive EVERY exit, including Ctrl-C on a
         # long-running serve — the state is consistent between ticks
         # (save() flushes pending rows first)
@@ -1066,7 +1182,7 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
 def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 use_native, dropped_seen, tracer, recorder=None,
                 health=None, probe_out=None, degrade=None,
-                drift=None, inc=None) -> None:
+                drift=None, inc=None, lat=None, usr1=None) -> None:
     from .utils.profiling import trace
 
     # Pipelined serving (serving/pipeline.py): the host stage (this
@@ -1114,7 +1230,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
     end = object()  # next() sentinel: a batch is never None-able
     source = _tick_source(
         args, raw=use_native and args.source in ("ryu", "controller"),
-        recorder=recorder, probe_out=probe_out,
+        recorder=recorder, probe_out=probe_out, stamp=lat is not None,
     )
     try:
         with trace(args.profile_dir):
@@ -1126,10 +1242,21 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                     batch = next(source, end)
                 if batch is end:
                     break
+                if usr1 is not None and usr1["due"]:
+                    # deferred half of the SIGUSR1 hook: safely outside
+                    # the signal frame, between ticks — record the
+                    # signal, freeze the ring, snapshot the counters,
+                    # and KEEP SERVING
+                    usr1["due"] = False
+                    recorder.record("signal.sigusr1")
+                    _dump_flight(recorder, args.obs_dir, "sigusr1")
+                    _dump_metrics(m, args.obs_dir, "sigusr1")
                 if pipe is not None:
                     # a dead device stage must kill the serve (and leave
                     # a post-mortem), not let the host spin silently
                     pipe.raise_if_failed()
+                if lat is not None:
+                    _begin_tick_provenance(lat, batch, probe_out)
                 if health is not None:
                     health.tick()
                     if (not probe_wired and probe_out is not None
@@ -1154,13 +1281,17 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                             else:
                                 n_rec = engine.ingest(batch)
                         m.inc("records", n_rec)
+                        if lat is not None:
+                            lat.mark_parse()
                         with tracer.span("scatter"):
                             engine.step()
+                        if lat is not None:
+                            lat.mark_scatter()
                     if (probe_out is not None
                             and probe_out.get("fanin") is not None):
                         _evict_dead_namespaces(
                             probe_out["fanin"], engine, m, pipe,
-                            recorder,
+                            recorder, lat=lat,
                         )
                     ticks += 1
                     m.inc("ticks")
@@ -1186,6 +1317,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 feature_stage, sharded,
                                 evict_state=evict_state,
                                 degrade=degrade, drift=drift, inc=inc,
+                                lat=lat,
                             )
                         elif sharded:
                             # the sharded tick's whole read side
@@ -1219,7 +1351,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 _print_table(
                                     engine, model, predict,
                                     serve_params, args, tracer,
-                                    degrade=degrade, inc=inc,
+                                    degrade=degrade, inc=inc, lat=lat,
                                 )
                             if drift is not None:
                                 # off the hot path: the tick's labels
@@ -1252,7 +1384,50 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
         source.close()
 
 
-def _evict_dead_namespaces(tier, engine, m, pipe, recorder) -> None:
+def _dump_metrics(m, obs_dir, reason: str) -> None:
+    """Best-effort metrics-snapshot dump (the SIGUSR1 pair of
+    ``_dump_flight``) — forensics must never become a new failure."""
+    from .obs import dump_metrics_snapshot
+
+    try:
+        path = dump_metrics_snapshot(m, obs_dir, reason)
+    except OSError as e:
+        print(f"WARNING: metrics snapshot dump failed: {e}",
+              file=sys.stderr)
+        return
+    print(f"metrics snapshot dumped to {path} ({reason})",
+          file=sys.stderr)
+
+
+def _begin_tick_provenance(lat, batch, probe_out) -> None:
+    """Register this tick's arrived batches with the latency plane:
+    the fan-in tier hands over its per-batch (sid, emit, enq, deq, n)
+    entries; a direct source becomes one sid-0 entry stamped at its
+    pump/parse moment. Raw byte batches degrade BY DESIGN to an
+    arrival-time emit (the native fast path has no records host-side
+    to carry a stamp); a RECORD batch arriving unstamped means the
+    stamp itself failed (an absorbed ``obs.stamp`` fire) — it keeps
+    the fault-site contract: counted in ``latency_unstamped_batches``
+    and excluded from the e2e fold, never fabricated from arrival
+    time (which would inject an understated sample into the headline
+    quantiles)."""
+    from .ingest.batcher import batch_emit_ts
+
+    tier = probe_out.get("fanin") if probe_out is not None else None
+    if tier is not None:
+        entries = tier.pop_provenance()
+        if entries:
+            lat.begin_tick(entries)
+        return
+    if isinstance(batch, (bytes, bytearray)):
+        emit, n = lat.clock(), 0
+    else:
+        emit, n = batch_emit_ts(batch), len(batch)
+    lat.begin_tick([(0, emit, None, None, n)])
+
+
+def _evict_dead_namespaces(tier, engine, m, pipe, recorder,
+                           lat=None) -> None:
     """Evict namespaces whose source-death quarantine expired (fan-in
     tier, ingest/fanin.py). Deferred while a pipelined render is in
     flight — a released slot's metadata must outlive its render, the
@@ -1277,6 +1452,12 @@ def _evict_dead_namespaces(tier, engine, m, pipe, recorder) -> None:
             )
             continue
         n = engine.evict_source(sid)
+        if lat is not None:
+            # the namespace's rows are gone: pending latency entries
+            # would fold against labels nobody will ever serve — the
+            # per-source e2e series stops accumulating here (its queue
+            # backlog was already purged by take_evictions)
+            lat.drop_source(sid)
         m.inc("evicted", n)
         m.inc("source_evictions")
         if recorder is not None:
@@ -1293,7 +1474,7 @@ def _evict_dead_namespaces(tier, engine, m, pipe, recorder) -> None:
 def _dispatch_render(args, engine, model, predict, serve_params, m,
                      tracer, pipe, feature_stage, sharded,
                      evict_state=None, degrade=None, drift=None,
-                     inc=None) -> None:
+                     inc=None, lat=None) -> None:
     """Host-stage half of one pipelined render tick: dispatch the read
     side against THIS tick's table and stage the device-stage job.
     Output is byte-identical to the serial render of the same tick —
@@ -1367,11 +1548,20 @@ def _dispatch_render(args, engine, model, predict, serve_params, m,
             engine, predict, serve_params, args.table_rows,
             feature_stage, inc=inc,
         )
+    # seal at dispatch, ON the host stage: the read side was dispatched
+    # against THIS tick's table, so exactly the batches scattered so
+    # far become visible when this render prints — later ticks' batches
+    # wait for their own render, like their rows. A coalesced
+    # (superseded) render's generation folds at the render that
+    # actually prints (render_visible folds every generation <= seal).
+    seal = lat.seal() if lat is not None else None
 
-    def job(read=read):
+    def job(read=read, seal=seal):
         with tracer.span("stage.device"):
             with m.time("predict_s"), tracer.span("predict"):
                 rows = read.rows()
+            if lat is not None:
+                lat.mark_device(seal)
             # the stale verdict must postdate the predict attempt: a
             # ladder trip DURING rows() marks THIS tick's render
             stale = degrade is not None and degrade.render_stale
@@ -1381,6 +1571,8 @@ def _dispatch_render(args, engine, model, predict, serve_params, m,
                                   stale=stale)
                 else:
                     _print_full(model, rows, stale=stale)
+            if lat is not None:
+                lat.render_visible(seal)
         if drift is not None:
             # the device-stage worker's idle time: the tick's frame is
             # already printed, the next render is not yet staged
@@ -1420,11 +1612,15 @@ def _print_full(model, rows, stale=False) -> None:
 
 
 def _print_table(engine, model, predict, serve_params, args,
-                 tracer, degrade=None, inc=None) -> None:
+                 tracer, degrade=None, inc=None, lat=None) -> None:
     import jax
 
     from .utils.table import CLASSIFIER_FIELDS, render_table, status_str
 
+    # serial render: everything scattered so far becomes visible when
+    # this frame prints — seal, sync, fold (the pipelined counterpart
+    # lives in _dispatch_render)
+    seal = lat.seal() if lat is not None else None
     # The device flow table produces float32 features natively, so the
     # SVC/KNN hi/lo precise mode is moot here (lo would be identically
     # zero); it applies to float64 feature sources like the CSV pipeline.
@@ -1445,6 +1641,8 @@ def _print_table(engine, model, predict, serve_params, args,
             # render (the degrade ladder returns host arrays — a no-op
             # pass-through)
             jax.block_until_ready(labels)
+    if lat is not None:
+        lat.mark_device(seal)
     # the stale verdict postdates the predict attempt: a ladder trip
     # during THIS call marks this tick's render
     stale = degrade is not None and degrade.render_stale
@@ -1470,6 +1668,8 @@ def _print_table(engine, model, predict, serve_params, args,
                 engine, model, engine.render_sample(labels, limit),
                 n_flows, stale=stale,
             )
+        if lat is not None:
+            lat.render_visible(seal)
         return
     with tracer.span("render"):
         rows = []
@@ -1489,6 +1689,8 @@ def _print_table(engine, model, predict, serve_params, args,
             )
         fields, rows = _stale_fields(CLASSIFIER_FIELDS, rows, stale)
         print(render_table(fields, rows), flush=True)
+    if lat is not None:
+        lat.render_visible(seal)
 
 
 def _print_ranked(engine, model, ranked, n_flows, stale=False) -> None:

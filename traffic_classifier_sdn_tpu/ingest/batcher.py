@@ -34,6 +34,19 @@ def bucket_size(n: int, buckets: Sequence[int]) -> int:
     return buckets[-1]
 
 
+def batch_emit_ts(batch) -> float | None:
+    """The emit stamp of a direct-source poll batch, for the latency
+    plane (obs/latency.py): a poll batch is stamped as one unit at its
+    pump-read moment (``protocol.stamp_records``), so the first
+    record's stamp speaks for the batch — one attribute read instead
+    of an O(records) min-scan on the hot path. None for raw byte
+    batches (the native fast path has no records host-side) and for
+    unstamped batches; the caller degrades to its arrival clock."""
+    if isinstance(batch, (bytes, bytearray)) or not batch:
+        return None
+    return getattr(batch[0], "emit_ts", None)
+
+
 @dataclass
 class SlotAssignment:
     slot: int

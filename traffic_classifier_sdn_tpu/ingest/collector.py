@@ -20,9 +20,10 @@ import queue
 import signal
 import subprocess
 import threading
+import time
 
 from ..utils.faults import FaultInjected, fault_bytes
-from .protocol import TelemetryRecord, parse_line
+from .protocol import TelemetryRecord, parse_line, stamp_records
 
 # The reference's monitor launch command (traffic_classifier.py:22).
 DEFAULT_MONITOR_CMD = "sudo ryu run simple_monitor_13.py"
@@ -32,14 +33,23 @@ class SubprocessCollector:
     """Spawn a monitor command and iterate parsed records."""
 
     def __init__(self, cmd: str = DEFAULT_MONITOR_CMD, queue_size: int = 1 << 16,
-                 raw: bool = False, recorder=None):
+                 raw: bool = False, recorder=None, stamp: bool = False,
+                 prov_clock=time.perf_counter):
         """``raw=True`` queues raw pipe chunks (bytes) instead of parsed
         TelemetryRecords — the zero-Python-per-line path for the native
         C++ engine (FlowStateEngine.ingest_bytes). ``recorder`` (an
         obs.FlightRecorder) receives a structured event per dropped-line
-        burst, so a post-mortem shows where telemetry was lost."""
+        burst, so a post-mortem shows where telemetry was lost.
+        ``stamp=True`` emit-stamps each parsed record ON THE READER
+        THREAD at pipe-parse time (obs/latency.py provenance — the
+        truest host-side proxy for the monitor's emission, capturing
+        queue-wait between the pipe and the serve loop; raw mode has no
+        records to stamp and degrades to batch-arrival stamping in the
+        serve loop)."""
         self.cmd = cmd
         self.raw = raw
+        self._stamp = stamp and not raw
+        self._prov_clock = prov_clock
         self._recorder = recorder  # set once here, read-only afterwards
         self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
         self._proc: subprocess.Popen | None = None
@@ -158,6 +168,10 @@ class SubprocessCollector:
             r = parse_line(line)
             if r is None:
                 continue
+            if self._stamp:
+                # per line, reader-thread-side: an absorbed obs.stamp
+                # fire leaves the record unstamped, never undelivered
+                stamp_records((r,), self._prov_clock())
             try:
                 self._queue.put_nowait(r)
             except queue.Full:

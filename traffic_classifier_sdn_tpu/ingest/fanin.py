@@ -46,7 +46,7 @@ from collections import deque
 from dataclasses import dataclass, replace
 
 from ..utils.faults import FaultInjected, fault_point
-from .protocol import TelemetryRecord
+from .protocol import TelemetryRecord, stamp_records
 
 SOURCE_HEALTHY = "HEALTHY"
 SOURCE_RESTARTING = "RESTARTING"
@@ -135,14 +135,25 @@ class FanInQueue:
     sources share one budget measured in what actually costs ingest
     time."""
 
-    def __init__(self, max_records: int = 1 << 16, recorder=None):
+    def __init__(self, max_records: int = 1 << 16, recorder=None,
+                 prov_clock=time.perf_counter,
+                 collect_provenance: bool = False):
         self.max_records = max_records
         self._recorder = recorder  # set once, read-only afterwards
+        # latency provenance (obs/latency.py): enqueue/dequeue stamps
+        # per batch, in the perf_counter domain the emit stamps use —
+        # queue-wait is deq − enq. Collection is opt-in (the tier turns
+        # it on with stamping) and the taken-entry buffer is bounded so
+        # a consumer that never drains it cannot leak.
+        self._prov_clock = prov_clock
+        self._collect_prov = collect_provenance
+        self._taken_prov: deque = deque(maxlen=4096)
         # guards every queue/counter access below: producers are the
         # source pump threads, the consumer is the serve loop, and the
         # drop counters are read by the obs roster — all cross-thread
         self._lock = threading.Lock()
-        self._batches: deque = deque()  # (sid, records) in arrival order
+        # (sid, records, enq_ts) in arrival order
+        self._batches: deque = deque()
         self._queued = 0  # records currently queued
         self._drops: dict[int, int] = {}  # sid → records dropped
         self._accepted: dict[int, int] = {}  # sid → records accepted
@@ -160,11 +171,12 @@ class FanInQueue:
         except FaultInjected:
             dropped = True
         if not dropped:
+            enq = self._prov_clock() if self._collect_prov else None
             with self._lock:
                 if self._queued + n > self.max_records:
                     dropped = True
                 else:
-                    self._batches.append((sid, records))
+                    self._batches.append((sid, records, enq))
                     self._queued += n
                     self._accepted[sid] = self._accepted.get(sid, 0) + n
         if dropped:
@@ -185,20 +197,41 @@ class FanInQueue:
         skipping sources in ``exclude`` — one serve tick consumes at
         most one poll tick per source, so a backlogged source drains
         one batch per tick instead of smearing several poll ticks into
-        one serve tick."""
+        one serve tick. With provenance collection on, each taken
+        batch's ``(sid, emit, enq, deq, n)`` lands in the taken-entry
+        buffer for ``pop_provenance`` — a PURGED batch never gets an
+        entry, so a dead source's flushed backlog cannot poison the
+        e2e quantiles."""
+        deq = self._prov_clock() if self._collect_prov else None
         with self._lock:
             out: list[tuple[int, list]] = []
             kept: deque = deque()
             seen = set(exclude)
             while self._batches:
-                sid, recs = self._batches.popleft()
+                sid, recs, enq = self._batches.popleft()
                 if sid in seen:
-                    kept.append((sid, recs))
+                    kept.append((sid, recs, enq))
                 else:
                     seen.add(sid)
                     out.append((sid, recs))
                     self._queued -= len(recs)
+                    if deq is not None:
+                        self._taken_prov.append((
+                            sid,
+                            recs[0].emit_ts if recs else None,
+                            enq, deq, len(recs),
+                        ))
             self._batches = kept
+        return out
+
+    def pop_provenance(self) -> list[tuple]:
+        """Drain the taken-batch provenance entries accumulated since
+        the last call — ``(sid, emit, enq, deq, n_records)`` per batch,
+        the ``obs.latency.LatencyProvenance.begin_tick`` input shape.
+        Empty unless the queue was built with provenance collection."""
+        with self._lock:
+            out = list(self._taken_prov)
+            self._taken_prov.clear()
         return out
 
     def purge(self, sid: int) -> int:
@@ -211,11 +244,11 @@ class FanInQueue:
         with self._lock:
             kept: deque = deque()
             while self._batches:
-                s, recs = self._batches.popleft()
+                s, recs, enq = self._batches.popleft()
                 if s == sid:
                     purged += len(recs)
                 else:
-                    kept.append((s, recs))
+                    kept.append((s, recs, enq))
             self._batches = kept
             if purged:
                 self._queued -= purged
@@ -255,12 +288,17 @@ class SourceWorker:
     verdict: only an UNCLEAN death quarantines the namespace."""
 
     def __init__(self, spec: SourceSpec, queue: FanInQueue, metrics=None,
-                 recorder=None, clock=time.monotonic):
+                 recorder=None, clock=time.monotonic,
+                 stamp: bool = False, prov_clock=time.perf_counter):
         self.spec = spec
         self._queue = queue
         self._metrics = metrics
         self._recorder = recorder
         self._clock = clock
+        # latency provenance: stamp each delivered batch's records with
+        # the pump-read moment (perf_counter domain, host-side only)
+        self._stamp = stamp
+        self._prov_clock = prov_clock
         self._state_lock = threading.Lock()
         self._state = SOURCE_HEALTHY
         self._clean = False
@@ -386,8 +424,23 @@ class SourceWorker:
     def _deliver(self, records: list) -> None:
         """Stamp the namespace and enqueue one poll batch. Source 0 is
         the legacy namespace: records pass through object-identical (the
-        single-source byte-compat path pays zero per-record work)."""
+        single-source byte-compat path pays zero per-record work).
+
+        With the latency plane armed, the batch is emit-stamped FIRST
+        (this is the "source pump read" moment — ``protocol
+        .stamp_records`` is write-once, so records a stamping collector
+        already marked at pipe parse keep the earlier, truer stamp;
+        an absorbed ``obs.stamp`` fire leaves the batch unstamped and
+        delivery proceeds regardless), then namespace-stamped — the
+        ``replace`` copies carry ``emit_ts`` through. Only the LEAD
+        record is stamped: one pump read is one emit moment for the
+        whole batch (``batcher.batch_emit_ts`` and the queue's
+        provenance read exactly that), and a per-record loop at batch
+        16k would cost ~4 ms/tick — past the 3% overhead budget — for
+        zero extra information."""
         sid = self.spec.sid
+        if self._stamp:
+            stamp_records(records[:1], self._prov_clock())
         if sid:
             records = [replace(r, source=sid) for r in records]
         ok = self._queue.put(sid, records)
@@ -452,6 +505,10 @@ class SourceWorker:
             self.spec.cmd, raw=False,
             max_restarts=self.spec.max_restarts,
             metrics=self._metrics, recorder=self._recorder,
+            # pipe-parse emit stamps on the reader thread: the truest
+            # emission proxy (captures pipe→pump queue wait; _deliver's
+            # write-once stamp then leaves these untouched)
+            stamp=self._stamp,
         )
         with self._state_lock:
             self._coll = coll
@@ -496,7 +553,8 @@ class FanInIngest:
 
     def __init__(self, specs, queue_records: int = 1 << 16,
                  quarantine_s: float = 5.0, metrics=None, recorder=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, stamp: bool = False,
+                 prov_clock=time.perf_counter):
         specs = list(specs)
         sids = [s.sid for s in specs]
         if len(set(sids)) != len(sids):
@@ -508,7 +566,15 @@ class FanInIngest:
         self._metrics = metrics
         self._recorder = recorder
         self._clock = clock
-        self.queue = FanInQueue(queue_records, recorder=recorder)
+        # latency provenance (obs/latency.py): emit stamps at each
+        # pump's _deliver + enq/deq stamps in the queue; the serve loop
+        # drains pop_provenance() per assembled tick
+        self._stamp = stamp
+        self._prov_clock = prov_clock
+        self.queue = FanInQueue(
+            queue_records, recorder=recorder, prov_clock=prov_clock,
+            collect_provenance=stamp,
+        )
         # guards the worker map and quarantine schedule: written by the
         # serve thread (supervision, restarts), read by the obs thread
         # (roster/healthz). Worker snapshots are taken OUTSIDE this lock
@@ -517,7 +583,7 @@ class FanInIngest:
         self._workers: dict[int, SourceWorker] = {
             s.sid: SourceWorker(
                 s, self.queue, metrics=metrics, recorder=recorder,
-                clock=clock,
+                clock=clock, stamp=stamp, prov_clock=prov_clock,
             )
             for s in specs
         }
@@ -564,6 +630,7 @@ class FanInIngest:
         fresh = SourceWorker(
             old.spec, self.queue, metrics=self._metrics,
             recorder=self._recorder, clock=self._clock,
+            stamp=self._stamp, prov_clock=self._prov_clock,
         )
         with self._roster_lock:
             self._quarantine.pop(sid, None)
@@ -705,6 +772,13 @@ class FanInIngest:
         return merged
 
     # -- obs surface -------------------------------------------------------
+    def pop_provenance(self) -> list[tuple]:
+        """This tick's taken-batch provenance — ``(sid, emit, enq, deq,
+        n)`` per batch consumed since the last call (obs/latency.py's
+        ``begin_tick`` shape). Empty unless the tier was built with
+        ``stamp=True``."""
+        return self.queue.pop_provenance()
+
     def roster(self) -> list[dict]:
         """Per-source status rows for /healthz and the metrics plane:
         id, state, lag since last delivery, drop/record counters, and

@@ -18,7 +18,10 @@ flow as that flow's reverse direction (reference :161-165).
 from __future__ import annotations
 
 import hashlib
-from dataclasses import dataclass
+import time as _time
+from dataclasses import dataclass, field
+
+from ..utils.faults import FaultInjected, fault_point
 
 PREFIX = b"data"
 _I64_MAX = (1 << 63) - 1
@@ -34,6 +37,16 @@ class TelemetryRecord:
     same (datapath, src, dst) tuple land in disjoint flow-table
     namespaces. Source 0 is the legacy/default namespace — a record
     that never crossed the fan-in tier keys exactly as before.
+
+    ``emit_ts`` is NOT on the wire either: it is the latency-provenance
+    plane's monotonic emit stamp (``time.perf_counter`` domain), set
+    host-side at the moment the owning pump read/generated the record
+    (``stamp_records``) and consumed by ``obs/latency.py`` to attribute
+    where a record's end-to-end budget went. ``compare=False``: two
+    records carrying the same telemetry are equal regardless of when
+    they were stamped — identity, replay convergence, and checkpoint
+    round-trips never see the stamp (``format_line`` does not emit it,
+    ``parse_line`` never sets it).
     """
 
     time: int
@@ -45,6 +58,39 @@ class TelemetryRecord:
     packets: int
     bytes: int
     source: int = 0
+    emit_ts: float | None = field(default=None, compare=False)
+
+
+def stamp_records(records, ts: float | None = None) -> bool:
+    """Set each record's ``emit_ts`` in place (write-once: records that
+    already carry a stamp keep it — a pump downstream of a stamping
+    collector must not overwrite the earlier, truer emit moment).
+
+    In-place via ``object.__setattr__`` on the frozen dataclass — the
+    stamp is provenance metadata set exactly once by the owning pump
+    BEFORE the batch is published to the queue (no concurrent reader
+    exists yet), and the cost must stay out of the hot path: callers
+    that own a whole poll batch stamp only its LEAD record
+    (``records[:1]`` — one pump read is one emit moment; the 3%
+    tick-p50 overhead budget the bench A/B pins at batch 16k rules out
+    an O(records) loop), while per-line paths (the collector's reader)
+    stamp each record as it parses. The wire fields stay immutable in
+    every hand that receives the record.
+
+    Fault site ``obs.stamp`` (ABSORBED): a stamping failure degrades
+    this batch to unstamped — the latency plane skips it, telemetry
+    flows untouched. Returns False when the fire absorbed the stamp.
+    """
+    try:
+        fault_point("obs.stamp")
+    except FaultInjected:
+        return False  # ABSORBED: unstamped batch, telemetry undropped
+    if ts is None:
+        ts = _time.perf_counter()
+    for r in records:
+        if r.emit_ts is None:
+            object.__setattr__(r, "emit_ts", ts)
+    return True
 
 
 def format_line(r: TelemetryRecord) -> bytes:

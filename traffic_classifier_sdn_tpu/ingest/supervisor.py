@@ -46,9 +46,13 @@ class SupervisedCollector:
 
     def __init__(self, cmd: str, raw: bool = False, max_restarts: int = 5,
                  backoff_base: float = 0.5, backoff_cap: float = 30.0,
-                 metrics=None, clock=time.monotonic, recorder=None):
+                 metrics=None, clock=time.monotonic, recorder=None,
+                 stamp: bool = False):
         self.cmd = cmd
         self.raw = raw
+        # latency-provenance emit stamping, forwarded to every
+        # collector incarnation (obs/latency.py)
+        self.stamp = stamp
         self.max_restarts = max_restarts
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
@@ -77,7 +81,8 @@ class SupervisedCollector:
         """Collector factory — the seam chaos tests override to script
         incarnation lifecycles without real subprocesses."""
         return SubprocessCollector(
-            self.cmd, raw=self.raw, recorder=self._recorder
+            self.cmd, raw=self.raw, recorder=self._recorder,
+            stamp=self.stamp,
         )
 
     def start(self) -> None:

@@ -20,20 +20,31 @@ Three cooperating layers, each dependency-free (stdlib + the existing
   ``/metrics`` (Prometheus text format), ``/healthz`` (liveness +
   staleness), and ``/events`` (flight-recorder tail as JSON), wired
   into ``cli.py`` behind ``--obs-port``.
+- ``obs.latency`` — record-level latency provenance: host-side emit
+  stamps on every telemetry batch, per-hop boundary marks (fan-in
+  queue enter/exit, batcher parse, scatter dispatch, device
+  completion, render visibility), folded per render tick into the
+  ``e2e_emit_to_render_s`` / ``queue_wait_s`` / ``batch_wait_s`` /
+  ``wf_*`` waterfall histograms and the /healthz ``latency`` block —
+  the live end-to-end budget the device-boundary "<1 ms" claim needs
+  as context.
 
 docs/OBSERVABILITY.md is the operator-facing catalog (metric names,
 span taxonomy, scrape and post-mortem workflow).
 """
 
 from .exposition import ExpositionServer, HealthState, prometheus_text
-from .flight_recorder import FlightRecorder
+from .flight_recorder import FlightRecorder, dump_metrics_snapshot
+from .latency import LatencyProvenance
 from .trace import Span, Tracer
 
 __all__ = [
     "ExpositionServer",
     "FlightRecorder",
     "HealthState",
+    "LatencyProvenance",
     "Span",
     "Tracer",
+    "dump_metrics_snapshot",
     "prometheus_text",
 ]

@@ -126,6 +126,8 @@ class HealthState:
         self._drift = None
         self._label_cache = None
         self._sources = None
+        self._latency = None
+        self._obs_port: int | None = None
 
     def model_loaded(self) -> None:
         """The serve registered its boot model — the ``model_age_s``
@@ -169,6 +171,23 @@ class HealthState:
         with self._lock:
             self._label_cache = status_fn
 
+    def set_latency(self, status_fn) -> None:
+        """``status_fn() -> dict`` (obs/latency.LatencyProvenance
+        .status): the live end-to-end latency budget, folded into
+        /healthz as a ``latency`` object — e2e p50/p99 since emit, the
+        dominant stage of the waterfall, and the SLO-breach flag when
+        ``--latency-slo`` is armed."""
+        with self._lock:
+            self._latency = status_fn
+
+    def set_obs_port(self, port: int) -> None:
+        """The exposition server's ACTUAL bound port — the /healthz
+        self-reference. With ``--obs-port 0`` (ephemeral bind) this is
+        how a supervisor that parsed nothing from stderr still learns
+        where the plane landed."""
+        with self._lock:
+            self._obs_port = int(port)
+
     def set_collector_probe(self, probe) -> None:
         """``probe() -> bool | None`` (None = no collector, e.g. replay
         sources — reported but never unhealthy)."""
@@ -208,6 +227,8 @@ class HealthState:
             drift = self._drift
             label_cache = self._label_cache
             sources = self._sources
+            latency = self._latency
+            obs_port = self._obs_port
             model_loaded = self._model_loaded_at
             model_promoted = self._model_promoted_at
             started = self._started_at
@@ -296,6 +317,13 @@ class HealthState:
             except Exception as e:  # noqa: BLE001 — health must not crash
                 report["sources"] = [{"state": "unknown",
                                       "error": str(e)}]
+        if latency is not None:
+            try:
+                report["latency"] = latency()
+            except Exception as e:  # noqa: BLE001 — health must not crash
+                report["latency"] = {"observed": False, "error": str(e)}
+        if obs_port is not None:
+            report["obs_port"] = obs_port
         return healthy, report
 
 

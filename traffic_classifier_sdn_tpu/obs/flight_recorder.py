@@ -153,3 +153,28 @@ class FlightRecorder:
         # none — the committed file is always complete
         atomic_write_bytes(path, payload)
         return path
+
+
+def dump_metrics_snapshot(metrics, directory: str, reason: str) -> str:
+    """Freeze a ``utils.metrics.Metrics`` snapshot as one JSON file in
+    ``directory`` — the metrics half of the on-demand (SIGUSR1) dump:
+    a flight-recorder JSONL answers *what happened*, this answers
+    *what the counters and latency quantiles said when it did*.
+    Same discipline as ``FlightRecorder.dump``: atomic write, reason
+    embedded in the filename, repeated dumps never collide (the
+    monotonic-ns suffix orders them)."""
+    snap = metrics.snapshot()
+    payload = json.dumps(
+        {"kind": "metrics", "reason": reason, "snapshot": snap},
+        sort_keys=True, default=repr,
+    ).encode()
+    os.makedirs(directory, exist_ok=True)
+    safe_reason = "".join(
+        c if c.isalnum() or c in "-_." else "-" for c in reason
+    )
+    path = os.path.join(
+        directory,
+        f"metrics-{os.getpid()}-{time.monotonic_ns()}-{safe_reason}.json",
+    )
+    atomic_write_bytes(path, payload)
+    return path

@@ -38,6 +38,15 @@ full-table, and sharded paths).
 Fault sites ``pipeline.handoff`` and ``pipeline.coalesce`` thread the
 chaos matrix through the new concurrency seams (utils/faults.SITES).
 
+Latency provenance (obs/latency.py) rides the same dispatch/visibility
+boundary this module defines: the serve loop SEALS the pending batch
+entries at read-side dispatch on the host stage (the set of scatters
+this render will make visible is fixed exactly there), the device-stage
+job marks device completion after ``rows()`` syncs, and the fold runs
+after the frame prints. Coalescing composes for free — a superseded
+render's sealed generation folds at the render that actually printed,
+which is when its telemetry truly became operator-visible.
+
 Failure propagation at the device stage (serving/degrade.py): a raw
 device kernel that wedges mid-dispatch would block the device-stage
 worker forever — ``ServePipeline`` propagates device-stage EXCEPTIONS

@@ -19,6 +19,13 @@ call-path cache on this jax version, so each warm also makes one
 priming call (against scratch state for donated fns — donation
 consumes the input, and the serve loop's live table must never be
 warmup fodder).
+
+Latency provenance (obs/latency.py) deliberately needs NOTHING warmed
+here: emit stamps and boundary marks are host-side clock reads on
+plain Python objects — zero traced ops, zero new jit programs — so
+the warm set below is complete with the plane armed and the
+first-tick compile discipline survives (tests/test_latency.py pins
+the plane jax-free — no traced op can hide in a host-only module).
 """
 
 from __future__ import annotations

@@ -83,6 +83,13 @@ SITES: dict[str, str] = {
         "window exactly its own slots are evicted, while every other "
         "source keeps serving fresh labels every tick"
     ),
+    "obs.stamp": (
+        "ingest/protocol.stamp_records — the latency-provenance emit "
+        "stamp itself fails; ABSORBED at the stamping seam: the batch "
+        "is delivered unstamped (counted in latency_unstamped_batches, "
+        "skipped by the e2e fold) and telemetry is NEVER dropped — a "
+        "broken observability plane must not cost a single record"
+    ),
     "native.load": (
         "native/engine.available() — the C++ engine is unavailable "
         "(build/dlopen failure)"
