@@ -1497,3 +1497,155 @@ def test_sigusr1_dumps_flight_recorder_and_metrics_without_exiting(
 
     assert 0 < snap["snapshot"]["ticks"] < 60
     assert global_metrics.counters["ticks"] == 60
+
+
+# ---------------------------------------------------------------------------
+# openset.score / openset.calibrate — the open-set rejection tier
+# (serving/openset.py): both ABSORBED — a score/calibration failure
+# degrades that tick to the closed-world predict served FRESH, never a
+# fabricated 'unknown' and never a crashed serve
+# ---------------------------------------------------------------------------
+
+
+def _openset_teacher(params, X):
+    return (np.asarray(X)[:, 0] > 500.0).astype(np.int32)
+
+
+def _openset_batch(lo, hi, n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 12), np.float32)
+    X[: n // 2, 0] = lo * (1 + 0.01 * rng.rand(n // 2))
+    X[n // 2:, 0] = hi * (1 + 0.01 * rng.rand(n - n // 2))
+    X[:, 1] = 1.0
+    return X
+
+
+def _openset_novel(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 12), np.float32)
+    X[:, 0] = 5e4 * (1 + 0.1 * rng.rand(n))
+    X[:, 1] = 1.0
+    return X
+
+
+def _armed_openset_gate(metrics=None, rows=64):
+    from traffic_classifier_sdn_tpu.serving.openset import (
+        CALIBRATING,
+        OpenSetGate,
+    )
+
+    gate = OpenSetGate(
+        _openset_teacher, n_classes=2, calibration_rows=rows,
+        metrics=metrics,
+    )
+    i = 0
+    while gate.state == CALIBRATING:
+        i += 1
+        assert i < 64
+        gate(None, _openset_batch(10.0, 1000.0, seed=i))
+    return gate
+
+
+def test_openset_score_fault_serves_closed_world_fresh():
+    """A fire at openset.score on a tick that WOULD have rejected:
+    the tick serves the inner closed-world labels fresh (the novel
+    rows get their wrong-but-honest argmax label), nothing is
+    fabricated, and the next tick rejects again."""
+    gate = _armed_openset_gate()
+    X = np.concatenate(
+        [_openset_batch(10.0, 1000.0, seed=5), _openset_novel(seed=5)]
+    )
+    with faults.installed(faults.FaultPlan(
+        [faults.FaultRule("openset.score", times=1)], SEED,
+    )) as plan:
+        out = np.asarray(gate(None, X))
+        # the fault tick: byte-equal to the inner predict — closed
+        # world, served fresh, no unknown anywhere
+        np.testing.assert_array_equal(out, _openset_teacher(None, X))
+        assert plan.fires
+        # recovery is immediate: the very next tick rejects
+        out2 = np.asarray(gate(None, X))
+        assert (out2[32:] == gate.unknown_index).all()
+    assert gate.status()["score_faults"] == 1
+
+
+def test_openset_calibrate_fault_drops_sample_arming_still_lands():
+    """Fires at openset.calibrate drop calibration samples — arming is
+    DELAYED, never wedged, and labels flow untouched throughout."""
+    from traffic_classifier_sdn_tpu.serving.openset import (
+        ARMED,
+        OpenSetGate,
+    )
+
+    gate = OpenSetGate(
+        _openset_teacher, n_classes=2, calibration_rows=64,
+    )
+    with faults.installed(faults.FaultPlan(
+        [faults.FaultRule("openset.calibrate", times=3)], SEED,
+    )) as plan:
+        i = 0
+        while gate.state != ARMED:
+            i += 1
+            assert i < 64, "arming wedged by calibrate faults"
+            X = _openset_batch(10.0, 1000.0, seed=i)
+            np.testing.assert_array_equal(
+                np.asarray(gate(None, X)), _openset_teacher(None, X)
+            )
+        assert len(plan.fires) == 3
+        # three dropped samples = three extra ticks before arming:
+        # calibration pairs fold one tick deferred (tick N's pair at
+        # tick N+1), so 2 clean 32-row folds land at call 6
+        assert i == 6
+    assert gate.status()["calibrate_faults"] == 3
+
+
+def test_openset_rebase_fault_keeps_previous_stats():
+    """A fire during a promotion-time rebase keeps the PREVIOUS
+    calibration: the threshold is unchanged and the gate still
+    rejects — a promotion never dies of its rebase."""
+    gate = _armed_openset_gate()
+    thr = gate.threshold
+    window = np.concatenate(
+        [_openset_batch(10.0, 1000.0, seed=i) for i in range(40, 44)]
+    )
+    with faults.installed(faults.FaultPlan(
+        # hits 1..N of openset.calibrate inside rebase
+        [faults.FaultRule("openset.calibrate", times=None)], SEED,
+    )) as plan:
+        assert gate.rebase(window, _openset_teacher(None, window)) \
+            is False
+        assert plan.fires
+    assert gate.threshold == thr
+    out = np.asarray(gate(None, _openset_novel(seed=9)))
+    assert (out == gate.unknown_index).all()
+    assert gate.status()["calibrate_faults"] == 1
+
+
+def test_openset_probabilistic_any_seed_never_fabricates_unknown():
+    """Probability-scheduled fires at BOTH openset seams (any
+    TCSDN_CHAOS_SEED): whatever subset fires, the gate never raises,
+    every tick returns labels, and a tick whose scoring faulted is
+    byte-equal to the closed-world predict — the absorbed rung is the
+    inner labels served fresh, never a stale or fabricated row."""
+    gate = _armed_openset_gate()
+    X = np.concatenate(
+        [_openset_batch(10.0, 1000.0, seed=77), _openset_novel(seed=77)]
+    )
+    closed = _openset_teacher(None, X)
+    with faults.installed(faults.FaultPlan([
+        faults.FaultRule("openset.score", p=0.4, times=None),
+        faults.FaultRule("openset.calibrate", p=0.4, times=None),
+    ], SEED)) as plan:
+        for _ in range(20):
+            before = len(
+                [s for s, _ in plan.fires if s == "openset.score"]
+            )
+            out = np.asarray(gate(None, X))
+            fired = len(
+                [s for s, _ in plan.fires if s == "openset.score"]
+            ) > before
+            if fired:
+                np.testing.assert_array_equal(out, closed)
+            else:
+                np.testing.assert_array_equal(out[:32], closed[:32])
+                assert (out[32:] == gate.unknown_index).all()

@@ -436,9 +436,16 @@ def test_monitor_rejects_mismatched_reference_at_construction():
         X = _batch(10.0, 1000.0, seed=i)
         good.observe(X, _teacher(None, X))
     ref = good.reference_arrays()
-    ref["class_freq"] = np.asarray([0.2, 0.3, 0.5], np.float64)  # 3 != 2
+    # 4 slots fit neither the n_classes=2 legacy shape nor the
+    # open-world n_classes+1=3 mix shape
+    ref["class_freq"] = np.asarray([0.1, 0.2, 0.3, 0.4], np.float64)
     with pytest.raises(ValueError, match="class_freq"):
         DriftMonitor(reference=ref)
+    # per-class stats from a different feature layout fail too
+    ref2 = good.reference_arrays()
+    ref2["class_mean"] = np.zeros((2, 7), np.float64)  # 7 != 12
+    with pytest.raises(ValueError, match="class_mean"):
+        DriftMonitor(reference=ref2)
 
 
 def test_rejected_candidate_retires_its_predict(tmp_path):

@@ -408,3 +408,154 @@ def test_knn_predict_chunked_matches(reference_models_dir, flow_dataset):
         np.asarray(knn.predict_chunked(params, X_hi, row_chunk=256)),
         np.asarray(knn.predict(params, X_hi)),
     )
+
+
+# ---------------------------------------------------------------------------
+# predict_scores — the open-set score surface (models/base.py protocol):
+# argmax(scores) == predict, byte-pinned per family, f32/f64, and
+# native-vs-XLA where a C++ path exists. Synthetic params (no reference
+# checkpoints needed) so the pin runs on every host.
+# ---------------------------------------------------------------------------
+
+
+def _surface_rng():
+    return np.random.RandomState(42)
+
+
+def _surface_X(rng, n=256, f=12):
+    # class-shaped magnitudes up to ~1e6 — the feature scale serving
+    # actually sees (rates/deltas), exercising the f32 rounding regime
+    return (rng.gamma(2.0, 1.0, (n, f)) *
+            (10.0 ** rng.randint(0, 7, (n, 1)))).astype(np.float64)
+
+
+def _surface_params(family, dtype):
+    rng = _surface_rng()
+    C, F = 6, 12
+    if family == "logreg":
+        return logreg.Params(
+            coef=jnp.asarray(rng.randn(C, F), dtype),
+            intercept=jnp.asarray(rng.randn(C), dtype),
+        )
+    if family == "gnb":
+        return gnb.from_numpy({
+            "theta": rng.gamma(2.0, 100.0, (C, F)),
+            "var": rng.gamma(2.0, 50.0, (C, F)) + 1.0,
+            "class_prior": np.full(C, 1 / C),
+        }, dtype=dtype)
+    if family == "kmeans":
+        return kmeans.Params(
+            centers=jnp.asarray(rng.gamma(2.0, 100.0, (4, F)), dtype)
+        )
+    if family == "knn":
+        return knn.from_numpy({
+            "fit_X": rng.gamma(2.0, 100.0, (512, F)),
+            "y": rng.randint(0, C, 512),
+            "n_neighbors": 5,
+            "classes": np.arange(C),
+        }, dtype=dtype)
+    if family == "svc":
+        S = 64
+        n_support = np.full(C, S // C)
+        n_support[0] += S - n_support.sum()
+        return svc.from_numpy({
+            "support_vectors": rng.gamma(2.0, 100.0, (S, F)),
+            "dual_coef": rng.randn(C - 1, S),
+            "n_support": n_support,
+            "intercept": rng.randn(C * (C - 1) // 2),
+            "gamma": 5e-9,
+            "classes": np.arange(C),
+        }, dtype=dtype)
+    if family == "forest":
+        from traffic_classifier_sdn_tpu.train import forest as tforest
+
+        theta = rng.gamma(2.0, 100.0, (C, F))
+        y = rng.randint(0, C, 2048)
+        X = (rng.gamma(2.0, 1.0, (2048, F)) * theta[y]).astype(
+            np.float32
+        )
+        return tforest.fit(X, y, n_classes=C, n_trees=12)
+    raise ValueError(family)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32])
+@pytest.mark.parametrize(
+    "family", ["logreg", "gnb", "kmeans", "knn", "svc", "forest"]
+)
+def test_predict_scores_argmax_parity(family, dtype):
+    """argmax(predict_scores) == predict, and the labels half of
+    predict_scores IS predict — byte-pinned for all six families,
+    both dtypes, under jit (the serving regime)."""
+    mod = {
+        "logreg": logreg, "gnb": gnb, "kmeans": kmeans,
+        "knn": knn, "svc": svc, "forest": forest,
+    }[family]
+    params = _surface_params(family, dtype)
+    X = jnp.asarray(_surface_X(_surface_rng()), dtype)
+    want = np.asarray(mod.predict(params, X))
+    labels, scores = jax.jit(mod.predict_scores)(params, X)
+    labels, scores = np.asarray(labels), np.asarray(scores)
+    np.testing.assert_array_equal(labels, want)
+    np.testing.assert_array_equal(
+        np.argmax(scores, axis=-1).astype(np.int32), want
+    )
+    assert scores.ndim == 2 and scores.shape[0] == X.shape[0]
+
+
+def test_native_forest_proba_argmax_matches_predict():
+    """The C++ walk's score surface: predict_proba's argmax equals its
+    own predict (first-max tie order) — the degrade rung keeps a
+    score view."""
+    from traffic_classifier_sdn_tpu.native import forest as nforest
+
+    if not nforest.available():
+        pytest.skip("native forest evaluator unavailable")
+    params = _surface_params("forest", jnp.float32)
+    nf = nforest.NativeForest({
+        k: np.asarray(getattr(params, k))
+        for k in ("left", "right", "feature", "threshold", "values")
+    })
+    X = _surface_X(_surface_rng()).astype(np.float32)
+    pred = nf.predict(X)
+    proba = nf.predict_proba(X)
+    np.testing.assert_array_equal(
+        np.argmax(proba, axis=-1).astype(np.int32), pred
+    )
+    # and the XLA surface agrees on the same forest
+    labels, _scores = forest.predict_scores(
+        params, jnp.asarray(X, jnp.float32)
+    )
+    np.testing.assert_array_equal(np.asarray(labels), pred)
+
+
+def test_native_knn_votes_argmax_matches_predict():
+    """The C++ brute-force evaluator's vote surface: votes sum to k,
+    argmax equals its own predict, and the XLA neighbor_votes surface
+    agrees vote-for-vote on a tie-free integer corpus."""
+    from traffic_classifier_sdn_tpu.native import knn as nknn
+
+    if not nknn.available():
+        pytest.skip("native knn evaluator unavailable")
+    rng = _surface_rng()
+    d = {
+        # integer-valued corpus: both paths rank exactly (no f32
+        # rounding ties), so the vote matrices must agree byte-for-byte
+        "fit_X": rng.randint(0, 1000, (256, 12)).astype(np.float64),
+        "y": rng.randint(0, 6, 256),
+        "n_neighbors": 5,
+        "classes": np.arange(6),
+    }
+    hk = nknn.NativeKnn(d)
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    X = rng.randint(0, 1000, (128, 12)).astype(np.float32)
+    pred = hk.predict(X)
+    votes = hk.votes(X)
+    assert (votes.sum(axis=1) == 5).all()
+    np.testing.assert_array_equal(
+        np.argmax(votes, axis=-1).astype(np.int32), pred
+    )
+    xla_labels, xla_votes = knn.predict_scores(
+        params, jnp.asarray(X, jnp.float32)
+    )
+    np.testing.assert_array_equal(np.asarray(xla_votes), votes)
+    np.testing.assert_array_equal(np.asarray(xla_labels), pred)

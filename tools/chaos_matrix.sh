@@ -54,6 +54,7 @@ GROUPS_KEYS=(
   "dirty:serve_dirty_mask or serve_label_cache"
   "fanin:fanin_put or fanin_source_dead"
   "obs:obs_stamp or sigusr1"
+  "openset:openset_score or openset_calibrate or openset_rebase or openset_probabilistic"
 )
 
 fail=0

@@ -76,6 +76,18 @@ if [ -f tools/bench_e2e_live.py ]; then
   fi
 fi
 
+# open-set eval on chip: the six-family fit + score sweep is short
+# kernels only (~2 min) — the TPU twin of openset_eval_cpu.json
+if [ -f tools/bench_openset.py ]; then
+  run_step 1200 /tmp/tpu_day_openset.log python tools/bench_openset.py \
+    --platform default
+  if [ "$STEP_OK" = 1 ] && grep '^{' /tmp/tpu_day_openset.log | tail -1 \
+      | grep -q '"platform": "tpu"'; then
+    grep '^{' /tmp/tpu_day_openset.log | tail -1 \
+      > docs/artifacts/openset_eval_tpu.json
+  fi
+fi
+
 # chip-day allowance: one warm process gets time for every race stage
 # (the driver's own end-of-round run keeps bench.py's 560 s default)
 TCSDN_BENCH_BUDGET=1500

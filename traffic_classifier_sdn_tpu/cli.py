@@ -370,6 +370,35 @@ def _build_parser() -> argparse.ArgumentParser:
         "resets the chain",
     )
     p.add_argument(
+        "--openset", choices=("auto", "off"), default="off",
+        help="open-set rejection tier (serving/openset.py): wrap the "
+        "serving predict in an OpenSetGate that calibrates per-class "
+        "feature statistics from the live stream's first windows, then "
+        "serves an explicit 'unknown' label for rows whose features "
+        "sit further than the calibrated threshold from EVERY known "
+        "class — wrong-but-confident never serves. Byte-transparent "
+        "until calibration completes and on closed-world traffic "
+        "(output identical to 'off' — pinned serial+pipelined); "
+        "composes with --drift (promotions re-base the gate on the "
+        "retrain window; rejected rows never become training signal). "
+        "'auto' enables it for single-device serves (sharded serves "
+        "bind their predict at construction and are skipped)",
+    )
+    p.add_argument(
+        "--openset-margin", type=float, default=3.0, metavar="M",
+        help="open-set threshold margin: the rejection threshold is M "
+        "times the worst (max) calibration-window score, so traffic "
+        "from the calibration distribution is not rejected by "
+        "construction (default 3.0; larger = more conservative)",
+    )
+    p.add_argument(
+        "--openset-calibration-rows", type=int, default=4096,
+        metavar="N",
+        help="active labeled rows the open-set gate accumulates before "
+        "freezing its per-class statistics and arming (default 4096); "
+        "the gate is byte-transparent until then",
+    )
+    p.add_argument(
         "--drift", choices=("auto", "off"), default="off",
         help="online drift loop (serving/drift.py): monitor the live "
         "feature stream against a training-time reference, retrain in "
@@ -921,6 +950,55 @@ def _run_classify_armed(args, lock_witness) -> None:
             # gate's CURRENT ladder, not the boot object
             degrade_surface = GateLadderView(gate, degrade)
 
+    # Open-set rejection tier (serving/openset.py): the OUTERMOST
+    # predict wrapper — drift promotions hot-swap INSIDE it, so a
+    # promoted model is gated exactly like the boot model. Rows
+    # further than the calibrated threshold from every known class
+    # serve an explicit 'unknown' label; the model's class list is
+    # extended so every render path decodes the unknown index to
+    # "unknown" (never "?" and never a fabricated known class).
+    # 'auto' skips sharded serves (their predict binds at
+    # construction — the same carve-out as --drift).
+    openset = None
+    if args.openset != "off" and not sharded:
+        import dataclasses
+
+        from .models.base import ClassList
+        from .serving.openset import OpenSetGate
+
+        # a restored serving checkpoint carries the gate's armed
+        # reference (stats + threshold): the gate boots ARMED against
+        # what it served with — a restart mid-novel-episode must not
+        # re-calibrate ON the novel traffic and unlearn its rejection
+        restored_ref = getattr(engine, "feature_reference", None) or {}
+        os_keys = (
+            "openset_mean", "openset_inv_std", "openset_threshold",
+        )
+        openset = OpenSetGate(
+            predict, n_classes=len(model.classes.names),
+            margin=args.openset_margin,
+            calibration_rows=args.openset_calibration_rows,
+            metrics=m, recorder=recorder,
+            reference=(
+                {
+                    k: restored_ref[k]
+                    for k in (*os_keys, "openset_calibrated_rows")
+                    if k in restored_ref
+                }
+                if all(k in restored_ref for k in os_keys) else None
+            ),
+        )
+        predict = openset
+        model = dataclasses.replace(
+            model,
+            classes=ClassList(tuple(model.classes.names) + ("unknown",)),
+        )
+        if drift is not None:
+            # promotions re-base the gate on the retrain window, and
+            # the monitor observes the gate's labels (the unknown
+            # fraction becomes the class-mix drift signal)
+            drift.set_openset(openset)
+
     # Incremental active-set serving (serving/incremental.py): wraps
     # the FINAL predict composition (ladder- and gate-wrapped) so its
     # label cache watches the composed label_epoch — a promotion
@@ -965,6 +1043,10 @@ def _run_classify_armed(args, lock_witness) -> None:
             # label-cache coverage: how much of the table the last
             # render served from cache vs re-predicted
             health.set_label_cache(inc.status)
+        if openset is not None:
+            # the rejection tier's self-report: state, calibrated
+            # threshold, rejection counters
+            health.set_openset(openset.status)
         if lat is not None:
             # the live e2e budget: p50/p99 since emit + dominant stage
             health.set_latency(lat.status)
@@ -1029,7 +1111,8 @@ def _run_classify_armed(args, lock_witness) -> None:
                         sharded, use_native, dropped_seen=0,
                         tracer=tracer, recorder=recorder, health=health,
                         probe_out=probe_out, degrade=degrade_surface,
-                        drift=drift, inc=inc, lat=lat, usr1=usr1)
+                        drift=drift, inc=inc, lat=lat, usr1=usr1,
+                        openset=openset)
     except BaseException as e:
         # the crash-forensics moment: record the terminal exception and
         # freeze the ring — safely outside any signal-handler frame.
@@ -1085,16 +1168,26 @@ def _run_classify_armed(args, lock_witness) -> None:
 
             _sc.save(
                 engine, args.save_serve_state,
-                feature_reference=(
-                    drift.reference_arrays()
-                    if drift is not None else None
-                ),
+                feature_reference=_serving_reference(drift, openset),
             )
             print(
                 f"saved serving state ({engine.num_flows()} tracked "
                 f"flows) to {args.save_serve_state}",
                 file=sys.stderr,
             )
+
+
+def _serving_reference(drift, openset) -> dict | None:
+    """The serving checkpoint's ``feature_reference`` block: the drift
+    monitor's reference and the open-set gate's armed stats+threshold
+    ride together (either may be absent — each loop restores only its
+    own keys)."""
+    ref: dict = {}
+    if drift is not None:
+        ref.update(drift.reference_arrays() or {})
+    if openset is not None:
+        ref.update(openset.reference_arrays() or {})
+    return ref or None
 
 
 def _dump_flight(recorder, obs_dir, reason: str) -> None:
@@ -1112,7 +1205,8 @@ def _dump_flight(recorder, obs_dir, reason: str) -> None:
 
 
 def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
-                     recorder=None, health=None, drift=None) -> None:
+                     recorder=None, health=None, drift=None,
+                     openset=None) -> None:
     """Periodic in-loop serving snapshot (between ticks, state flushed).
 
     The wall-clock budget guard keeps checkpointing from starving the
@@ -1148,13 +1242,12 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
             _, nbytes = _sc.save_rotating(
                 engine, args.serve_checkpoint_dir, tick=ticks,
                 keep=args.serve_checkpoint_keep,
-                # the drift reference rides in the snapshot (format v3)
-                # so a restored serve resumes detection against the
-                # same training-time distribution
-                feature_reference=(
-                    drift.reference_arrays()
-                    if drift is not None else None
-                ),
+                # the drift reference AND the open-set gate's armed
+                # stats ride in the snapshot (format v3) so a restored
+                # serve resumes detection against the same
+                # training-time distribution and keeps rejecting at
+                # the same calibrated threshold
+                feature_reference=_serving_reference(drift, openset),
             )
     except FaultInjected:
         raise
@@ -1182,7 +1275,8 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
 def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 use_native, dropped_seen, tracer, recorder=None,
                 health=None, probe_out=None, degrade=None,
-                drift=None, inc=None, lat=None, usr1=None) -> None:
+                drift=None, inc=None, lat=None, usr1=None,
+                openset=None) -> None:
     from .utils.profiling import trace
 
     # Pipelined serving (serving/pipeline.py): the host stage (this
@@ -1364,6 +1458,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 args, engine, m, tick_base + ticks,
                                 loop_t0, recorder=recorder,
                                 health=health, drift=drift,
+                                openset=openset,
                             )
                 if args.metrics_every and ticks % args.metrics_every == 0:
                     print(m.report(), file=sys.stderr, flush=True)

@@ -245,10 +245,18 @@ def jit_serving_fn(serve_fn: Callable) -> Callable:
 class ServingFallback:
     """A degraded-rung predict: ``predict(X) -> labels`` as a plain host
     call (params baked in — the ladder has no second params slot), plus
-    the kind string the flight recorder / /healthz report."""
+    the kind string the flight recorder / /healthz report.
+
+    ``scores(X) -> (N, C)`` is the rung's score surface — the same
+    per-class scores the family's ``predict_scores`` exposes on the
+    device path (native C++: ``NativeForest.predict_proba`` /
+    ``NativeKnn.votes``), so open-set tooling keeps a score view even
+    while the serve is degraded. ``argmax(scores) == predict`` holds on
+    every rung (pinned in tests/test_model_parity.py)."""
 
     predict: Callable
     kind: str
+    scores: Callable | None = None
 
 
 def resolve_fallback(name: str, params) -> ServingFallback | None:
@@ -290,6 +298,9 @@ def resolve_fallback(name: str, params) -> ServingFallback | None:
             return ServingFallback(
                 lambda X: nf.predict(np.asarray(X, np.float32)),
                 "native-forest",
+                scores=lambda X: nf.predict_proba(
+                    np.asarray(X, np.float32)
+                ),
             )
     if name == "knn":
         from ..native import knn as native_knn
@@ -304,6 +315,7 @@ def resolve_fallback(name: str, params) -> ServingFallback | None:
             return ServingFallback(
                 lambda X: hk.predict(np.asarray(X, np.float32)),
                 "native-knn",
+                scores=lambda X: hk.votes(np.asarray(X, np.float32)),
             )
 
     import jax
@@ -327,7 +339,15 @@ def resolve_fallback(name: str, params) -> ServingFallback | None:
             fn = chunked if chunked is not None else mod.predict
             return np.asarray(fn(cpu_params, Xc))
 
-    return ServingFallback(eager_cpu, "eager-cpu")
+    def eager_cpu_scores(X):
+        # the rung's score surface; ``scores`` is unchunked — acceptable
+        # for the eval/ops consumers this serves (the hot path rejects
+        # on feature-space statistics, serving/openset.py)
+        with jax.default_device(cpu):
+            Xc = jnp_mod.asarray(np.asarray(X), jnp_mod.float32)
+            return np.asarray(mod.scores(cpu_params, Xc))
+
+    return ServingFallback(eager_cpu, "eager-cpu", scores=eager_cpu_scores)
 
 
 def make_loaded_model(name: str, params, classes) -> LoadedModel:

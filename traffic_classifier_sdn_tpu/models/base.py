@@ -7,10 +7,21 @@ Every model family is a module exposing:
   ``scores(params, X)``      (N, C)-ish per-class score matrix (model-specific
                              semantics: logits, log-probs, votes, −distances)
   ``predict(params, X)``     (N,) int32 indices into the model's class list
+  ``predict_scores(params, X)``  ``(labels, scores)`` from ONE score
+                             computation — the open-set serving surface:
+                             ``labels == argmax(scores)`` structurally
+                             (the argmax shares the family's tie order),
+                             so score-based rejection can never disagree
+                             with the label it rejects. Parity with
+                             ``predict`` is pinned per family in
+                             tests/test_model_parity.py.
 
-``predict`` is a pure function of (params, X) with static shapes — safe to
-``jax.jit``, ``vmap`` and ``shard_map`` as-is. Class *labels* (strings) never
-enter device code; ``ClassList`` decodes indices on the host.
+``predict`` and ``predict_scores`` are pure functions of (params, X) with
+static shapes — safe to ``jax.jit``, ``vmap`` and ``shard_map`` as-is. The
+native C++ evaluators expose the same score surfaces for the degrade
+rungs (``NativeForest.predict_proba``, ``NativeKnn.votes``). Class
+*labels* (strings) never enter device code; ``ClassList`` decodes indices
+on the host.
 
 This replaces the reference's per-flow ``model.predict(List[List[float]])``
 call (reference: traffic_classifier.py:104-106) with batched device-resident

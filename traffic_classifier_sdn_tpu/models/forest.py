@@ -64,3 +64,12 @@ def scores(params: Params, X: jax.Array) -> jax.Array:
 
 def predict(params: Params, X: jax.Array) -> jax.Array:
     return jnp.argmax(scores(params, X), axis=-1).astype(jnp.int32)
+
+
+def predict_scores(params: Params, X: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(labels, ensemble vote-mass scores) from ONE traversal — the
+    open-set serving surface (models/base.py protocol);
+    ``argmax(scores) == predict`` by construction. The native C++
+    walk exposes the same surface as ``NativeForest.predict_proba``."""
+    s = scores(params, X)
+    return jnp.argmax(s, axis=-1).astype(jnp.int32), s

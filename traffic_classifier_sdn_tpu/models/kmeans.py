@@ -52,3 +52,11 @@ def scores(params: Params, X: jax.Array) -> jax.Array:
 
 def predict(params: Params, X: jax.Array) -> jax.Array:
     return jnp.argmax(scores(params, X), axis=-1).astype(jnp.int32)
+
+
+def predict_scores(params: Params, X: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(cluster ids, negated-inertia scores) from ONE score
+    computation — the open-set serving surface (models/base.py
+    protocol); ``argmax(scores) == predict`` by construction."""
+    s = scores(params, X)
+    return jnp.argmax(s, axis=-1).astype(jnp.int32), s

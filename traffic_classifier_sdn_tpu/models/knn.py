@@ -180,6 +180,18 @@ def predict(params: Params, X: jax.Array, X_lo=None,
     ).astype(jnp.int32)
 
 
+def predict_scores(
+    params: Params, X: jax.Array, X_lo=None, top_k_impl: str = "sort",
+) -> tuple[jax.Array, jax.Array]:
+    """(labels, neighbor-vote scores) from ONE vote computation — the
+    open-set serving surface (models/base.py protocol);
+    ``argmax(scores) == predict`` by construction (same votes, same
+    first-max tie order). The native C++ evaluator exposes the same
+    surface as ``NativeKnn.votes``."""
+    votes = neighbor_votes(params, X, X_lo, top_k_impl=top_k_impl)
+    return jnp.argmax(votes, axis=-1).astype(jnp.int32), votes
+
+
 def predict_chunked(
     params: Params, X: jax.Array, X_lo=None, row_chunk: int = 65536,
     top_k_impl: str = "sort",

@@ -149,6 +149,17 @@ def predict(params: Params, X: jax.Array, X_lo=None) -> jax.Array:
     return jnp.argmax(scores(params, X, X_lo), axis=-1).astype(jnp.int32)
 
 
+def predict_scores(
+    params: Params, X: jax.Array, X_lo=None
+) -> tuple[jax.Array, jax.Array]:
+    """(labels, ovo vote-count scores) from ONE kernel computation —
+    the open-set serving surface (models/base.py protocol);
+    ``argmax(scores) == predict`` by construction (same votes, same
+    libsvm lowest-index tie order)."""
+    votes = scores(params, X, X_lo)
+    return jnp.argmax(votes, axis=-1).astype(jnp.int32), votes
+
+
 def predict_chunked(
     params: Params, X: jax.Array, X_lo=None, row_chunk: int = 65536
 ) -> jax.Array:

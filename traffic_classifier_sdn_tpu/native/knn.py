@@ -56,6 +56,10 @@ def _load():
         lib.tck_predict.argtypes = [
             ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
         ]
+        lib.tck_votes.restype = None
+        lib.tck_votes.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
+        ]
         _lib = lib
         return _lib
 
@@ -111,6 +115,27 @@ class NativeKnn:
             )
         out = np.empty(X.shape[0], np.int32)
         self._lib.tck_predict(
+            self._h,
+            X.ctypes.data_as(ct.c_void_p),
+            X.shape[0], X.shape[1],
+            out.ctypes.data_as(ct.c_void_p),
+        )
+        return out
+
+    def votes(self, X: np.ndarray) -> np.ndarray:
+        """(N, F) float32 features -> (N, C) int32 neighbor vote counts
+        — the score surface for the open-set / degrade-rung paths
+        (``argmax(votes) == predict``, first-max tie order, asserted in
+        tests/test_native_knn.py)."""
+        if not self._h:
+            raise RuntimeError("NativeKnn handle is closed")
+        X = np.ascontiguousarray(X, np.float32)
+        if X.ndim != 2 or X.shape[1] != self.n_features:
+            raise ValueError(
+                f"X shape {X.shape} != (N, {self.n_features})"
+            )
+        out = np.empty((X.shape[0], self.n_classes), np.int32)
+        self._lib.tck_votes(
             self._h,
             X.ctypes.data_as(ct.c_void_p),
             X.shape[0], X.shape[1],

@@ -53,6 +53,80 @@ struct Cand {
 
 }  // namespace
 
+namespace {
+
+// One query block's k-nearest vote counts — the shared core of
+// tck_predict (argmax tail) and tck_votes (raw (N, C) exposure for the
+// open-set / degrade-rung score surface). Vote semantics unchanged:
+// class counts over the k nearest, candidate order (distance asc,
+// corpus index asc).
+void knn_votes_range(const Knn *h, const float *X, uint64_t q0,
+                     uint32_t QB, uint32_t F, uint32_t *votes) {
+    const uint32_t S = h->S, C = h->C, k = h->k;
+    double acc[kQueryBlock][kChunk];
+    double xq[kQueryBlock][32];
+    Cand best[kQueryBlock][kMaxK];
+    uint32_t nbest[kQueryBlock];
+    for (uint32_t q = 0; q < QB; ++q) nbest[q] = 0;
+    for (uint32_t q = 0; q < QB; ++q)
+        for (uint32_t f = 0; f < h->F; ++f)
+            xq[q][f] = double(X[(q0 + q) * F + f]);
+    for (uint32_t c0 = 0; c0 < S; c0 += kChunk) {
+        const uint32_t CH = (S - c0 < kChunk) ? (S - c0) : kChunk;
+        for (uint32_t q = 0; q < QB; ++q)
+            std::memset(acc[q], 0, CH * sizeof(double));
+        // per-feature streaming accumulation: each column chunk is
+        // one contiguous run (prefetch-friendly; a register-blocked
+        // 12-stream variant measured 3× SLOWER here). Elementwise,
+        // no cross-lane reduction — vectorizes exactly without
+        // -ffast-math, f-order fixed per element.
+        for (uint32_t f = 0; f < h->F; ++f) {
+            const double *col = h->cols.data() + size_t(f) * S + c0;
+            for (uint32_t q = 0; q < QB; ++q) {
+                const double x = xq[q][f];
+                double *a = acc[q];
+                for (uint32_t i = 0; i < CH; ++i) {
+                    const double diff = x - col[i];
+                    a[i] += diff * diff;
+                }
+            }
+        }
+        // per query: fold this chunk into the running top-k.
+        // Ascending corpus index; a candidate EQUAL to the incumbent
+        // worst is rejected, so earlier indices win ties — the
+        // lax.top_k total order (value desc == distance asc, then
+        // index asc)
+        for (uint32_t q = 0; q < QB; ++q) {
+            Cand *b = best[q];
+            uint32_t n = nbest[q];
+            const double *a = acc[q];
+            for (uint32_t i = 0; i < CH; ++i) {
+                const double d = a[i];
+                if (n == k && !(d < b[k - 1].d)) continue;
+                // insert (d, c0+i) keeping (d asc, idx asc); equal
+                // distances: the new (larger) index goes AFTER
+                uint32_t pos = (n < k) ? n : k - 1;
+                while (pos > 0 && b[pos - 1].d > d) {
+                    b[pos] = b[pos - 1];
+                    --pos;
+                }
+                b[pos] = {d, c0 + i};
+                if (n < k) nbest[q] = ++n;
+            }
+        }
+    }
+    for (uint32_t q = 0; q < QB; ++q) {
+        uint32_t *v = votes + size_t(q) * C;
+        std::memset(v, 0, C * sizeof(uint32_t));
+        for (uint32_t j = 0; j < k; ++j) {
+            const int32_t lab = h->y[best[q][j].idx];
+            if (lab >= 0 && uint32_t(lab) < C) ++v[lab];
+        }
+    }
+}
+
+}  // namespace
+
 extern "C" {
 
 void *tck_create(uint32_t S, uint32_t F, uint32_t C, uint32_t k,
@@ -79,75 +153,37 @@ void tck_destroy(void *h) { delete static_cast<Knn *>(h); }
 void tck_predict(void *hp, const float *X, uint64_t N, uint32_t F,
                  int32_t *out) {
     const Knn *h = static_cast<const Knn *>(hp);
-    const uint32_t S = h->S, C = h->C, k = h->k;
-    double acc[kQueryBlock][kChunk];
-    double xq[kQueryBlock][32];
-    Cand best[kQueryBlock][kMaxK];
-    uint32_t nbest[kQueryBlock];
+    const uint32_t C = h->C;
     std::vector<uint32_t> votes(size_t(kQueryBlock) * C);
     for (uint64_t q0 = 0; q0 < N; q0 += kQueryBlock) {
         const uint32_t QB =
             uint32_t(N - q0 < kQueryBlock ? N - q0 : kQueryBlock);
-        for (uint32_t q = 0; q < QB; ++q) nbest[q] = 0;
-        for (uint32_t q = 0; q < QB; ++q)
-            for (uint32_t f = 0; f < h->F; ++f)
-                xq[q][f] = double(X[(q0 + q) * F + f]);
-        for (uint32_t c0 = 0; c0 < S; c0 += kChunk) {
-            const uint32_t CH = (S - c0 < kChunk) ? (S - c0) : kChunk;
-            for (uint32_t q = 0; q < QB; ++q)
-                std::memset(acc[q], 0, CH * sizeof(double));
-            // per-feature streaming accumulation: each column chunk is
-            // one contiguous run (prefetch-friendly; a register-blocked
-            // 12-stream variant measured 3× SLOWER here). Elementwise,
-            // no cross-lane reduction — vectorizes exactly without
-            // -ffast-math, f-order fixed per element.
-            for (uint32_t f = 0; f < h->F; ++f) {
-                const double *col = h->cols.data() + size_t(f) * S + c0;
-                for (uint32_t q = 0; q < QB; ++q) {
-                    const double x = xq[q][f];
-                    double *a = acc[q];
-                    for (uint32_t i = 0; i < CH; ++i) {
-                        const double diff = x - col[i];
-                        a[i] += diff * diff;
-                    }
-                }
-            }
-            // per query: fold this chunk into the running top-k.
-            // Ascending corpus index; a candidate EQUAL to the incumbent
-            // worst is rejected, so earlier indices win ties — the
-            // lax.top_k total order (value desc == distance asc, then
-            // index asc)
-            for (uint32_t q = 0; q < QB; ++q) {
-                Cand *b = best[q];
-                uint32_t n = nbest[q];
-                const double *a = acc[q];
-                for (uint32_t i = 0; i < CH; ++i) {
-                    const double d = a[i];
-                    if (n == k && !(d < b[k - 1].d)) continue;
-                    // insert (d, c0+i) keeping (d asc, idx asc); equal
-                    // distances: the new (larger) index goes AFTER
-                    uint32_t pos = (n < k) ? n : k - 1;
-                    while (pos > 0 && b[pos - 1].d > d) {
-                        b[pos] = b[pos - 1];
-                        --pos;
-                    }
-                    b[pos] = {d, c0 + i};
-                    if (n < k) nbest[q] = ++n;
-                }
-            }
-        }
+        knn_votes_range(h, X, q0, QB, F, votes.data());
         for (uint32_t q = 0; q < QB; ++q) {
-            uint32_t *v = votes.data() + size_t(q) * C;
-            std::memset(v, 0, C * sizeof(uint32_t));
-            for (uint32_t j = 0; j < k; ++j) {
-                const int32_t lab = h->y[best[q][j].idx];
-                if (lab >= 0 && uint32_t(lab) < C) ++v[lab];
-            }
+            const uint32_t *v = votes.data() + size_t(q) * C;
             uint32_t argc = 0, bv = v[0];
             for (uint32_t c = 1; c < C; ++c)
                 if (v[c] > bv) { bv = v[c]; argc = c; }  // first max wins
             out[q0 + q] = int32_t(argc);
         }
+    }
+}
+
+// X: (N, F) float32 row-major; out: (N, C) int32 neighbor vote counts
+// — the score surface (argmax with first-max ties == tck_predict).
+void tck_votes(void *hp, const float *X, uint64_t N, uint32_t F,
+               int32_t *out) {
+    const Knn *h = static_cast<const Knn *>(hp);
+    const uint32_t C = h->C;
+    std::vector<uint32_t> votes(size_t(kQueryBlock) * C);
+    for (uint64_t q0 = 0; q0 < N; q0 += kQueryBlock) {
+        const uint32_t QB =
+            uint32_t(N - q0 < kQueryBlock ? N - q0 : kQueryBlock);
+        knn_votes_range(h, X, q0, QB, F, votes.data());
+        for (uint32_t q = 0; q < QB; ++q)
+            for (uint32_t c = 0; c < C; ++c)
+                out[(q0 + q) * C + c] =
+                    int32_t(votes[size_t(q) * C + c]);
     }
 }
 

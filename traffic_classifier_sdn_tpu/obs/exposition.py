@@ -124,6 +124,7 @@ class HealthState:
         self._probe = None
         self._degrade = None
         self._drift = None
+        self._openset = None
         self._label_cache = None
         self._sources = None
         self._latency = None
@@ -161,6 +162,15 @@ class HealthState:
         operator (and the alerting rule) what actually needs attention."""
         with self._lock:
             self._degrade = status_fn
+
+    def set_openset(self, status_fn) -> None:
+        """``status_fn() -> dict`` (serving/openset.OpenSetGate
+        .status): the open-set rejection tier's self-report — state
+        (CALIBRATING/ARMED), the calibrated threshold and margin, and
+        the rejection counters — folded into /healthz as an
+        ``openset`` object."""
+        with self._lock:
+            self._openset = status_fn
 
     def set_label_cache(self, status_fn) -> None:
         """``status_fn() -> dict`` (serving/incremental.IncrementalLabels
@@ -225,6 +235,7 @@ class HealthState:
             probe = self._probe
             degrade = self._degrade
             drift = self._drift
+            openset = self._openset
             label_cache = self._label_cache
             sources = self._sources
             latency = self._latency
@@ -304,6 +315,11 @@ class HealthState:
                 report["drift"] = drift()
             except Exception as e:  # noqa: BLE001 — health must not crash
                 report["drift"] = {"state": "unknown", "error": str(e)}
+        if openset is not None:
+            try:
+                report["openset"] = openset()
+            except Exception as e:  # noqa: BLE001 — health must not crash
+                report["openset"] = {"state": "unknown", "error": str(e)}
         if label_cache is not None:
             try:
                 report["label_cache"] = label_cache()

@@ -123,10 +123,28 @@ class DriftMonitor:
 
     ``reference`` seeds a previously persisted reference (the serving
     checkpoint's ``feature_reference`` block: ``mean``, ``std``,
-    ``class_freq``, ``count`` arrays); without one, the first
+    ``class_freq``, ``count`` arrays, and — since the open-set tier —
+    optional ``class_mean``/``class_std``/``class_count`` per-class
+    per-feature statistics); without one, the first
     ``calibration_windows`` non-empty windows calibrate it from the
     live stream.
+
+    Open-world labels: observed labels may carry the ``unknown`` index
+    ``n_classes`` (serving/openset.OpenSetGate rejections). The class
+    mix tracks ``n_classes + 1`` slots — a surge in the unknown
+    fraction IS a class-mix drift signal, attributed as the ``unknown``
+    class — while the per-class feature statistics and the reference
+    freeze EXCLUDE unknown rows (a rejected row has no trustworthy
+    class to teach).
+
+    Attribution: every scored window's report carries an
+    ``attribution`` block — the top-k per-feature z-shift contributors
+    and the top per-class frequency deltas, plus the score
+    decomposition — so a trip names WHAT moved, not just that
+    something did.
     """
+
+    ATTRIBUTION_TOP_K = 3
 
     def __init__(self, n_features: int = 12, n_classes: int = 2, *,
                  window: int = 8, threshold: float = 4.0, trips: int = 3,
@@ -150,14 +168,25 @@ class DriftMonitor:
         self.score = 0.0
         self.over_streak = 0
         self._obs = 0
+        # class-mix slots: n_classes known classes + one ``unknown``
+        # slot (index n_classes) for open-set rejections
+        self._n_mix = self.n_classes + 1
         self._wsum = np.zeros(self.n_features, np.float64)
         self._wsumsq = np.zeros(self.n_features, np.float64)
-        self._wclass = np.zeros(self.n_classes, np.float64)
+        self._wclass = np.zeros(self._n_mix, np.float64)
         self._wrows = 0
         self._ewma: np.ndarray | None = None
         self._cal_sum = np.zeros(self.n_features, np.float64)
         self._cal_sumsq = np.zeros(self.n_features, np.float64)
-        self._cal_class = np.zeros(self.n_classes, np.float64)
+        self._cal_class = np.zeros(self._n_mix, np.float64)
+        # per-class per-feature calibration moments (unknown excluded)
+        self._cal_class_sum = np.zeros(
+            (self.n_classes, self.n_features), np.float64
+        )
+        self._cal_class_sumsq = np.zeros(
+            (self.n_classes, self.n_features), np.float64
+        )
+        self._cal_class_rows = np.zeros(self.n_classes, np.float64)
         self._cal_rows = 0
         self._cal_windows = 0
         self._res: collections.deque = collections.deque()
@@ -174,19 +203,41 @@ class DriftMonitor:
         ref["count"] = np.asarray(
             reference.get("count", 0.0), np.float64
         )
+        # pre-open-set references carry n_classes mix slots; pad the
+        # unknown slot with 0 (no rejections were possible then)
+        if ref["class_freq"].shape == (self.n_classes,):
+            ref["class_freq"] = np.concatenate(
+                [ref["class_freq"], np.zeros(1, np.float64)]
+            )
         # every shape checked HERE, at construction: a reference
         # persisted by a serve with a different feature/class layout
         # must fail loudly at startup, never as a broadcast error in
         # the middle of a window close
         for key, want in (("mean", (self.n_features,)),
                           ("std", (self.n_features,)),
-                          ("class_freq", (self.n_classes,))):
+                          ("class_freq", (self._n_mix,))):
             if ref[key].shape != want:
                 raise ValueError(
                     f"feature_reference {key} shape {ref[key].shape} "
                     f"!= {want} — the persisted reference belongs to a "
                     f"different model layout"
                 )
+        # optional per-class per-feature stats (the open-set tier's
+        # reference; absent in older checkpoints)
+        for key, want in (
+            ("class_mean", (self.n_classes, self.n_features)),
+            ("class_std", (self.n_classes, self.n_features)),
+            ("class_count", (self.n_classes,)),
+        ):
+            if key in reference:
+                arr = np.asarray(reference[key], np.float64)
+                if arr.shape != want:
+                    raise ValueError(
+                        f"feature_reference {key} shape {arr.shape} "
+                        f"!= {want} — the persisted reference belongs "
+                        f"to a different model layout"
+                    )
+                ref[key] = arr
         return ref
 
     @property
@@ -211,12 +262,29 @@ class DriftMonitor:
         if X.shape[0]:
             self._wsum += X.sum(axis=0)
             self._wsumsq += np.square(X).sum(axis=0)
+            # labels may carry the unknown index n_classes (open-set
+            # rejections) — it gets its own mix slot
             labels = np.clip(
-                y.astype(np.int64), 0, self.n_classes - 1
+                y.astype(np.int64), 0, self.n_classes
             )
             self._wclass += np.bincount(
-                labels, minlength=self.n_classes
-            )[: self.n_classes]
+                labels, minlength=self._n_mix
+            )[: self._n_mix]
+            if self._ref is None:
+                # per-class calibration moments — KNOWN rows only (a
+                # rejected row has no trustworthy class to teach)
+                known = labels < self.n_classes
+                if known.any():
+                    np.add.at(
+                        self._cal_class_sum, labels[known], X[known]
+                    )
+                    np.add.at(
+                        self._cal_class_sumsq, labels[known],
+                        np.square(X[known]),
+                    )
+                    np.add.at(
+                        self._cal_class_rows, labels[known], 1.0
+                    )
             self._wrows += int(X.shape[0])
             self._res.append(
                 (X.astype(np.float32), y.astype(np.int32))
@@ -268,20 +336,40 @@ class DriftMonitor:
             else a * self._ewma + (1.0 - a) * mean
         )
         ref_std = np.maximum(self._ref["std"], self.eps)
-        z = float(np.max(
-            np.abs(self._ewma - self._ref["mean"]) / ref_std
-        ))
+        zs = np.abs(self._ewma - self._ref["mean"]) / ref_std
+        z = float(np.max(zs))
         # class-mix shift scaled so it CAN trip the default threshold:
         # the max frequency delta is 1.0, so the score ceiling is
         # 1/class_tolerance — the default 0.2 puts a full label-mix
         # inversion at 5.0, above the default threshold 4.0 (a
         # tolerance of threshold⁻¹ or larger would make this signal
         # mathematically inert)
-        c = float(
-            np.max(np.abs(freq - self._ref["class_freq"]))
-        ) / self.class_tolerance
+        class_deltas = freq - self._ref["class_freq"]
+        c = float(np.max(np.abs(class_deltas))) / self.class_tolerance
         self.score = max(z, c)
         report["score"] = self.score
+        # attribution: WHAT moved, not just that something did — the
+        # top-k per-feature z contributors and per-class frequency
+        # deltas, plus the score decomposition. Index n_classes in the
+        # class list is the open-set ``unknown`` slot.
+        k = self.ATTRIBUTION_TOP_K
+        feat_order = np.argsort(zs)[::-1][:k]
+        class_order = np.argsort(np.abs(class_deltas))[::-1][:k]
+        report["attribution"] = {
+            "z_score": z,
+            "class_score": c,
+            "dominant": "feature" if z >= c else "class",
+            "features": [
+                (int(i), float(zs[i])) for i in feat_order
+            ],
+            "classes": [
+                (int(i), float(class_deltas[i])) for i in class_order
+            ],
+            # the FULL per-slot vector: gauge publication must refresh
+            # every class every window — a class that left the top-k
+            # must not keep its stale high gauge forever
+            "all_class_deltas": [float(d) for d in class_deltas],
+        }
         if self.score > self.threshold:
             self.over_streak += 1
             report["over"] = True
@@ -295,11 +383,22 @@ class DriftMonitor:
         rows = self._cal_rows
         mean = self._cal_sum / rows
         var = np.maximum(self._cal_sumsq / rows - mean * mean, 0.0)
+        # per-class stats from the same calibration windows (unknown
+        # rows excluded at accumulation); empty classes are inert —
+        # zero mean, eps std
+        crows = np.maximum(self._cal_class_rows, 1.0)[:, None]
+        cmean = self._cal_class_sum / crows
+        cvar = np.maximum(
+            self._cal_class_sumsq / crows - cmean * cmean, 0.0
+        )
         self._ref = {
             "mean": mean,
             "std": np.sqrt(var),
             "class_freq": self._cal_class / rows,
             "count": np.float64(rows),
+            "class_mean": cmean,
+            "class_std": np.sqrt(cvar),
+            "class_count": self._cal_class_rows.copy(),
         }
 
     def reset_streak(self) -> None:
@@ -307,17 +406,41 @@ class DriftMonitor:
 
     def reservoir_window(self) -> tuple[np.ndarray, np.ndarray] | None:
         """The recent labeled window as ``(X, y)`` — the retrainer's
-        training set. None when nothing has been observed."""
+        training set (labels may include the unknown index; the
+        controller filters before fitting). None when nothing has been
+        observed."""
         if not self._res:
             return None
         X = np.concatenate([x for x, _ in self._res], axis=0)
         y = np.concatenate([y_ for _, y_ in self._res], axis=0)
         return X, y
 
+    def known_reservoir_window(
+        self,
+    ) -> tuple[np.ndarray, np.ndarray] | None:
+        """``reservoir_window`` restricted to rows with a KNOWN class
+        label — what the retrainer fits on and what the per-class
+        reference/open-set rebase learns from. A rejected (unknown)
+        row has no trustworthy label: teaching it to any class would
+        fold the novel traffic into the known world, which is exactly
+        how a promoted model would FORGET to reject it."""
+        window = self.reservoir_window()
+        if window is None:
+            return None
+        X, y = window
+        known = y.astype(np.int64) < self.n_classes
+        if not int(known.sum()):
+            return None
+        return X[known], y[known]
+
     def rebase_from_reservoir(self) -> bool:
         """Re-reference onto the retrain window's own statistics after a
         promotion: the new model's 'training-time' distribution IS that
-        window, so drift detection continues relative to it. Resets the
+        window, so drift detection continues relative to it. Global
+        feature stats and the class mix fold in EVERY reservoir row
+        (the unknown fraction becomes the new baseline — sustained
+        novel traffic stops re-tripping); the per-class stats fold in
+        KNOWN rows only, so rejection survives the rebase. Resets the
         EWMA, streak, and score."""
         window = self.reservoir_window()
         if window is None:
@@ -325,10 +448,10 @@ class DriftMonitor:
         X, y = window
         Xf = np.asarray(X, np.float64)
         mean = Xf.mean(axis=0)
-        labels = np.clip(y.astype(np.int64), 0, self.n_classes - 1)
+        labels = np.clip(y.astype(np.int64), 0, self.n_classes)
         freq = (
-            np.bincount(labels, minlength=self.n_classes)[
-                : self.n_classes
+            np.bincount(labels, minlength=self._n_mix)[
+                : self._n_mix
             ].astype(np.float64) / max(1, Xf.shape[0])
         )
         self._ref = {
@@ -337,6 +460,17 @@ class DriftMonitor:
             "class_freq": freq,
             "count": np.float64(Xf.shape[0]),
         }
+        # per-class moments through the ONE batch-window home
+        # (serving/openset.class_reference — it excludes unknown rows
+        # by the same rule); the streaming accumulators in observe/
+        # _freeze_reference genuinely need their own incremental code,
+        # this full-window path does not
+        from .openset import class_reference
+
+        cref = class_reference(Xf, labels, self.n_classes)
+        self._ref["class_mean"] = cref["class_mean"]
+        self._ref["class_std"] = cref["class_std"]
+        self._ref["class_count"] = cref["class_count"]
         self._ewma = None
         self.over_streak = 0
         self.score = 0.0
@@ -506,11 +640,31 @@ class DriftController:
                  reference: dict | None = None, build_serving=None,
                  fit_kwargs: dict | None = None, metrics=None,
                  recorder=None, health=None, clock=time.monotonic,
-                 boot_params=None):
+                 boot_params=None, feature_names=None):
         self._gate = gate
         self._family = family
         self._classes = tuple(classes)
         self._directory = directory
+        # open-set composition — wired POST-construction via
+        # set_openset (the OpenSetGate wraps the DriftGate, so it
+        # cannot exist before the controller): the gate to re-base at
+        # each promotion, and its capture as the observation source so
+        # the monitor sees the ``unknown`` relabels as the (C+1)th
+        # mix slot. One wiring point keeps the pair consistent.
+        self._openset = None
+        self._capture_source = None
+        # display names for attribution: known classes + the open-set
+        # unknown slot; feature names fall back to column indices
+        self._mix_names = self._classes + ("unknown",)
+        if feature_names is None and int(n_features) == 12:
+            from ..core.features import FEATURE_COLUMNS_12
+
+            feature_names = FEATURE_COLUMNS_12
+        self._feature_names = (
+            tuple(feature_names) if feature_names is not None
+            else tuple(str(i) for i in range(int(n_features)))
+        )
+        self._attribution: dict | None = None
         self.probe_successes = max(1, int(probe_successes))
         self.parity_min = float(parity_min)
         if parity_mode not in ("exact", "mode-matched"):
@@ -603,6 +757,19 @@ class DriftController:
         with self._lock:
             self._health = health
 
+    def set_openset(self, gate) -> None:
+        """Wire the outermost OpenSetGate (cli.py constructs it AFTER
+        the controller — the gate wraps the DriftGate, so it cannot
+        exist first): promotions re-base the gate's reference onto the
+        retrain window, and observation consumes the gate's capture so
+        the monitor sees the ``unknown`` relabels. The ONE wiring
+        point — rebase target, capture source, and the gate's capture
+        opt-in always move together."""
+        gate.enable_capture()
+        with self._lock:
+            self._openset = gate
+            self._capture_source = gate.take_capture
+
     def status(self) -> dict:
         """The /healthz self-report (obs.HealthState.set_drift)."""
         with self._lock:
@@ -610,6 +777,11 @@ class DriftController:
                 "state": self._state,
                 "gauge": STATE_GAUGE[self._state],
                 "score": self._score,
+                # why the score is what it is: top z-shift features,
+                # top class-mix deltas (unknown slot included), and
+                # the score decomposition — an operator reads WHY the
+                # monitor tripped without tailing the ring
+                "attribution": self._attribution,
                 "windows": self._counts["windows"],
                 "window_errors": self._counts["window_errors"],
                 "retrain_runs": self._counts["retrain_runs"],
@@ -643,7 +815,14 @@ class DriftController:
         produced — off the hot path. NEVER raises: every failure mode is
         absorbed and counted (the serve loop must not die of its own
         self-updating machinery)."""
-        cap = self._gate.take_capture()
+        gate_cap = self._gate.take_capture()
+        if self._capture_source is not None:
+            # the openset gate is the outermost wrapper: observe ITS
+            # labels (unknown relabels included); the drift gate's own
+            # capture is drained above so it never pins a stale tick
+            cap = self._capture_source()
+        else:
+            cap = gate_cap
         report = self._observe(cap) if cap is not None else None
         if self.state == RETRAINING:
             self._check_retrain()
@@ -709,21 +888,85 @@ class DriftController:
             if report is not None:
                 self._counts["windows"] += 1
                 self._score = report["score"]
+                if report.get("attribution") is not None:
+                    self._attribution = self._name_attribution(
+                        report["attribution"]
+                    )
         if report is not None:
             if self._metrics is not None:
                 self._metrics.set("drift_score", report["score"])
                 self._metrics.inc("drift_windows")
+                attribution = report.get("attribution")
+                if attribution is not None:
+                    # per-class attribution gauges: the live |Δfreq|
+                    # per mix slot (unknown included), scaled like the
+                    # class score so the gauge is threshold-comparable.
+                    # EVERY slot refreshes every scored window — a
+                    # class that recovered must read ~0, not its last
+                    # top-k value
+                    for ci, delta in enumerate(
+                        attribution["all_class_deltas"]
+                    ):
+                        name = self._mix_names[ci] if ci < len(
+                            self._mix_names
+                        ) else str(ci)
+                        self._metrics.set(
+                            f"drift_attribution_{name}",
+                            abs(delta) / self._monitor.class_tolerance,
+                        )
             if report["over"] and self._recorder is not None:
                 self._recorder.record(
                     "drift.window", window=report["window"],
                     score=report["score"],
                     streak=self._monitor.over_streak,
+                    attribution=self._name_attribution(
+                        report.get("attribution")
+                    ),
                 )
         return report
 
+    def _name_attribution(self, attribution) -> dict | None:
+        """The monitor's index-based attribution with class/feature
+        names resolved — what /healthz, the ring, and the transition
+        log carry (an operator reads ``voice``/``Delta Forward
+        Bytes``, not slot numbers)."""
+        if attribution is None:
+            return None
+        def fname(i: int) -> str:
+            return (
+                self._feature_names[i]
+                if i < len(self._feature_names) else str(i)
+            )
+        def cname(i: int) -> str:
+            return (
+                self._mix_names[i] if i < len(self._mix_names)
+                else str(i)
+            )
+        return {
+            "z_score": round(attribution["z_score"], 6),
+            "class_score": round(attribution["class_score"], 6),
+            "dominant": attribution["dominant"],
+            "top_class": cname(attribution["classes"][0][0])
+            if attribution["classes"] else None,
+            "top_feature": fname(attribution["features"][0][0])
+            if attribution["features"] else None,
+            "features": [
+                {"feature": fname(i), "z": round(z, 6)}
+                for i, z in attribution["features"]
+            ],
+            "classes": [
+                {"class": cname(i), "delta": round(d, 6)}
+                for i, d in attribution["classes"]
+            ],
+        }
+
     # -- retrain -----------------------------------------------------------
     def _start_retrain(self, report: dict) -> None:
-        window = self._monitor.reservoir_window()
+        # KNOWN-labeled rows only: an open-set rejection must never
+        # become training signal (teaching the novel class to a known
+        # label is exactly how the promoted model would stop rejecting
+        # it)
+        window = self._monitor.known_reservoir_window()
         n_classes = len(self._classes)
         if window is None or window[0].shape[0] < self.min_retrain_rows \
                 or np.unique(window[1]).size < min(2, n_classes):
@@ -848,7 +1091,16 @@ class DriftController:
             if got.shape[:1] != ys.shape[:1]:
                 ok, agree, detail = False, 0.0, "shape-mismatch"
             else:
-                agree = self._agreement(got[mask], np.asarray(ys)[mask])
+                ysm = np.asarray(ys)[mask]
+                gotm = got[mask]
+                # open-world shadows: rows the openset gate rejected
+                # carry the unknown index — a closed-world candidate
+                # can never reproduce it, so parity judges KNOWN rows
+                # only (an all-unknown shadow judges nothing)
+                known = ysm.astype(np.int64) < len(self._classes)
+                if not int(known.sum()):
+                    return
+                agree = self._agreement(gotm[known], ysm[known])
                 ok = agree >= self.parity_min
                 detail = f"agree={agree:.4f}"
         if self._recorder is not None:
@@ -927,7 +1179,17 @@ class DriftController:
         self._count("promotions", metric="promotions")
         if health is not None:
             health.model_promoted()
+        # the rebase pair: the monitor re-references onto the retrain
+        # window, and the open-set gate re-bases its per-class stats +
+        # threshold onto the SAME window's known-labeled rows — the
+        # promoted model keeps rejecting what it was never taught
+        # (rejected rows are in neither the fit nor the stats). Both
+        # are absorbing: a promotion that landed never un-lands.
         self._monitor.rebase_from_reservoir()
+        if self._openset is not None:
+            known = self._monitor.known_reservoir_window()
+            if known is not None:
+                self._openset.rebase(known[0], known[1])
         retrain.prune_candidates(self._directory, keep=self.keep)
         self._transition(
             PROMOTED, f"promoted:{os.path.basename(path)}"
@@ -1011,13 +1273,26 @@ class DriftController:
             if frm == to:
                 return
             self._state = to
+            # divergence transitions carry WHY: the responsible
+            # class/feature rides the event, so a ring tail (or the
+            # post-mortem dump) names the mover without correlation
+            attribution = (
+                self._attribution if to in (DRIFTING, RETRAINING)
+                else None
+            )
         if self._metrics is not None:
             self._metrics.inc("drift_transitions")
             self._metrics.set("drift_state", STATE_GAUGE[to])
         if self._recorder is not None:
-            self._recorder.record(
-                "drift.transition", frm=frm, to=to, reason=reason
-            )
+            if attribution is not None:
+                self._recorder.record(
+                    "drift.transition", frm=frm, to=to, reason=reason,
+                    attribution=attribution,
+                )
+            else:
+                self._recorder.record(
+                    "drift.transition", frm=frm, to=to, reason=reason
+                )
         print(
             f"DRIFT: {frm} -> {to} ({reason})", file=sys.stderr,
             flush=True,

@@ -1,0 +1,577 @@
+"""Open-set rejection: calibrated unknown-class detection on the
+serving path.
+
+The reference's contract is a closed 6-class world
+(``dns, game, ping, quake, telnet, voice`` — PAPER.md), but production
+traffic is dominated by classes the model has never seen, and a closed
+argmax serves every unseen flow a confident wrong label. This module is
+the serve path's "none of the above": an ``OpenSetGate`` wraps the
+final predict composition (ladder- and drift-gate-wrapped) and relabels
+rows whose features sit too far from EVERY known class as an explicit
+``unknown`` — never a stale or fabricated known class.
+
+Score and threshold
+-------------------
+
+The rejection score is feature-space, not model-space: for per-class
+per-feature reference statistics (mean ``μ_cf``, std ``σ_cf`` — the
+same shape of statistics the drift monitor keeps, serving/drift.py),
+
+    d(x, c) = sqrt( mean_f ((x_f − μ_cf) / max(σ_cf, floor_f))² )
+    score(x) = min_c d(x, c)
+
+— a diagonal Mahalanobis distance to the nearest known class. Being
+feature-space it works identically on EVERY serving rung (device
+kernel, native C++ fallback, stale-label BROKEN rung) and for every
+family; the per-family ``predict_scores`` surfaces (models/base.py)
+remain the model-space view for eval and operators
+(tools/bench_openset.py publishes both). ``floor_f`` guards
+near-constant features: a within-class std below 5% of the feature's
+global calibration std is floored there, so counter jitter cannot
+manufacture rejections.
+
+Calibration is from the live stream's first windows — the same
+first-windows discipline the drift monitor uses: the gate stays
+byte-transparent while it accumulates ``calibration_rows`` active
+labeled rows, then freezes per-class stats and sets
+
+    threshold = margin × max(calibration scores)
+
+so traffic from the calibration distribution is, by construction, not
+rejected (``--openset auto`` output is byte-identical to ``--openset
+off`` on closed-world traffic — pinned serial + pipelined,
+``--incremental auto/off``). On a drift promotion the controller
+re-bases the gate onto the retrain window's KNOWN-labeled rows
+(``rebase``) exactly like it re-bases the monitor's reference — and
+because rejected rows never re-enter the retrain window or the class
+stats, a promoted model still rejects what it was never taught.
+
+Composition
+-----------
+
+The gate is the OUTERMOST predict wrapper (cli.py): promotions hot-swap
+inside it, the incremental label cache wraps outside it and watches
+``label_epoch`` (any calibration freeze or rebase bumps the gate's own
+epoch, so wrong-but-cached closed-world labels never survive an arming
+or a threshold move). The drift controller consumes the gate's capture
+(``take_capture``) instead of the drift gate's, so the monitor sees the
+``unknown`` labels as a (C+1)th class — an unknown-fraction surge IS
+the class-mix drift signal, attributed as class ``unknown``.
+
+Fault sites (utils/faults.SITES), both ABSORBED:
+
+- ``openset.score`` — the per-tick scoring fails: that tick serves the
+  inner (closed-world) labels fresh; never a fabricated ``unknown``.
+- ``openset.calibrate`` — a calibration/rebase update fails: the
+  sample is dropped (calibration just takes longer; a failed rebase
+  keeps the previous stats), telemetry and labels are never touched.
+
+Threading: predict calls arrive from one thread at a time (the serve
+loop / device-stage worker, like DriftGate); ``status()`` may be read
+concurrently from the exposition thread. Shared state is guarded by
+``_lock``, never held across a predict or a device sync.
+
+Compile discipline: the device relabel program is built once and
+jit's shape-keyed cache handles re-traces (a new present-class count
+after a rebase, a new dirty-bucket shape under incremental serving).
+Each first-use-of-a-shape compiles on the HOST stage at the tick that
+hits it — outside the DeviceWatchdog's dispatch (the gate wraps the
+ladder, not the reverse), so a compile can never trip a spurious
+degrade; it costs that one tick latency, the same
+lazily-compiled-path behavior every un-warmed program in the repo
+has. The stats' float32 device copies are cached per epoch — no
+per-tick upload.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..utils import faults
+
+CALIBRATING = "CALIBRATING"
+ARMED = "ARMED"
+
+# the openset_state gauge encoding (docs/OBSERVABILITY.md)
+STATE_GAUGE = {CALIBRATING: 0, ARMED: 1}
+
+_STD_FLOOR_FRAC = 0.05  # per-class std floor, as a fraction of global std
+_EPS = 1e-9
+
+
+def class_reference(X, y, n_classes: int, eps: float = _EPS) -> dict:
+    """Per-class per-feature reference statistics from a labeled window:
+    ``{"class_mean": (C, F), "class_std": (C, F), "class_count": (C,)}``
+    (float64). Rows labeled outside ``[0, n_classes)`` — the ``unknown``
+    index included — are EXCLUDED: an unknown row has no trustworthy
+    class to teach. Classes with no rows get zero mean and ``eps`` std
+    (inert: nothing is near them, so they never win the min)."""
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y).astype(np.int64).ravel()[: X.shape[0]]
+    mean = np.zeros((n_classes, X.shape[1]), np.float64)
+    std = np.full((n_classes, X.shape[1]), eps, np.float64)
+    count = np.zeros(n_classes, np.float64)
+    for c in range(n_classes):
+        rows = X[y == c]
+        count[c] = rows.shape[0]
+        if rows.shape[0]:
+            mean[c] = rows.mean(axis=0)
+            std[c] = rows.std(axis=0)
+    return {"class_mean": mean, "class_std": std, "class_count": count}
+
+
+def floored_std(class_std: np.ndarray, global_std: np.ndarray,
+                eps: float = _EPS) -> np.ndarray:
+    """The score denominator: per-class std floored at
+    ``_STD_FLOOR_FRAC`` of the global per-feature std (and ``eps``
+    absolutely) — near-constant features can't turn jitter into
+    rejections, while a feature that is constant EVERYWHERE still
+    rejects genuinely novel values."""
+    return np.maximum(
+        np.maximum(class_std, _STD_FLOOR_FRAC * global_std[None, :]),
+        eps,
+    )
+
+
+def reference_matrices(
+    ref: dict, global_std: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """``(mean, inv_std)`` scoring matrices from a ``class_reference``
+    dict: EMPTY classes are dropped, not floored — a class the
+    calibration window never saw would otherwise become a phantom
+    acceptance basin at the origin (mean 0, std floored to 5% of the
+    global std), silently accepting exactly the low-rate novel traffic
+    the gate exists to reject. None when NO class has rows (nothing to
+    measure distance to — the caller must not arm)."""
+    present = ref["class_count"] > 0
+    if not present.any():
+        return None
+    mean = ref["class_mean"][present]
+    inv_std = 1.0 / floored_std(ref["class_std"][present], global_std)
+    return mean, inv_std
+
+
+def openset_scores(X, mean, inv_std) -> np.ndarray:
+    """(N,) min-over-classes diagonal Mahalanobis RMS distance — the
+    ONE home of the score expression. The jitted device path in
+    ``OpenSetGate`` mirrors it term for term in float32 (device
+    dtype): labels can differ from this float64 host path only for a
+    score within f32 epsilon of the threshold — ~7 orders of magnitude
+    inside the default margin of 3×, so the paths agree on every row
+    that isn't an exact threshold tie (tests pin equality on
+    representative data)."""
+    X = np.asarray(X, np.float64)
+    best = None
+    for c in range(mean.shape[0]):
+        z = (X - mean[c][None, :]) * inv_std[c][None, :]
+        d = np.mean(z * z, axis=-1)
+        best = d if best is None else np.minimum(best, d)
+    return np.sqrt(best)
+
+
+class OpenSetGate:
+    """The outermost predict wrapper: closed-world labels in, open-set
+    labels out (``unknown_index == n_classes`` for rejected rows).
+
+    Byte-transparent until calibration completes, and on every fault
+    path after it — a scoring failure serves that tick's inner labels
+    fresh. ``host_native`` mirrors the wrapped predict so the serve
+    loop's routing is unchanged.
+    """
+
+    def __init__(self, predict, n_classes: int, *, margin: float = 3.0,
+                 calibration_rows: int = 4096,
+                 metrics=None, recorder=None, reference: dict | None = None):
+        if n_classes < 1:
+            raise ValueError("n_classes must be >= 1")
+        if margin <= 0:
+            raise ValueError("margin must be > 0")
+        self.host_native = bool(getattr(predict, "host_native", False))
+        self.n_classes = int(n_classes)
+        self.unknown_index = int(n_classes)
+        self.margin = float(margin)
+        self.calibration_rows = max(1, int(calibration_rows))
+        self._inner = predict
+        self._metrics = metrics
+        self._recorder = recorder
+        self._lock = threading.Lock()
+        self._state = CALIBRATING
+        self._epoch = 0
+        # calibration accumulators (host; dropped at freeze) + the
+        # one-tick-deferred (X, labels) pair awaiting materialization
+        self._cal_X: list[np.ndarray] = []
+        self._cal_y: list[np.ndarray] = []
+        self._cal_rows = 0
+        self._pending_cal: tuple | None = None
+        # armed stats (compacted — present classes only)
+        self._mean: np.ndarray | None = None  # (P, F) f64
+        self._inv_std: np.ndarray | None = None  # (P, F) f64
+        self._threshold = float("inf")
+        self._calibrated_at_rows = 0
+        # device-path mirrors of the armed stats, cached per epoch so
+        # the hot path never re-uploads them tick after tick
+        self._device_stats: tuple | None = None  # (epoch, mean32, inv32)
+        # counters / capture (capture is OPT-IN: without a drift
+        # controller draining it, holding the last tick's full feature
+        # matrix by reference would pin device memory for nothing)
+        self._rejections = 0
+        self._last_rejected = 0
+        self._score_faults = 0
+        self._calibrate_faults = 0
+        self._capture = None
+        self._capture_enabled = False
+        self._pending_count = None  # device-path lazy rejection count
+        self._reject_jit = None  # built once, shape-keyed by jit
+        if metrics is not None:
+            metrics.set("openset_state", STATE_GAUGE[CALIBRATING])
+        if reference is not None:
+            # a persisted reference (serving-checkpoint round-trip):
+            # the gate boots ARMED against the SAME stats + threshold
+            # it served with — a serve restarted mid-novel-episode
+            # must not re-calibrate on the novel traffic and unlearn
+            # its rejection
+            self._seed_reference(reference)
+
+    def _seed_reference(self, reference: dict) -> None:
+        mean = np.asarray(reference["openset_mean"], np.float64)
+        inv_std = np.asarray(reference["openset_inv_std"], np.float64)
+        threshold = float(np.asarray(reference["openset_threshold"]))
+        rows = int(np.asarray(reference.get(
+            "openset_calibrated_rows", 0
+        )))
+        if (mean.ndim != 2 or mean.shape != inv_std.shape
+                or not mean.shape[0]):
+            raise ValueError(
+                f"openset reference shapes {mean.shape} / "
+                f"{inv_std.shape} are not a (present_classes, "
+                f"features) pair — the persisted reference belongs to "
+                f"a different layout"
+            )
+        with self._lock:
+            self._mean = mean
+            self._inv_std = inv_std
+            self._threshold = threshold
+            self._calibrated_at_rows = rows
+            self._state = ARMED
+            self._epoch += 1
+        if self._metrics is not None:
+            self._metrics.set("openset_state", STATE_GAUGE[ARMED])
+
+    def reference_arrays(self) -> dict | None:
+        """The armed scoring reference as a flat name→array dict — the
+        serving checkpoint's ``feature_reference`` block carries it
+        beside the drift monitor's stats (io/serving_checkpoint.save),
+        and a restored serve seeds it back via ``reference=``. None
+        while calibrating."""
+        with self._lock:
+            if self._state != ARMED:
+                return None
+            return {
+                "openset_mean": np.array(self._mean),
+                "openset_inv_std": np.array(self._inv_std),
+                "openset_threshold": np.float64(self._threshold),
+                # provenance for /healthz: a restored gate reports the
+                # window it was ORIGINALLY calibrated on, not 0
+                "openset_calibrated_rows": np.float64(
+                    self._calibrated_at_rows
+                ),
+            }
+
+    # -- predict surface ---------------------------------------------------
+    def __call__(self, params, X):
+        labels = self._inner(params, X)
+        self._drain_pending_count()
+        with self._lock:
+            armed = self._state == ARMED
+            # previous tick's calibration pair: by now its device
+            # labels have long since materialized, so folding it here
+            # costs no fresh host↔device sync on the serve path (the
+            # same one-tick-lazy discipline as _drain_pending_count);
+            # arming drops any leftover pair (stats are frozen)
+            pending, self._pending_cal = self._pending_cal, None
+        if not armed:
+            if pending is not None:
+                self._calibrate_tick(*pending)
+            with self._lock:
+                # re-check: folding the pending pair may just have
+                # armed the gate — then this tick's pair has nothing
+                # left to teach
+                if self._state != ARMED:
+                    self._pending_cal = (X, labels)
+            out = labels
+        else:
+            out = self._apply(X, labels)
+        with self._lock:
+            if self._capture_enabled:
+                self._capture = (X, out)
+        return out
+
+    def enable_capture(self) -> None:
+        """Opt in to per-tick ``(X, labels)`` capture — called by the
+        drift controller's ``set_openset`` wiring. Without a consumer
+        the gate records nothing: a by-reference capture would pin the
+        last tick's full feature matrix for nobody."""
+        with self._lock:
+            self._capture_enabled = True
+
+    def take_capture(self):
+        """The newest ``(X, labels)`` pair — labels INCLUDING any
+        ``unknown`` relabels — consumed (None when no predict ran since
+        the last take). The drift controller observes through this so
+        the monitor's class mix carries the unknown fraction."""
+        with self._lock:
+            cap = self._capture
+            self._capture = None
+            return cap
+
+    @property
+    def label_epoch(self) -> tuple:
+        """Composed label-source epoch for the incremental cache
+        (serving/incremental.py): the gate's own epoch (bumped at
+        calibration freeze and every rebase — both change what a row's
+        label MEANS) plus the inner composition's."""
+        with self._lock:
+            own = self._epoch
+        return (own, getattr(self._inner, "label_epoch", 0))
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def threshold(self) -> float:
+        with self._lock:
+            return self._threshold
+
+    def status(self) -> dict:
+        """The /healthz self-report (obs.HealthState.set_openset)."""
+        with self._lock:
+            return {
+                "state": self._state,
+                "gauge": STATE_GAUGE[self._state],
+                "threshold": (
+                    None if self._threshold == float("inf")
+                    else round(self._threshold, 6)
+                ),
+                "margin": self.margin,
+                "rejections": self._rejections,
+                "last_rejected": self._last_rejected,
+                "calibration_rows": (
+                    self._calibrated_at_rows or self._cal_rows
+                ),
+                "score_faults": self._score_faults,
+                "calibrate_faults": self._calibrate_faults,
+            }
+
+    # -- calibration -------------------------------------------------------
+    def _calibrate_tick(self, X, labels) -> None:
+        """Fold one pre-arming tick's ACTIVE labeled rows into the
+        calibration window; freeze stats + threshold once enough rows
+        accumulated. Absorbing: a failure drops this tick's sample,
+        never the labels (they were already produced)."""
+        try:
+            faults.fault_point("openset.calibrate")
+            Xh = np.asarray(X, np.float64)
+            yh = np.asarray(labels).astype(np.int64).ravel()
+            yh = yh[: Xh.shape[0]]
+            mask = Xh.any(axis=1)
+            with self._lock:
+                if int(mask.sum()):
+                    self._cal_X.append(Xh[mask].astype(np.float32))
+                    self._cal_y.append(yh[mask].astype(np.int32))
+                    self._cal_rows += int(mask.sum())
+                due = self._cal_rows >= self.calibration_rows
+            if due:
+                self._freeze()
+        except Exception as e:  # noqa: BLE001 — calibration must not fail the serve
+            self._absorb("openset.calibrate", e)
+
+    def _freeze(self) -> None:
+        with self._lock:
+            cal_X, self._cal_X = self._cal_X, []
+            cal_y, self._cal_y = self._cal_y, []
+            # reset so a failed install re-accumulates a fresh window
+            # instead of re-freezing empty buffers forever
+            self._cal_rows = 0
+        X = np.concatenate(cal_X, axis=0)
+        y = np.concatenate(cal_y, axis=0)
+        self._install_reference(X, y, reason="calibrated")
+
+    def _install_reference(self, X, y, reason: str) -> None:
+        """Compute per-class stats + the margin-calibrated threshold
+        from a labeled window and arm (or re-arm) the gate. Shared by
+        the first-windows freeze and the promotion-time ``rebase``.
+        Classes the window never saw are DROPPED from the scoring
+        matrices (reference_matrices) — never floored into a phantom
+        acceptance basin."""
+        X = np.asarray(X, np.float64)
+        ref = class_reference(X, y, self.n_classes)
+        matrices = reference_matrices(ref, X.std(axis=0))
+        if matrices is None:
+            raise ValueError(
+                "calibration window has no class-labeled rows"
+            )
+        mean, inv_std = matrices
+        scores = openset_scores(X, mean, inv_std)
+        threshold = self.margin * float(scores.max()) if scores.size \
+            else float("inf")
+        with self._lock:
+            self._mean = mean
+            self._inv_std = inv_std
+            self._threshold = threshold
+            self._calibrated_at_rows = int(X.shape[0])
+            self._state = ARMED
+            self._epoch += 1
+            # the jitted program survives: stats are runtime operands
+            # (jit re-traces only on a shape change, e.g. a different
+            # present-class count), but the cached device copies are
+            # stale now — the next device tick re-uploads once
+            self._device_stats = None
+        if self._metrics is not None:
+            self._metrics.set("openset_state", STATE_GAUGE[ARMED])
+        if self._recorder is not None:
+            self._recorder.record(
+                "openset.calibrated", reason=reason,
+                rows=int(X.shape[0]), threshold=threshold,
+            )
+
+    def rebase(self, X, y) -> bool:
+        """Re-reference onto a promotion's retrain window (the drift
+        controller calls this with the reservoir's KNOWN-labeled rows —
+        rejected rows never teach the stats, which is what keeps a
+        promoted model rejecting what it was never taught). Absorbing:
+        a failure keeps the previous stats — never fails a promotion."""
+        try:
+            faults.fault_point("openset.calibrate")
+            X = np.asarray(X, np.float64)
+            y = np.asarray(y)
+            known = y.astype(np.int64) < self.n_classes
+            if not int(known.sum()):
+                return False
+            self._install_reference(X[known], y[known], reason="rebase")
+            return True
+        except Exception as e:  # noqa: BLE001 — a promotion must not die of its rebase
+            self._absorb("openset.calibrate", e)
+            return False
+
+    # -- armed scoring -----------------------------------------------------
+    def _apply(self, X, labels):
+        """Relabel over-threshold active rows ``unknown``; absorbing —
+        any scoring failure serves the inner labels fresh."""
+        try:
+            faults.fault_point("openset.score")
+            if self.host_native or isinstance(labels, np.ndarray):
+                return self._apply_host(X, labels)
+            return self._apply_device(X, labels)
+        except Exception as e:  # noqa: BLE001 — scoring must not fail the serve
+            self._absorb("openset.score", e)
+            return labels
+
+    def _apply_host(self, X, labels):
+        with self._lock:
+            mean, inv_std, thr = self._mean, self._inv_std, self._threshold
+        Xh = np.asarray(X, np.float64)
+        yh = np.asarray(labels)
+        scores = openset_scores(Xh, mean, inv_std)
+        active = Xh.any(axis=1)
+        rej = active & (scores > thr)
+        n = int(rej.sum())
+        out = np.where(
+            rej[: yh.shape[0]], np.int32(self.unknown_index), yh
+        ).astype(yh.dtype, copy=False)
+        self._note_rejections(n)
+        return out
+
+    def _apply_device(self, X, labels):
+        """The device path: one jitted relabel program (built once;
+        jit's cache keys re-traces on shape changes such as a new
+        present-class count), all dispatch — the rejection count is a
+        device scalar drained LAZILY at the next call, and the stats'
+        device copies are cached per epoch, so the pipelined render
+        gains neither a host sync nor a per-tick re-upload."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            fn = self._reject_jit
+            mean, inv_std = self._mean, self._inv_std
+            thr = self._threshold
+            epoch = self._epoch
+            cached = self._device_stats
+        if fn is None:
+            # mirror of openset_scores, device dtype; the unknown
+            # index is a trace-time constant
+            unknown = self.unknown_index
+
+            def _reject(X, labels, mean, inv_std, thr):
+                Xf = X.astype(jnp.float32)
+                best = None
+                for c in range(mean.shape[0]):
+                    z = (Xf - mean[c][None, :]) * inv_std[c][None, :]
+                    d = jnp.mean(z * z, axis=-1)
+                    best = d if best is None else jnp.minimum(best, d)
+                score = jnp.sqrt(best)
+                active = jnp.any(X != 0, axis=-1)
+                rej = active & (score > thr)
+                out = jnp.where(
+                    rej[: labels.shape[0]], jnp.int32(unknown), labels
+                )
+                return out, jnp.sum(rej, dtype=jnp.int32)
+
+            fn = jax.jit(_reject)
+            with self._lock:
+                self._reject_jit = fn
+        if cached is not None and cached[0] == epoch:
+            _e, mean32, inv32, thr32 = cached
+        else:
+            mean32 = jnp.asarray(mean, jnp.float32)
+            inv32 = jnp.asarray(inv_std, jnp.float32)
+            thr32 = jnp.float32(thr)
+            with self._lock:
+                if self._epoch == epoch:
+                    self._device_stats = (epoch, mean32, inv32, thr32)
+        out, count = fn(X, labels, mean32, inv32, thr32)
+        with self._lock:
+            self._pending_count = count
+        return out
+
+    def _drain_pending_count(self) -> None:
+        """Fold the previous device tick's rejection count into the
+        counters (it has long since materialized — no fresh sync)."""
+        with self._lock:
+            count, self._pending_count = self._pending_count, None
+        if count is None:
+            return
+        try:
+            self._note_rejections(int(count))
+        except Exception:  # noqa: BLE001 — a deleted/donated scalar drops the sample
+            pass
+
+    def _note_rejections(self, n: int) -> None:
+        with self._lock:
+            self._last_rejected = n
+            self._rejections += n
+        if self._metrics is not None:
+            self._metrics.set("openset_rejected_rows", n)
+            if n:
+                self._metrics.inc("openset_rejections", n)
+        if n and self._recorder is not None:
+            self._recorder.record("openset.reject", rows=n)
+
+    # -- fault absorption --------------------------------------------------
+    def _absorb(self, site: str, e: Exception) -> None:
+        with self._lock:
+            if site == "openset.score":
+                self._score_faults += 1
+            else:
+                self._calibrate_faults += 1
+        if self._metrics is not None:
+            self._metrics.inc("openset_faults")
+        if self._recorder is not None:
+            self._recorder.record(
+                "openset.fault_absorbed", site=site,
+                error=type(e).__name__, detail=str(e),
+            )

@@ -166,6 +166,21 @@ SITES: dict[str, str] = {
         "itself fails; ABSORBED: the gate keeps the pair it already "
         "holds (the old model), so serving continues regardless"
     ),
+    "openset.score": (
+        "serving/openset.OpenSetGate scoring — the per-tick open-set "
+        "rejection scoring fails; ABSORBED: that tick serves the inner "
+        "closed-world labels FRESH (the predict already ran) — never a "
+        "fabricated 'unknown', never a stale label, and the serve "
+        "never sees the failure"
+    ),
+    "openset.calibrate": (
+        "serving/openset.OpenSetGate calibration/rebase — a "
+        "calibration sample fold or a promotion-time rebase fails; "
+        "ABSORBED: the sample is dropped (calibration just takes "
+        "longer; a failed rebase keeps the previous stats) and labels "
+        "are never touched — the gate stays byte-transparent until a "
+        "calibration actually lands"
+    ),
 }
 
 
