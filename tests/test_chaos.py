@@ -1256,6 +1256,80 @@ def test_fanin_put_probabilistic_accounting_any_seed():
         assert acc.get(sid, 0) + drops.get(sid, 0) == emitted[sid]
 
 
+def test_native_parse_fault_counts_and_skips_per_source_absorbed():
+    """ingest.native_parse fires at the C++ parse seam: the batch's
+    lead line is treated as corrupt — counted against ITS source and
+    skipped — the REST of the batch parses normally, nothing raises
+    into the serve loop, and the resulting table is exactly the
+    Python oracle's table over the surviving lines (no torn row)."""
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
+
+    if not native_engine.available():
+        pytest.skip("C++ engine unavailable")
+    recs = [
+        TelemetryRecord(
+            time=1, datapath="1", in_port="1", eth_src=f"h{i}",
+            eth_dst=f"g{i}", out_port="2", packets=5 + i, bytes=100 * i,
+        )
+        for i in range(4)
+    ]
+    blob = b"".join(format_line(r) for r in recs)
+    nat = FlowStateEngine(capacity=32, native=True)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("ingest.native_parse", after=1, times=1)], SEED
+    )
+    with faults.installed(plan):
+        assert nat.ingest_bytes(blob, source=1) == 4   # hit 1: clean
+        assert nat.ingest_bytes(blob, source=2) == 3   # hit 2: fires
+        assert nat.ingest_bytes(blob, source=3) == 4   # hit 3: clean
+    assert plan.fires == [("ingest.native_parse", 2)]
+    assert nat.parse_errors(2) == 1 and nat.parse_errors() == 1
+    assert nat.parse_errors(1) == nat.parse_errors(3) == 0
+    # no torn row: the table equals the oracle fed the surviving lines
+    py = FlowStateEngine(capacity=32, native=False)
+    py.ingest_bytes(blob, source=1)
+    py.ingest_bytes(b"".join(format_line(r) for r in recs[1:]), source=2)
+    py.ingest_bytes(blob, source=3)
+    py.step(), nat.step()
+    np.testing.assert_array_equal(
+        np.asarray(ft.features12(py.table)),
+        np.asarray(ft.features12(nat.table)),
+    )
+
+
+def test_native_parse_probabilistic_accounting_any_seed():
+    """Probability-scheduled parse-seam fires (any TCSDN_CHAOS_SEED):
+    whatever subset fires, feeds never raise, and per-source accounting
+    stays exact — parsed + skipped == emitted lines for EVERY source,
+    with untouched sources reading zero errors."""
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
+
+    if not native_engine.available():
+        pytest.skip("C++ engine unavailable")
+    nat = FlowStateEngine(capacity=256, native=True)
+    r = TelemetryRecord(
+        time=1, datapath="1", in_port="1", eth_src="aa", eth_dst="bb",
+        out_port="2", packets=1, bytes=10,
+    )
+    emitted = {1: 0, 2: 0}
+    with faults.installed(faults.FaultPlan(
+        [faults.FaultRule("ingest.native_parse", times=None, p=0.35)],
+        SEED,
+    )):
+        for i in range(40):
+            sid = 1 + i % 2
+            n_lines = 1 + i % 3
+            blob = format_line(r) * n_lines
+            nat.ingest_bytes(blob, source=sid)
+            emitted[sid] += n_lines
+    for sid in emitted:
+        parsed = nat.batcher.source_parsed(sid)
+        skipped = nat.parse_errors(sid)
+        assert parsed + skipped == emitted[sid], (sid, parsed, skipped)
+    assert nat.parse_errors(7) == 0
+    nat.step()  # whatever survived still scatters cleanly
+
+
 def test_fanin_source_dead_quarantines_only_its_namespace():
     """ingest.source_dead fires mid-stream in ONE of three pumps: that
     source goes DEAD (unclean), its namespace quarantines and evicts,

@@ -158,15 +158,20 @@ def test_specs_from_cli_rejects_duplicates_and_workload():
 # ---------------------------------------------------------------------------
 
 def _drive(tier, eng, gen, ticks, on_tick=None):
-    """Advance the serve side: ingest `ticks` fan-in batches, applying
-    expired quarantines exactly like cli._evict_dead_namespaces."""
+    """Advance the serve side: ingest `ticks` fan-in batches (record
+    lists or raw-wire RawTicks), applying expired quarantines exactly
+    like cli._evict_dead_namespaces."""
     evicted = {}
     for _ in range(ticks):
         batch = next(gen, None)
         if batch is None:
             break
         eng.mark_tick()
-        eng.ingest(batch)
+        if isinstance(batch, fanin.RawTick):
+            for sid, data in batch:
+                eng.ingest_bytes(data, sid)
+        else:
+            eng.ingest(batch)
         eng.step()
         for sid in tier.take_evictions():
             evicted[sid] = eng.evict_source(sid)
@@ -224,6 +229,80 @@ def test_kill_one_of_three_evicts_only_its_namespace():
         gen.close()
 
 
+def test_kill_one_of_three_native_raw_evicts_only_its_namespace():
+    """The native-ingest fan-in tier end to end: raw-wire pumps feed
+    the C++ engine under per-source namespaces, a killed source's
+    quarantine evicts exactly its own slots through the REAL native
+    evict_source, and the survivors keep serving fresh."""
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
+
+    if not native_engine.available():
+        pytest.skip("C++ engine unavailable")
+    specs = [
+        fanin.SourceSpec(kind="synthetic", sid=i, n_flows=4, seed=i,
+                         mac_base=i * 4, lockstep=True)
+        for i in range(3)
+    ]
+    tier = fanin.FanInIngest(specs, quarantine_s=0.1, raw=True)
+    eng = FlowStateEngine(64, native=True)
+    gen = tier.ticks(tick_timeout=5.0)
+    try:
+        _drive(tier, eng, gen, 3)
+        assert eng.num_flows() == 12
+        before = {
+            sid: sorted(eng.batcher.slots_for_source(sid).tolist())
+            for sid in range(3)
+        }
+        assert all(len(s) == 4 for s in before.values())
+
+        tier.kill_source(1)
+        evicted = {}
+        deadline = time.monotonic() + 20.0
+        while not evicted and time.monotonic() < deadline:
+            evicted.update(_drive(tier, eng, gen, 1))
+        assert evicted == {1: 4}
+        # blast radius: namespace 1 gone, 0 and 2 byte-untouched
+        assert eng.batcher.slots_for_source(1).size == 0
+        assert sorted(
+            eng.batcher.slots_for_source(0).tolist()
+        ) == before[0]
+        assert sorted(
+            eng.batcher.slots_for_source(2).tolist()
+        ) == before[2]
+        assert eng.num_flows() == 8
+        # survivors still FRESH: their counters keep advancing
+        t_before = int(eng.last_time)
+        _drive(tier, eng, gen, 2)
+        assert int(eng.last_time) > t_before
+    finally:
+        gen.close()
+
+
+def test_raw_queue_bound_purge_and_provenance():
+    """put_bytes shares the record-counted bound, per-source drop
+    accounting, eviction-time purge, and the provenance seam (the
+    pump-read emit stamp rides the queue entry — byte batches carry no
+    record object to stamp)."""
+    clock = iter([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]).__next__
+    q = fanin.FanInQueue(
+        max_records=10, collect_provenance=True, prov_clock=clock,
+    )
+    assert q.put_bytes(0, b"l1\nl2\n", 2, emit_ts=0.5)
+    assert q.put_bytes(1, b"x\n" * 9, 9) is False  # bound: 2+9 > 10
+    assert q.drops() == {1: 9}
+    taken = q.take()
+    assert taken == [(0, b"l1\nl2\n")]
+    # (sid, emit, enq, deq, n): emit is the explicit put_bytes stamp
+    # (enq=1.0 from the accepted put; the dropped put burned 2.0;
+    # deq=3.0 at take)
+    assert q.pop_provenance() == [(0, 0.5, 1.0, 3.0, 2)]
+    # purge counts a dead source's queued byte backlog as its drops
+    assert q.put_bytes(2, b"y\n", 1)
+    assert q.purge(2) == 1
+    assert q.drops()[2] == 1
+    assert q.take() == []
+
+
 def test_restart_within_quarantine_cancels_eviction():
     """A source restarted before its quarantine expires keeps its flows:
     the namespace is live again, evicting it would throw away state the
@@ -253,14 +332,41 @@ def test_restart_within_quarantine_cancels_eviction():
         gen.close()
 
 
-def test_evict_source_requires_python_batcher():
+def test_native_evict_source_clears_exactly_one_namespace():
+    """The C++ engine's per-slot source map: evicting one namespace
+    releases exactly its own slots (matching the Python index's set),
+    leaves every other namespace live, and the freed slots are
+    reusable — the real native evict_source that replaced PR 9's
+    idle-timeout degrade."""
+    from traffic_classifier_sdn_tpu.ingest.protocol import format_line
     from traffic_classifier_sdn_tpu.native import engine as native_engine
 
     if not native_engine.available():
         pytest.skip("C++ engine unavailable")
-    eng = FlowStateEngine(16, native=True)
-    with pytest.raises(RuntimeError, match="Python batcher"):
-        eng.evict_source(1)
+    nat = FlowStateEngine(32, native=True)
+    py = FlowStateEngine(32, native=False)
+    data = b"".join(
+        format_line(_rec(1, f"h{i}", f"g{i}", 5, 100)) for i in range(4)
+    )
+    for sid in (0, 1, 2):
+        nat.ingest_bytes(data, source=sid)
+        py.ingest_bytes(data, source=sid)
+    nat.step(), py.step()
+    assert nat.num_flows() == py.num_flows() == 12
+    nat_slots = set(nat.batcher.slots_for_source(1).tolist())
+    py_slots = set(py.index.slots_for_source(1))
+    assert nat_slots == py_slots and len(nat_slots) == 4
+    assert nat.evict_source(1) == py.evict_source(1) == 4
+    assert nat.num_flows() == py.num_flows() == 8
+    assert nat.batcher.slots_for_source(1).size == 0
+    # the freed slots rejoin the allocator identically on both spines
+    nat.ingest_bytes(data, source=3)
+    py.ingest_bytes(data, source=3)
+    nat.step(), py.step()
+    assert nat.num_flows() == py.num_flows() == 12
+    assert set(nat.batcher.slots_for_source(3).tolist()) == set(
+        py.index.slots_for_source(3)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -431,6 +537,34 @@ def test_namespace_identity_one_vs_two_sources(
     assert len(t_one[-1]) == 8
 
 
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+@pytest.mark.parametrize("incremental", ["auto", "off"])
+def test_native_ingest_byte_identical_multisource(
+    gnb_checkpoint, tmp_path, pipeline, incremental
+):
+    """THE native-ingest acceptance anchor: a multi-source fan-in serve
+    with --native-ingest on (raw wire batches → tck_feed_lines under
+    per-source namespaces) renders byte-identically to the Python
+    batcher over the same partitioned captures — per-flow labels, slot
+    ids, activity flags, footers, everything — across serial/pipelined
+    and --incremental auto/off."""
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
+
+    if not native_engine.available():
+        pytest.skip("C++ engine unavailable")
+    _whole, part_a, part_b = _partitioned_captures(tmp_path)
+    base = _base_args(gnb_checkpoint) + [
+        "--pipeline", pipeline, "--incremental", incremental,
+        "--source-lockstep",
+        "--source-spec", f"capture:{part_a}",
+        "--source-spec", f"capture:{part_b}",
+    ]
+    nat = _serve(base + ["--native-ingest", "on"])
+    py = _serve(base + ["--native-ingest", "off"])
+    assert "Flow ID" in nat
+    assert nat == py
+
+
 # ---------------------------------------------------------------------------
 # review-hardening regressions
 # ---------------------------------------------------------------------------
@@ -477,6 +611,39 @@ def test_eviction_purges_dead_sources_queued_backlog():
     assert tier.take_evictions() == []
 
 
+def test_eviction_poisons_raw_framing_even_when_queue_drained():
+    """A raw source's eviction must resync byte framing even when its
+    queued backlog was already drained before the quarantine expired:
+    the consumer's per-source tail can still hold the dead
+    incarnation's dangling half line, so take_evictions poisons the
+    sid unconditionally — the restarted stream's first chunk arrives
+    behind the \x00\n seam instead of completing the fragment."""
+    clock = {"t": 0.0}
+    tier = fanin.FanInIngest(
+        [fanin.SourceSpec(kind="synthetic", sid=i, n_flows=2, seed=i,
+                          mac_base=i * 2, lockstep=True)
+         for i in range(2)],
+        quarantine_s=5.0, clock=lambda: clock["t"], raw=True,
+    )
+    w = tier._workers[1]
+    with w._state_lock:
+        w._state = fanin.SOURCE_DEAD
+        w._clean = False
+    # the dead source's last chunk was already consumed: nothing queued
+    tier.queue.put_bytes(1, b"data\thalf-a-line", 1)
+    assert tier.queue.take() == [(1, b"data\thalf-a-line")]
+    tier._supervise()
+    clock["t"] = 6.0
+    assert tier.take_evictions() == [1]
+    assert tier.queue.purge(1) == 0  # drained — purge alone saw nothing
+    # the restarted incarnation's first batch carries the poison seam
+    assert tier.queue.put_bytes(1, b"data\tfresh\n", 1)
+    assert tier.queue.take() == [(1, b"\x00\ndata\tfresh\n")]
+    # other sources' framing is untouched
+    assert tier.queue.put_bytes(0, b"data\tok\n", 1)
+    assert tier.queue.take() == [(0, b"data\tok\n")]
+
+
 def test_specs_from_cli_rejects_identical_live_commands():
     """N copies of one monitor command fight over the same port — the
     homogeneous live mode must refuse unless the command is templated
@@ -498,53 +665,65 @@ def test_specs_from_cli_rejects_identical_live_commands():
     assert one[0].cmd == "mon"
 
 
-def test_evict_dead_namespaces_skips_native_engine():
-    """Single-source fan-in keeps the C++ engine; a dead source must
-    degrade to idle-timeout reclamation, never crash the serve on the
-    native evict_source guard."""
+def test_evict_dead_namespaces_evicts_on_native_engine():
+    """The serve loop's quarantine pass runs the REAL native
+    evict_source now — PR 9's degrade-to-idle-timeout skip (and its
+    source_evictions_skipped counter) is gone."""
     from traffic_classifier_sdn_tpu.utils.metrics import Metrics
 
     class _Tier:
         def take_evictions(self):
-            return [0]
+            return [3]
+
+    evicted = []
 
     class _NativeEngine:
         native = True
 
-        def evict_source(self, sid):  # pragma: no cover - must not run
-            raise AssertionError("native evict_source must be skipped")
+        def evict_source(self, sid):
+            evicted.append(sid)
+            return 7
 
     m = Metrics()
     cli._evict_dead_namespaces(_Tier(), _NativeEngine(), m, None, None)
-    assert m.counters["source_evictions_skipped"] == 1
-    assert "source_evictions" not in m.counters
+    assert evicted == [3]
+    assert m.counters["source_evictions"] == 1
+    assert m.counters["evicted"] == 7
+    assert "source_evictions_skipped" not in m.counters
 
 
-def test_train_multisource_forces_python_batcher(tmp_path, capsys):
-    """The train subcommand shares the classify rule: multi-source
-    fan-in routes through the Python batcher (the C++ keyer round-trips
-    the wire format, which has no source field — namespaces would
-    collapse into shared slots)."""
+def test_train_multisource_native_and_python_identical(tmp_path):
+    """Multi-source train collection is legal on BOTH ingest paths now
+    (the C++ keyer namespaces per source via tck_feed_lines), and the
+    collected CSV is identical: the byte-identity anchor, train-side.
+    One test runs BOTH modes so the cross-path comparison actually
+    executes (parametrized variants get disjoint tmp_paths)."""
     from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+    from traffic_classifier_sdn_tpu.native import engine as native_engine
 
+    if not native_engine.available():
+        pytest.skip("C++ engine unavailable")
     syn = SyntheticFlows(n_flows=4, seed=3)
     cap = tmp_path / "cap.tsv"
     with open(cap, "wb") as f:
         for _ in range(3):
             for r in syn.tick():
                 f.write(format_line(r))
-    out = tmp_path / "train.csv"
-    cli.main([
-        "train", "ping", "--source", "replay", "--capture", str(cap),
-        "--sources", "2", "--source-lockstep", "--capacity", "64",
-        "--duration", "999", "--max-ticks", "3", "--out", str(out),
-    ])
-    lines = out.read_text().splitlines()
-    # both namespaces collected: 4 conversations x 2 sources, written
-    # for every in-use slot at each of the 3 ticks, plus the header
-    assert len(lines) == 1 + 8 * 3
-    err = capsys.readouterr().err
-    from traffic_classifier_sdn_tpu.native import engine as native_engine
-
-    if native_engine.available():
-        assert "Python batcher" in err
+    outs = {}
+    for native_flag in ("on", "off"):
+        out = tmp_path / f"train_{native_flag}.csv"
+        cli.main([
+            "train", "ping", "--source", "replay", "--capture", str(cap),
+            "--sources", "2", "--source-lockstep", "--capacity", "64",
+            "--duration", "999", "--max-ticks", "3", "--out", str(out),
+            "--native-ingest", native_flag,
+        ])
+        lines = out.read_text().splitlines()
+        # both namespaces collected: 4 conversations x 2 sources,
+        # written for every in-use slot at each of the 3 ticks, plus
+        # the header
+        assert len(lines) == 1 + 8 * 3
+        outs[native_flag] = out.read_text()
+    # cross-path identity: the two modes must write the same rows
+    # (slot order included — same assignment sequence)
+    assert outs["on"] == outs["off"]

@@ -356,6 +356,172 @@ print("THREADED_PARITY_OK")
     assert "THREADED_PARITY_OK" in r.stdout
 
 
+# ---------------------------------------------------------------------------
+# wire-protocol edge cases through the C++ parser — each pinned equal to
+# the Python parser's behavior (the byte-identity contract's corners)
+# ---------------------------------------------------------------------------
+
+def _both(capacity=16):
+    return (
+        FlowStateEngine(capacity=capacity, native=False),
+        FlowStateEngine(capacity=capacity, native=True),
+    )
+
+
+def _assert_state_equal(py, nat):
+    s_py, s_nat = _table_state(py), _table_state(nat)
+    for k in s_py:
+        np.testing.assert_array_equal(s_py[k], s_nat[k], err_msg=k)
+
+
+def test_truncated_final_line_carries_per_source():
+    """A chunk ending mid-record parses nothing until the rest arrives —
+    and the carry is PER SOURCE: source A's half line must never be
+    completed by source B's bytes."""
+    py, nat = _both()
+    r0 = TelemetryRecord(1, "1", "1", "aa", "bb", "2", 5, 100)
+    r1 = TelemetryRecord(1, "1", "1", "cc", "dd", "2", 7, 700)
+    l0, l1 = format_line(r0), format_line(r1)
+    for eng in (py, nat):
+        assert eng.ingest_bytes(l0[:9], source=1) == 0
+        # source 2's complete line lands while source 1's tail is open
+        assert eng.ingest_bytes(l1, source=2) == 1
+        assert eng.ingest_bytes(l0[9:], source=1) == 1
+    _assert_state_equal(py, nat)
+    assert py.num_flows() == nat.num_flows() == 2
+    assert set(nat.batcher.slots_for_source(1).tolist()) == set(
+        py.index.slots_for_source(1)
+    )
+
+
+def test_oversized_token_heap_path_matches_python():
+    """String fields past the fingerprint's 512-byte stack buffer take
+    the heap path; routing and acceptance must not change. Oversized
+    NOISE (a >512-byte junk line) is also free on both paths."""
+    py, nat = _both()
+    big_src = "aa" * 400  # 800 bytes — well past the stack buffer
+    line = (
+        f"data\t1\t1\t1\t{big_src}\tbb\t2\t5\t100\n".encode()
+    )
+    for eng in (py, nat):
+        assert eng.ingest_bytes(line) == 1
+        assert eng.ingest_bytes(b"x" * 2048 + b"\n") == 0
+        # the reverse direction folds onto the same slot
+        rev = f"data\t2\t1\t2\tbb\t{big_src}\t1\t3\t60\n".encode()
+        assert eng.ingest_bytes(rev) == 1
+    _assert_state_equal(py, nat)
+    assert py.num_flows() == nat.num_flows() == 1
+
+
+def test_non_utf8_field_rejected_and_counted_per_source():
+    """Non-UTF8 string fields are malformed on both paths — and the
+    native path counts them per source (the fan-in attribution the
+    Python fallback mirrors)."""
+    py, nat = _both()
+    bad = b"data\t1\t1\t1\t\xff\xfe\tbb\t2\t5\t100\n"
+    for eng in (py, nat):
+        assert eng.ingest_bytes(bad, source=3) == 0
+        assert eng.ingest_bytes(bad, source=4) == 0
+        assert eng.ingest_bytes(bad, source=4) == 0
+        assert eng.parse_errors(3) == 1
+        assert eng.parse_errors(4) == 2
+        assert eng.parse_errors() == 3
+    _assert_state_equal(py, nat)
+
+
+def test_cumulative_counter_reset_matches_python():
+    """A monitor restart resets cumulative counters to small values —
+    the mod-2^32 delta math wraps negative identically on both paths
+    (the reference's arbitrary-precision ints see the same delta sign
+    through int(new) - int(old))."""
+    py, nat = _both()
+    lines = (
+        b"data\t1\t1\t1\taa\tbb\t2\t1000\t90000\n"
+        b"data\t2\t1\t1\taa\tbb\t2\t2000\t180000\n"
+        # the reset: counters fall back below the previous poll
+        b"data\t3\t1\t1\taa\tbb\t2\t5\t400\n"
+        b"data\t4\t1\t1\taa\tbb\t2\t10\t800\n"
+    )
+    for chunk in (lines[:40], lines[40:]):  # split mid-stream
+        py.ingest_bytes(chunk)
+        nat.ingest_bytes(chunk)
+        _assert_state_equal(py, nat)
+    f12 = np.asarray(ft.features12(nat.table))
+    assert f12[0, 0] == 5.0  # post-reset delta, not a 2^32 wrap artifact
+
+
+def test_sid_namespace_round_trip_matches_python():
+    """The {sid} round trip: the SAME wire bytes under N source ids
+    occupy N disjoint slot sets with identical counters, evicting one
+    namespace leaves the rest byte-untouched, and the slot/namespace
+    maps agree with the Python index at every step."""
+    py, nat = _both(capacity=64)
+    r = TelemetryRecord(1, "1", "1", "aa", "bb", "2", 5, 100)
+    upd = TelemetryRecord(2, "1", "1", "aa", "bb", "2", 9, 180)
+    blob = format_line(r) + format_line(upd)
+    for sid in (0, 1, 5):
+        for eng in (py, nat):
+            assert eng.ingest_bytes(blob, source=sid) == 2
+    _assert_state_equal(py, nat)
+    assert py.num_flows() == nat.num_flows() == 3
+    for sid in (0, 1, 5):
+        assert set(nat.batcher.slots_for_source(sid).tolist()) == set(
+            py.index.slots_for_source(sid)
+        )
+        assert nat.batcher.source_parsed(sid) == 2
+    for eng in (py, nat):
+        assert eng.evict_source(1) == 1
+    _assert_state_equal(py, nat)
+    assert py.num_flows() == nat.num_flows() == 2
+
+
+def test_flush_wire_zero_copy_path_matches_flush_pack():
+    """The pinned-staging wire flush (tck_flush_wire) must scatter the
+    identical device state as the legacy flush + pack_wire route — and
+    the full-width (B, 6) form must engage exactly when a counter's
+    float32 image reaches 2^31, like pack_wire."""
+    from traffic_classifier_sdn_tpu.native.engine import NativeBatcher
+
+    big = 1 << 33  # forces the (B, 6) full-width wire
+    recs = [
+        TelemetryRecord(1, "1", "1", "aa", "bb", "2", 5, 100),
+        TelemetryRecord(1, "1", "1", "cc", "dd", "2", 7, big),
+        TelemetryRecord(2, "1", "1", "aa", "bb", "2", 9, 180),
+    ]
+    blob = b"".join(format_line(r) for r in recs)
+
+    nb_wire = NativeBatcher(capacity=16)
+    nb_pack = NativeBatcher(capacity=16)
+    nb_wire.feed(blob)
+    nb_pack.feed(blob)
+    tbl_wire = ft.make_table(16)
+    tbl_pack = ft.make_table(16)
+    widths = []
+    while (w := nb_wire.flush_wire()) is not None:
+        widths.append(w.shape[1])
+        tbl_wire = ft.apply_wire(tbl_wire, w)
+    while (b := nb_pack.flush()) is not None:
+        tbl_pack = ft.apply_wire(tbl_pack, ft.pack_wire(b))
+    assert 6 in widths  # the big counter forced the full-width form
+    np.testing.assert_array_equal(
+        np.asarray(ft.features12(tbl_wire)),
+        np.asarray(ft.features12(tbl_pack)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tbl_wire.fwd.bytes_f), np.asarray(tbl_pack.fwd.bytes_f)
+    )
+    # double-buffering: the previous flush's view survives the next
+    nb2 = NativeBatcher(capacity=16)
+    nb2.feed(blob)
+    v1 = nb2.flush_wire()
+    snap = v1.copy()
+    nb2.feed(format_line(TelemetryRecord(3, "1", "1", "ee", "ff", "2",
+                                         1, 10)))
+    v2 = nb2.flush_wire()
+    assert v2 is not None
+    np.testing.assert_array_equal(v1, snap)
+
+
 @pytest.mark.parametrize("native", [True, False])
 def test_eviction_churn_reuses_slots_without_drops(native):
     """Sustained flow churn: each even tick one churn cohort vanishes and
@@ -411,3 +577,163 @@ def test_eviction_churn_reuses_slots_without_drops(native):
     eng.evict_idle(now=15, idle_seconds=2)
     assert eng.dropped == 0
     assert eng.num_flows() == stable_n
+
+
+# ---------------------------------------------------------------------------
+# review hardening: framing under faults, eviction, and the wire bound
+# ---------------------------------------------------------------------------
+
+
+def test_native_parse_fault_with_pending_tail_never_tears_framing():
+    """ingest.native_parse firing while a per-source partial line is
+    carried must not splice the stale tail onto the next line: the seam
+    SUBSTITUTES a malformed line for the batch head instead of deleting
+    bytes, so the tail terminates at an unparseable boundary and every
+    surviving record parses exactly as the oracle's."""
+    from traffic_classifier_sdn_tpu.utils import faults
+
+    nat = FlowStateEngine(capacity=32, native=True)
+    r0 = TelemetryRecord(1, "1", "1", "aa", "bb", "2", 5, 100)
+    r1 = TelemetryRecord(1, "1", "1", "cc", "dd", "2", 7, 700)
+    r2 = TelemetryRecord(1, "1", "1", "ee", "ff", "2", 9, 900)
+    l0, l1, l2 = format_line(r0), format_line(r1), format_line(r2)
+    # open a tail: half of r0's line is pending for source 1
+    assert nat.ingest_bytes(l0[: len(l0) // 2], source=1) == 0
+    plan = faults.FaultPlan(
+        [faults.FaultRule("ingest.native_parse", after=0, times=1)], 1234
+    )
+    with faults.installed(plan):
+        # the fire corrupts the boundary record (tail + its completion);
+        # r1 and r2 must survive untouched — never a spliced hybrid of
+        # r0's head and r1's fields
+        n = nat.ingest_bytes(l0[len(l0) // 2:] + l1 + l2, source=1)
+    assert plan.fires == [("ingest.native_parse", 1)]
+    assert n == 2
+    # exactly the corrupt boundary line is counted, against its source
+    assert nat.parse_errors(1) == 1 and nat.parse_errors() == 1
+    py = FlowStateEngine(capacity=32, native=False)
+    py.ingest_bytes(l1 + l2, source=1)
+    _assert_state_equal(py, nat)
+    assert nat.num_flows() == 2
+
+
+def test_poison_seam_terminates_stale_tail_after_eviction():
+    """The fan-in queue's \\x00\\n poison prefix (sent after namespace
+    eviction / source restart) must terminate a dangling per-source
+    tail on BOTH spines: the stale fragment dies at the seam and the
+    restarted stream's first full line parses cleanly."""
+    py, nat = _both(capacity=32)
+    r0 = TelemetryRecord(1, "1", "1", "aa", "bb", "2", 5, 100)
+    r1 = TelemetryRecord(2, "1", "1", "cc", "dd", "2", 7, 700)
+    l0, l1 = format_line(r0), format_line(r1)
+    for eng in (py, nat):
+        # dead incarnation leaves half a line carried for source 1
+        assert eng.ingest_bytes(l0[:12], source=1) == 0
+        eng.evict_source(1)
+        # restarted incarnation's first chunk arrives poison-prefixed
+        # (FanInQueue.poison → the b"\x00\n" seam)
+        assert eng.ingest_bytes(b"\x00\n" + l1, source=1) == 1
+    _assert_state_equal(py, nat)
+    assert py.num_flows() == nat.num_flows() == 1
+    # the surviving flow is r1, not a tail-spliced hybrid of r0 and r1
+    assert list(nat.slot_metadata().values()) == [("cc", "dd")]
+
+
+def test_evict_source_drops_dangling_tail_both_spines():
+    """evict_source clears the namespace's carried partial line with
+    its slots on BOTH spines (Python _tails / native tck_reset_tail): a
+    post-restart chunk must not complete the dead incarnation's
+    fragment even without the queue's poison seam in front of it."""
+    py, nat = _both(capacity=32)
+    r0 = TelemetryRecord(1, "1", "1", "aa", "bb", "2", 5, 100)
+    r1 = TelemetryRecord(2, "1", "1", "cc", "dd", "2", 7, 700)
+    l0, l1 = format_line(r0), format_line(r1)
+    for eng in (py, nat):
+        assert eng.ingest_bytes(l0[:12], source=1) == 0
+        eng.evict_source(1)
+        assert eng.ingest_bytes(l1, source=1) == 1
+        eng.step()
+        assert eng.num_flows() == 1
+        assert list(eng.slot_metadata().values()) == [("cc", "dd")]
+    _assert_state_equal(py, nat)
+
+
+def test_native_parse_fault_on_newline_less_fragment_keeps_framing():
+    """A fire on a pure mid-line fragment (zero newlines — the raw cmd
+    path delivers these) must corrupt the SPANNING line in place, not
+    delete the fragment and fabricate a terminator: the line's
+    continuation in the next chunk must neither be parsed at a false
+    boundary nor splice into a wrong-but-valid record."""
+    from traffic_classifier_sdn_tpu.utils import faults
+
+    nat = FlowStateEngine(capacity=32, native=True)
+    r0 = TelemetryRecord(1, "1", "1", "aa", "bb", "2", 5, 100)
+    r1 = TelemetryRecord(1, "1", "1", "cc", "dd", "2", 7, 700)
+    l0, l1 = format_line(r0), format_line(r1)
+    frag = l0[: len(l0) // 2]  # no newline in it
+    plan = faults.FaultPlan(
+        [faults.FaultRule("ingest.native_parse", after=0, times=1)], 99
+    )
+    with faults.installed(plan):
+        assert nat.ingest_bytes(frag, source=1) == 0
+    assert plan.fires == [("ingest.native_parse", 1)]
+    # the continuation + a clean record arrive next chunk: the spanning
+    # line is malformed (counted), r1 parses — never a torn boundary
+    assert nat.ingest_bytes(l0[len(l0) // 2:] + l1, source=1) == 1
+    nat.step()
+    assert nat.parse_errors(1) == 1 and nat.parse_errors() == 1
+    assert nat.num_flows() == 1
+    assert list(nat.slot_metadata().values()) == [("cc", "dd")]
+
+
+def test_staging_overwrite_guard_persists_across_steps():
+    """flush_wire's double-buffer reuse hazard spans step() calls (this
+    tick's first flush reuses the buffer staged two flushes ago), so
+    the sync guard counts in-flight applies on the ENGINE, not in a
+    per-call local that resets every tick."""
+    nat = FlowStateEngine(capacity=64, native=True)
+    assert nat._staged_flushes == 0
+    r = TelemetryRecord(1, "1", "1", "aa", "bb", "2", 5, 100)
+    for expect, t in ((1, 1), (2, 2)):
+        nat.ingest_bytes(format_line(
+            TelemetryRecord(t, "1", "1", "aa", "bb", "2", 5 * t, 100 * t)
+        ))
+        assert nat.step() is True
+        assert nat._staged_flushes == expect
+    # third single-flush step: the guard must fire (sync + reset) before
+    # the C++ side rewrites the first buffer, then count the new flush
+    nat.ingest_bytes(format_line(
+        TelemetryRecord(3, "1", "1", "aa", "bb", "2", 50, 1000)
+    ))
+    assert nat.step() is True
+    assert nat._staged_flushes == 1
+    f12 = np.asarray(ft.features12(nat.table))
+    assert float(f12[0, 0]) > 0.0  # the applies all landed
+
+
+def test_capacity_at_wire_flag_bound_rejected_loudly():
+    """capacity >= 2^30 collides with tck_flush_wire's slot flag bits —
+    tc_engine_create must refuse (the Python path's pack_wire raises
+    for the same bound), never silently corrupt direction/create
+    semantics."""
+    from traffic_classifier_sdn_tpu.native.engine import NativeBatcher
+
+    with pytest.raises(RuntimeError, match="2\\^30"):
+        NativeBatcher(1 << 30)
+
+
+def test_extra_fields_rejected_and_counted_identically():
+    """The wire format emits exactly 9 columns — a line with trailing
+    junk fields is a corrupt line on BOTH paths (counted per source),
+    never slop to ignore. The exactness is also what guarantees the
+    ingest.native_parse fragment seam's spliced \\t\\xff field corrupts
+    wherever it lands."""
+    py, nat = _both()
+    good = b"data\t1\t1\t1\taa\tbb\t2\t5\t100\n"
+    extra = b"data\t1\t1\t1\taa\tbb\t2\t5\t100\tjunk\n"
+    for eng in (py, nat):
+        assert eng.ingest_bytes(extra, source=1) == 0
+        assert eng.parse_errors(1) == 1
+        assert eng.ingest_bytes(good, source=1) == 1
+    _assert_state_equal(py, nat)
+    assert py.num_flows() == nat.num_flows() == 1

@@ -488,7 +488,8 @@ def _run_sweep(args, native, predict, params, raw_fn,
     print(json.dumps(out), flush=True)
 
 
-def _run_fanin_sweep(args, predict, params, n_flows: int) -> None:
+def _run_fanin_sweep(args, native, predict, params,
+                     n_flows: int) -> None:
     """The fan-in source sweep (docs/artifacts/serve_fanin_sources_cpu
     .json): for each source count N, drive the REAL fan-in tier
     (ingest/fanin.py — per-source pump threads, the bounded MPSC queue,
@@ -500,10 +501,12 @@ def _run_fanin_sweep(args, predict, params, n_flows: int) -> None:
     the tier's roster. A level 'holds' when processing p50 <= 1 s and
     no source dropped records; the knee is the largest holding level.
 
-    Multi-source fan-in routes through the Python batcher (per-slot
-    source namespacing — same rule as the CLI), so every level pays the
-    same per-record routing cost and the sweep isolates the tier's own
-    scaling."""
+    With the native engine (the default now that tck_feed_lines keys
+    per-source namespaces), pumps deliver raw wire bytes and the serve
+    tick feeds each (sid, payload) straight to C++ — the Python-batcher
+    capacity ceiling the original sweep hit at 256 sources is the
+    per-record routing cost this path deletes; --no-native reproduces
+    the historical Python-batcher sweep."""
     import numpy as np
 
     import jax
@@ -524,8 +527,14 @@ def _run_fanin_sweep(args, predict, params, n_flows: int) -> None:
             )
             for sid in range(n_sources)
         ]
-        tier = fanin.FanInIngest(specs, quarantine_s=5.0)
-        eng = FlowStateEngine(capacity=args.capacity, native=False)
+        # queue bound sized to the aggregate record rate (records, not
+        # batches): a sweep probing ABOVE the old 24.5k-conversation
+        # ceiling must not report self-inflicted bound drops
+        tier = fanin.FanInIngest(
+            specs, quarantine_s=5.0, raw=native,
+            queue_records=max(1 << 16, 4 * 2 * n_flows),
+        )
+        eng = FlowStateEngine(capacity=args.capacity, native=native)
         if args.warmup and not warmed:
             from traffic_classifier_sdn_tpu.serving.warmup import (
                 warmup_serving,
@@ -554,7 +563,13 @@ def _run_fanin_sweep(args, predict, params, n_flows: int) -> None:
                     break
                 t0 = time.perf_counter()
                 eng.mark_tick()
-                n_records += eng.ingest(batch)
+                if isinstance(batch, fanin.RawTick):
+                    n_records += sum(
+                        eng.ingest_bytes(data, sid)
+                        for sid, data in batch
+                    )
+                else:
+                    n_records += eng.ingest(batch)
                 t1 = time.perf_counter()
                 eng.step()
                 t2 = time.perf_counter()
@@ -639,7 +654,7 @@ def _run_fanin_sweep(args, predict, params, n_flows: int) -> None:
         "source_interval_s": args.source_interval,
         "table_rows_rendered": args.table_rows,
         "predict_model": args.model,
-        "native_ingest": False,
+        "native_ingest": native,
         "platform": __import__("jax").devices()[0].platform,
         "warmup": args.warmup,
         "max_sources_holding_1s_p50": knee,
@@ -803,7 +818,7 @@ def main() -> None:
     predict, params, raw_fn = _build_model(args)
 
     if args.sources_sweep is not None:
-        _run_fanin_sweep(args, predict, params, n_flows)
+        _run_fanin_sweep(args, native, predict, params, n_flows)
         return
 
     if args.churn_sweep is not None:

@@ -53,6 +53,7 @@ GROUPS_KEYS=(
   "drift:drift_window or retrain_fit or promote_swap or promote_rollback or drift_loop"
   "dirty:serve_dirty_mask or serve_label_cache"
   "fanin:fanin_put or fanin_source_dead"
+  "native_ingest:native_parse"
   "obs:obs_stamp or sigusr1"
   "openset:openset_score or openset_calibrate or openset_rebase or openset_probabilistic"
 )

@@ -10,11 +10,20 @@
 #          real loaders use, so even a mid-run rebuild stays sanitized.
 #   ubsan  UBSan-only build of flow_engine.cpp linked against the
 #          feed/flush driver (tools/sanitize_feed_flush.cpp): integer/
-#          pointer UB under both single- and multi-threaded load.
+#          pointer UB under both single- and multi-threaded load. The
+#          driver's second phase hammers the namespaced parser —
+#          concurrent tck_feed_lines from N sources over OVERLAPPING
+#          flow tuples (disjoint namespaces), per-source tail carries
+#          split mid-line, deliberate malformed lines (counted, never
+#          crashing), the packed tck_flush_wire drain, live per-source
+#          accounting polls, and a tck_slots_for_source eviction.
+#   asan_engine  ASan(+UBSan) build of the same driver pair — heap
+#          errors in the per-source tail map / wire staging / namespace
+#          scan that UBSan alone would miss.
 #   tsan   ThreadSanitizer build of the same pair, driving concurrent
-#          tc_engine_feed / tc_engine_flush / bookkeeping-poll threads —
-#          the engine's mutex contract, checked for real (a lock removal
-#          fails this phase with TSan exit 66, verified).
+#          tc_engine_feed / tck_feed_lines / flush / bookkeeping-poll
+#          threads — the engine's mutex contract, checked for real (a
+#          lock removal fails this phase with TSan exit 66, verified).
 #
 # Exits 0 iff every phase is clean, and always writes a machine-readable
 # per-phase summary (JSON) to $NATIVE_SANITIZE_SUMMARY (default: a
@@ -34,6 +43,7 @@ WORK="$(mktemp -d /tmp/native_sanitize.XXXXXX)" || exit 2
 trap 'rm -rf "$WORK"' EXIT
 asan_status=fail
 ubsan_status=fail
+asan_engine_status=fail
 tsan_status=fail
 
 # ---- phase 1: asan (ASan+UBSan on the ctypes evaluators) -------------------
@@ -132,6 +142,19 @@ if g++ -O1 -g -fsanitize=undefined -fno-sanitize-recover=all \
   echo "flow_engine: ubsan clean"
 fi
 
+# ---- phase 2b: asan_engine (flow_engine + driver under ASan+UBSan) ---------
+echo "=== phase asan_engine: flow_engine driver under ASan+UBSan"
+if g++ -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
+     -std=c++17 -pthread -o "$WORK/tc_asan_drv" \
+     tools/sanitize_feed_flush.cpp \
+     traffic_classifier_sdn_tpu/native/flow_engine.cpp \
+   && ASAN_OPTIONS=detect_leaks=0 "$WORK/tc_asan_drv" \
+   && ASAN_OPTIONS=detect_leaks=0 TC_ENGINE_THREADS=4 "$WORK/tc_asan_drv"
+then
+  asan_engine_status=pass
+  echo "flow_engine: asan clean"
+fi
+
 # ---- phase 3: tsan (concurrent feed/flush) ---------------------------------
 echo "=== phase tsan: concurrent tc_engine_feed/tc_engine_flush under TSan"
 if g++ -O1 -g -fsanitize=thread \
@@ -146,15 +169,15 @@ then
 fi
 
 # ---- summary ---------------------------------------------------------------
-printf '{"phases": [{"name": "asan", "status": "%s"}, {"name": "ubsan", "status": "%s"}, {"name": "tsan", "status": "%s"}], "ok": %s}\n' \
-  "$asan_status" "$ubsan_status" "$tsan_status" \
-  "$([ "$asan_status$ubsan_status$tsan_status" = passpasspass ] \
+printf '{"phases": [{"name": "asan", "status": "%s"}, {"name": "ubsan", "status": "%s"}, {"name": "asan_engine", "status": "%s"}, {"name": "tsan", "status": "%s"}], "ok": %s}\n' \
+  "$asan_status" "$ubsan_status" "$asan_engine_status" "$tsan_status" \
+  "$([ "$asan_status$ubsan_status$asan_engine_status$tsan_status" = passpasspasspass ] \
      && echo true || echo false)" > "$SUMMARY"
 cat "$SUMMARY"
 
-if [ "$asan_status$ubsan_status$tsan_status" = passpasspass ]; then
+if [ "$asan_status$ubsan_status$asan_engine_status$tsan_status" = passpasspasspass ]; then
   echo "native_sanitize: all clean (summary: $SUMMARY)"
   exit 0
 fi
-echo "native_sanitize: FAILURES (asan=$asan_status ubsan=$ubsan_status tsan=$tsan_status)" >&2
+echo "native_sanitize: FAILURES (asan=$asan_status ubsan=$ubsan_status asan_engine=$asan_engine_status tsan=$tsan_status)" >&2
 exit 1

@@ -28,11 +28,21 @@ extern "C" {
 void* tc_engine_create(uint32_t capacity, uint32_t max_batch);
 void tc_engine_destroy(void* h);
 uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len);
+uint64_t tck_feed_lines(void* h, const char* buf, uint64_t len,
+                        uint32_t source);
 uint64_t tc_engine_pending(void* h);
 uint32_t tc_engine_flush(void* h, int32_t* slot, int32_t* time,
                          uint32_t* pkts_lo, float* pkts_f,
                          uint32_t* bytes_lo, float* bytes_f,
                          uint8_t* is_fwd, uint8_t* is_create);
+uint64_t tck_flush_wire(void* h, uint32_t* wire, const uint32_t* buckets,
+                        uint32_t n_buckets, uint32_t pad_slot);
+uint32_t tck_slots_for_source(void* h, uint32_t source, uint32_t* out);
+void tck_reset_tail(void* h, uint32_t source);
+uint64_t tck_parse_errors_total(void* h);
+uint64_t tck_parse_errors(void* h, uint32_t source);
+uint64_t tck_source_parsed(void* h, uint32_t source);
+void tc_engine_release_slots(void* h, const uint32_t* slots, uint32_t n);
 int tc_engine_last_flush_conflict(void* h);
 uint64_t tc_engine_dropped(void* h);
 uint64_t tc_engine_parsed(void* h);
@@ -51,6 +61,167 @@ constexpr uint32_t kMaxBatch = 256;
 constexpr int kChunks = 400;
 constexpr int kLinesPerChunk = 200;
 constexpr int kFlows = 1000;  // < kCap: nothing is ever dropped
+
+// multi-source phase: N feeder threads, one per namespace, all emitting
+// the SAME flow-tuple population — overlapping tuples, disjoint
+// namespaces (the fan-in contract the source-folded fingerprint makes)
+constexpr uint32_t kSources = 4;
+constexpr int kChunks2 = 150;
+constexpr int kLines2 = 120;
+constexpr int kFlows2 = 500;        // 4 * 500 < kCap: nothing dropped
+constexpr int kBadEvery = 40;       // deliberate malformed line cadence
+
+int run_multisource() {
+  void* eng = tc_engine_create(kCap, kMaxBatch);
+  if (eng == nullptr) {
+    std::fprintf(stderr, "tc_engine_create (multisource) failed\n");
+    return 1;
+  }
+  std::atomic<uint32_t> feeders_done{0};
+  std::vector<uint64_t> valid_fed(kSources + 1, 0);
+  std::vector<uint64_t> bad_fed(kSources + 1, 0);
+  std::vector<std::thread> feeders;
+  feeders.reserve(kSources);
+  for (uint32_t sid = 1; sid <= kSources; ++sid) {
+    feeders.emplace_back([&, sid] {
+      uint64_t counter = 1;
+      for (int c = 0; c < kChunks2; ++c) {
+        std::string chunk;
+        for (int l = 0; l < kLines2; ++l) {
+          int flow = (c * kLines2 + l) % kFlows2;
+          char line[256];
+          if ((c * kLines2 + l) % kBadEvery == kBadEvery - 1) {
+            // malformed telemetry: 'data' prefix, garbage body — must
+            // be counted against THIS source and skipped, never crash
+            int n = std::snprintf(line, sizeof line,
+                                  "data\t%d\tbroken\n", c + 1);
+            chunk.append(line, static_cast<size_t>(n));
+            bad_fed[sid]++;
+            continue;
+          }
+          int n = std::snprintf(
+              line, sizeof line,
+              "data\t%d\tdp%d\t1\taa:bb:%02x:%02x\tcc:dd:%02x:%02x\t2"
+              "\t%llu\t%llu\n",
+              c + 1, flow % 7, flow & 0xff, (flow >> 8) & 0xff,
+              flow & 0xff, (flow >> 8) & 0xff,
+              static_cast<unsigned long long>(counter),
+              static_cast<unsigned long long>(counter * 64));
+          chunk.append(line, static_cast<size_t>(n));
+          valid_fed[sid]++;
+          ++counter;
+        }
+        // split mid-line: each source's PRIVATE tail carry runs
+        // concurrently with every other source's feed and the flush
+        size_t half = chunk.size() / 2;
+        tck_feed_lines(eng, chunk.data(), half, sid);
+        tck_feed_lines(eng, chunk.data() + half, chunk.size() - half,
+                       sid);
+      }
+      feeders_done.fetch_add(1);
+    });
+  }
+
+  std::atomic<uint64_t> rows{0};
+  std::thread flusher([&] {
+    std::vector<uint32_t> wire(static_cast<size_t>(kMaxBatch) * 6);
+    const uint32_t buckets[3] = {64, kMaxBatch / 2, kMaxBatch};
+    while (true) {
+      uint64_t r = tck_flush_wire(eng, wire.data(), buckets, 3, kCap);
+      tc_engine_last_flush_conflict(eng);
+      if (r == 0) {
+        if (feeders_done.load() == kSources &&
+            tc_engine_pending(eng) == 0)
+          break;
+        std::this_thread::yield();
+        continue;
+      }
+      uint32_t padded = static_cast<uint32_t>(r & 0xFFFFFFFFu);
+      uint32_t width = static_cast<uint32_t>(r >> 32);
+      for (uint32_t i = 0; i < padded; ++i) {
+        if ((wire[static_cast<size_t>(i) * width] & 0x3FFFFFFFu) != kCap)
+          rows += 1;
+      }
+    }
+  });
+
+  std::thread poller([&] {
+    std::vector<uint32_t> slots(kCap);
+    char src[64], dst[64];
+    while (feeders_done.load() != kSources) {
+      tck_parse_errors_total(eng);
+      for (uint32_t sid = 1; sid <= kSources; ++sid) {
+        tck_parse_errors(eng, sid);
+        tck_source_parsed(eng, sid);
+        tck_slots_for_source(eng, sid, slots.data());
+      }
+      tc_engine_num_flows(eng);
+      tc_engine_slot_meta(eng, 0, src, dst, sizeof src);
+      std::this_thread::yield();
+    }
+  });
+
+  for (auto& f : feeders) f.join();
+  flusher.join();
+  poller.join();
+
+  int rc = 0;
+  uint64_t total_valid = 0;
+  for (uint32_t sid = 1; sid <= kSources; ++sid) {
+    total_valid += valid_fed[sid];
+    if (tck_source_parsed(eng, sid) != valid_fed[sid] ||
+        tck_parse_errors(eng, sid) != bad_fed[sid]) {
+      std::fprintf(stderr,
+                   "source %u accounting: parsed=%llu/%llu "
+                   "errors=%llu/%llu\n",
+                   sid,
+                   static_cast<unsigned long long>(
+                       tck_source_parsed(eng, sid)),
+                   static_cast<unsigned long long>(valid_fed[sid]),
+                   static_cast<unsigned long long>(
+                       tck_parse_errors(eng, sid)),
+                   static_cast<unsigned long long>(bad_fed[sid]));
+      rc = 1;
+    }
+  }
+  if (tc_engine_parsed(eng) != total_valid ||
+      tc_engine_dropped(eng) != 0 || rows.load() != total_valid) {
+    std::fprintf(stderr,
+                 "multisource parity: parsed=%llu dropped=%llu "
+                 "rows=%llu expected=%llu\n",
+                 static_cast<unsigned long long>(tc_engine_parsed(eng)),
+                 static_cast<unsigned long long>(tc_engine_dropped(eng)),
+                 static_cast<unsigned long long>(rows.load()),
+                 static_cast<unsigned long long>(total_valid));
+    rc = 1;
+  }
+  // namespace eviction: source 2's slots, exactly, then slot reuse.
+  // Leave a dangling partial line first and reset it the way
+  // FlowStateEngine.evict_source does — the dead incarnation's
+  // fragment must not survive the eviction to splice a later chunk.
+  const char frag[] = "data\t9\t1\t1\thalf";
+  tck_feed_lines(eng, frag, sizeof(frag) - 1, 2);
+  std::vector<uint32_t> slots(kCap);
+  uint32_t n2 = tck_slots_for_source(eng, 2, slots.data());
+  uint32_t before = tc_engine_num_flows(eng);
+  tck_reset_tail(eng, 2);
+  tc_engine_release_slots(eng, slots.data(), n2);
+  if (n2 != kFlows2 || tc_engine_num_flows(eng) != before - n2 ||
+      tck_slots_for_source(eng, 2, slots.data()) != 0) {
+    std::fprintf(stderr, "namespace eviction: n2=%u before=%u after=%u\n",
+                 n2, before, tc_engine_num_flows(eng));
+    rc = 1;
+  }
+  tc_engine_destroy(eng);
+  if (rc == 0) {
+    std::printf("multisource driver: %llu records across %u namespaces, "
+                "%llu malformed counted, eviction exact\n",
+                static_cast<unsigned long long>(total_valid), kSources,
+                static_cast<unsigned long long>(
+                    bad_fed[1] + bad_fed[2] + bad_fed[3] + bad_fed[4]));
+  }
+  return rc;
+}
 
 }  // namespace
 
@@ -152,5 +323,10 @@ int main() {
                 static_cast<unsigned long long>(parsed),
                 static_cast<unsigned long long>(rows.load()));
   }
-  return rc;
+  if (rc != 0) return rc;
+  // phase 2: concurrent multi-source tck_feed_lines over overlapping
+  // flow tuples in disjoint namespaces, flushed through the packed
+  // wire path, with live per-source accounting polls and a namespace
+  // eviction at the end
+  return run_multisource();
 }

@@ -63,6 +63,20 @@ if [ -f tools/bench_e2e.py ]; then
   fi
 fi
 
+# native-ingest serving budget: the whole wire path (tck_feed_lines →
+# pinned tck_flush_wire staging → scatter → predict → render) at batch
+# 16k, native-vs-Python A/B with the render-identity gate — the chip
+# twin of docs/artifacts/e2e_budget_native_cpu.json
+if [ -f tools/bench_e2e.py ]; then
+  run_step 1200 /tmp/tpu_day_e2e_native.log python tools/bench_e2e.py \
+    --serve-budget
+  if [ "$STEP_OK" = 1 ] && grep '^{' /tmp/tpu_day_e2e_native.log | tail -1 \
+      | grep -q '"platform": "tpu"'; then
+    grep '^{' /tmp/tpu_day_e2e_native.log | tail -1 \
+      > docs/artifacts/e2e_budget_native_tpu.json
+  fi
+fi
+
 # the live counterpart: the latency-provenance waterfall through the
 # REAL fan-in serve path (short kernels, ~1 min) — lands beside the
 # microbench budget so the chip window carries both views

@@ -556,10 +556,12 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None,
     quarantines and /healthz reads its per-source roster.
 
     ``stamp`` arms latency-provenance emit stamping (obs/latency.py):
-    fan-in pumps stamp at ``_deliver``, subprocess collectors at pipe
-    parse on the reader thread, pull-paced direct sources at
-    generation; raw byte sources cannot stamp (no records host-side)
-    and the serve loop degrades them to arrival-time provenance."""
+    fan-in pumps stamp at ``_deliver`` (raw-mode pumps carry the
+    pump-read stamp on the queue entry itself — the provenance seam
+    survives byte delivery), subprocess collectors at pipe parse on the
+    reader thread, pull-paced direct sources at generation; DIRECT raw
+    byte sources cannot stamp (no records host-side) and the serve
+    loop degrades them to arrival-time provenance."""
     if _fanin_active(args):
         from .ingest import fanin
         from .utils.metrics import global_metrics
@@ -579,6 +581,10 @@ def _tick_source(args, raw: bool = False, recorder=None, probe_out=None,
         tier = fanin.FanInIngest(
             specs, quarantine_s=args.source_quarantine,
             metrics=global_metrics, recorder=recorder, stamp=stamp,
+            # native ingest rides the raw wire end to end: pumps
+            # deliver bytes, ticks() yields RawTick batches, and the
+            # C++ keyer namespaces per (sid, payload) pair
+            raw=raw,
         )
         if probe_out is not None:
             probe_out["probe"] = tier.alive
@@ -686,19 +692,10 @@ def _run_classify_armed(args, lock_witness) -> None:
     if sharded and (args.restore_serve_state or args.save_serve_state
                     or args.serve_checkpoint_every):
         sys.exit("serving-state checkpoints are single-device (no --shards)")
-    fanin_n = (
-        len(args.source_spec) if args.source_spec else args.sources
-    )
     if _fanin_active(args) and sharded:
         # the sharded engine has no per-slot source map, so a dead
         # source's namespace could not be quarantine-evicted
         sys.exit("the fan-in ingest tier is single-device (no --shards)")
-    if _fanin_active(args) and fanin_n > 1 and args.native_ingest == "on":
-        sys.exit(
-            "multi-source fan-in routes through the Python batcher "
-            "(the C++ index has no per-slot source map for namespace "
-            "eviction) — drop --native-ingest on or serve one source"
-        )
     if args.serve_checkpoint_every and not args.serve_checkpoint_dir:
         sys.exit("--serve-checkpoint-every needs --serve-checkpoint-dir")
     if args.obs_dump_on_exit and not args.obs_dir:
@@ -762,17 +759,11 @@ def _run_classify_armed(args, lock_witness) -> None:
             metrics=m, recorder=recorder, slo_s=args.latency_slo,
         )
 
+    # --native-ingest composes with --sources N: the C++ engine keys
+    # per-source namespaces (tck_feed_lines folds the source id) and
+    # owns the per-slot source map behind namespace eviction, so
+    # multi-source fan-in rides the raw wire path end to end
     use_native = _use_native(args)
-    if _fanin_active(args) and fanin_n > 1 and use_native:
-        # namespace-scoped eviction needs FlowIndex.slot_source — the
-        # Python batcher's per-slot source map (validated above for an
-        # explicit --native-ingest on; 'auto' just falls back here)
-        use_native = False
-        print(
-            "fan-in: multi-source serve uses the Python batcher "
-            "(per-slot source namespacing)",
-            file=sys.stderr,
-        )
     if args.restore_serve_state:
         from .io import serving_checkpoint as _sc
 
@@ -1277,6 +1268,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 health=None, probe_out=None, degrade=None,
                 drift=None, inc=None, lat=None, usr1=None,
                 openset=None) -> None:
+    from .ingest.fanin import RawTick
     from .utils.profiling import trace
 
     # Pipelined serving (serving/pipeline.py): the host stage (this
@@ -1323,7 +1315,13 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
     probe_wired = False
     end = object()  # next() sentinel: a batch is never None-able
     source = _tick_source(
-        args, raw=use_native and args.source in ("ryu", "controller"),
+        args,
+        # raw wherever the native engine can consume bytes directly:
+        # pipe-fed direct sources, and EVERY fan-in kind (the tier's
+        # pumps render capture/synthetic ticks to the wire themselves)
+        raw=use_native and (
+            args.source in ("ryu", "controller") or _fanin_active(args)
+        ),
         recorder=recorder, probe_out=probe_out, stamp=lat is not None,
     )
     try:
@@ -1372,9 +1370,27 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                         with tracer.span("parse"):
                             if isinstance(batch, bytes):
                                 n_rec = engine.ingest_bytes(batch)
+                            elif isinstance(batch, RawTick):
+                                # native fan-in: one tck_feed_lines
+                                # call per (source, poll batch) — no
+                                # per-flow string ever touches Python
+                                n_rec = sum(
+                                    engine.ingest_bytes(data, sid)
+                                    for sid, data in batch
+                                )
                             else:
                                 n_rec = engine.ingest(batch)
                         m.inc("records", n_rec)
+                        # malformed wire lines, counted + skipped at
+                        # the parse seam — the accessor is spine-
+                        # agnostic (C++ per-source counters, or the
+                        # Python fallback's mirror), so the gauge
+                        # reads the same on either path instead of
+                        # vanishing when --native-ingest is off
+                        m.set(
+                            "native_parse_errors",
+                            engine.parse_errors(),
+                        )
                         if lat is not None:
                             lat.mark_parse()
                         with tracer.span("scatter"):
@@ -1532,20 +1548,11 @@ def _evict_dead_namespaces(tier, engine, m, pipe, recorder,
     if pipe is not None and not pipe.idle():
         return
     for sid in tier.take_evictions():
-        if engine.native:
-            # single-source fan-in keeps the C++ engine, whose index
-            # has no per-slot source map — the dead source's flows are
-            # reclaimed by the ordinary idle timeout instead of a
-            # surgical namespace clear (its queued backlog was already
-            # purged by take_evictions, so nothing re-creates them)
-            m.inc("source_evictions_skipped")
-            print(
-                f"WARNING: telemetry source {sid} dead past quarantine "
-                f"— native index has no source map; its flows will be "
-                f"reclaimed by the idle timeout",
-                file=sys.stderr,
-            )
-            continue
+        # surgical namespace clear on EITHER spine: the Python index
+        # walks its sparse slot_source map, the C++ engine its per-slot
+        # namespace tags (tck_slots_for_source) — the old native
+        # degrade-to-idle-timeout fallback (and its
+        # source_evictions_skipped counter) is gone
         n = engine.evict_source(sid)
         if lat is not None:
             # the namespace's rows are gone: pending latency entries
@@ -1828,35 +1835,31 @@ def _run_train(args) -> None:
     if not args.traffic_type:
         sys.exit("ERROR: specify traffic type.")  # reference :225
     out_path = args.out or f"{args.traffic_type}_training_data.csv"
-    fanin_n = len(args.source_spec) if args.source_spec else args.sources
-    if _fanin_active(args) and fanin_n > 1 and args.native_ingest == "on":
-        sys.exit(
-            "multi-source fan-in routes through the Python batcher "
-            "(the C++ index has no per-slot source map) — drop "
-            "--native-ingest on or collect from one source"
-        )
+    # --native-ingest is legal with --sources N here too: the fan-in
+    # tier delivers raw byte batches per source and the C++ keyer folds
+    # the source id into every flow key (tck_feed_lines), so N sources'
+    # identical flow tuples land in N disjoint slots — the old
+    # collapse-into-one-slot hazard is gone
     use_native = _use_native(args)
-    if _fanin_active(args) and fanin_n > 1 and use_native:
-        # same rule as the classify path: the C++ keyer round-trips
-        # records through the wire format, which has no source field —
-        # N sources' identical flow tuples would collapse into ONE slot
-        # and interleave their cumulative counters into garbage deltas
-        use_native = False
-        print(
-            "fan-in: multi-source collection uses the Python batcher "
-            "(per-slot source namespacing)",
-            file=sys.stderr,
-        )
     engine = FlowStateEngine(args.capacity, native=use_native)
     deadline = time.time() + args.duration
     ticks = 0
     with open(out_path, "w") as f:
         f.write("\t".join(list(CSV_COLUMNS_16) + [LABEL_COLUMN]) + "\n")
+        from .ingest.fanin import RawTick
+
         for batch in _tick_source(
-            args, raw=engine.native and args.source in ("ryu", "controller")
+            args,
+            raw=engine.native and (
+                args.source in ("ryu", "controller")
+                or _fanin_active(args)
+            ),
         ):
             if isinstance(batch, bytes):
                 engine.ingest_bytes(batch)
+            elif isinstance(batch, RawTick):
+                for sid, data in batch:
+                    engine.ingest_bytes(data, sid)
             else:
                 engine.ingest(batch)
             engine.step()

@@ -162,6 +162,47 @@ def pack_wire(b: UpdateBatch) -> "np.ndarray":
     return w
 
 
+class WireStage:
+    """Pinned, reusable host staging for packed wire batches — the
+    zero-copy half of native ingest (native/engine.NativeBatcher
+    .flush_wire): the C++ engine writes each flushed generation straight
+    into one of these buffers in the ``pack_wire`` layout, and the view
+    handed back goes to ``apply_wire`` untouched. Two rotating buffers
+    (the ``FeatureStage`` double-buffer discipline from the pipelined
+    serve, serving/pipeline.py): the previous flush's view — possibly
+    still being consumed by an in-flight transfer — is never overwritten
+    by the next flush. Buffers are flat uint32 so one allocation serves
+    both wire widths: a (rows, 4) compact view and a (rows, 6) full view
+    are reshapes of the same pages.
+    """
+
+    def __init__(self, max_rows: int):
+        import numpy as np
+
+        self._bufs = (
+            np.empty(max_rows * 6, np.uint32),
+            np.empty(max_rows * 6, np.uint32),
+        )
+        self._i = 0
+
+    def buffer(self):
+        """The buffer the NEXT flush writes into (flat uint32)."""
+        return self._bufs[self._i]
+
+    def view(self, rows: int, width: int):
+        """Consume the current buffer as a (rows, width) wire matrix and
+        rotate — the caller owns the view until the flush after next."""
+        buf = self._bufs[self._i]
+        self._i ^= 1
+        return buf[: rows * width].reshape(rows, width)
+
+    def touch(self) -> None:
+        """Fault every page in (warmup): first-tick latency must not pay
+        the staging buffers' lazy page allocation."""
+        for b in self._bufs:
+            b.fill(0)
+
+
 def widen_wire(w: "np.ndarray") -> "np.ndarray":
     """Host-side (B, 4) compact → (B, 6) full wire: rebuilds the f32
     lanes as ``float32(lo)`` (exact under the compact form's < 2³¹

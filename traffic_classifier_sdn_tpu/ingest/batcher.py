@@ -274,7 +274,19 @@ class HostSpine:
             self.index = FlowIndex(capacity)
             self.batcher = Batcher(self.index, buckets)
         self.buckets = buckets
-        self._tail = b""  # partial line carried across ingest_bytes calls
+        # partial lines carried across ingest_bytes calls, PER SOURCE:
+        # the fan-in raw path interleaves byte chunks from N sources,
+        # and one source's half line must never be completed by another
+        # source's next chunk (the native engine keeps the same map)
+        self._tails: dict[int, bytes] = {}
+        # native flush_wire dispatches in flight since the last device
+        # sync — the step() staging-overwrite guard's cross-call state
+        self._staged_flushes = 0
+        # malformed-telemetry accounting for the Python fallback parser
+        # ('data'-prefixed lines parse_line rejected) — the counterpart
+        # of the C++ engine's per-source parse-error counters, so
+        # native_parse_errors reads the same on either path
+        self._parse_errors: dict[int, int] = {}
         self._last_time = 0
         # cumulative host→device update-batch bytes (padded wire matrices)
         # — lets serving benches report what actually crosses the link
@@ -303,26 +315,48 @@ class HostSpine:
             return max(self._last_time, self.batcher.last_time)
         return self._last_time
 
-    def ingest_bytes(self, data: bytes) -> int:
+    def ingest_bytes(self, data: bytes, source: int = 0) -> int:
         """Bulk raw-byte ingest (monitor pipe chunks). On the native path
         this never crosses into Python per line; the fallback parses with
-        protocol.parse_line. Returns records parsed."""
+        protocol.parse_line. ``source`` is the fan-in namespace the bytes
+        belong to (0 = the legacy/default namespace) — the raw wire
+        carries no source field, so the delivery path supplies it.
+        Returns records parsed."""
         if self.native:
-            return self.batcher.feed(data)
-        from .protocol import parse_line
+            return self.batcher.feed(data, source)
+        from dataclasses import replace
 
-        data = self._tail + data
+        from .protocol import PREFIX, parse_line
+
+        data = self._tails.get(source, b"") + data
         # split on \n only (not universal newlines) — same framing as the
         # native engine; the final element is the partial-line tail
         parts = data.split(b"\n")
-        self._tail = parts.pop()
+        self._tails[source] = parts.pop()
         n = 0
         for line in parts:
             r = parse_line(line + b"\n")
             if r is not None:
+                if source:
+                    r = replace(r, source=source)
                 self.ingest([r])
                 n += 1
+            elif line.startswith(PREFIX):
+                # telemetry-shaped but unparseable = malformed (noise
+                # lines are free) — mirror the C++ engine's accounting
+                self._parse_errors[source] = (
+                    self._parse_errors.get(source, 0) + 1
+                )
         return n
+
+    def parse_errors(self, source: int | None = None) -> int:
+        """Malformed telemetry lines rejected by the parser (total, or
+        one source's) — native and Python paths count identically."""
+        if self.native:
+            return self.batcher.parse_errors(source)
+        if source is None:
+            return sum(self._parse_errors.values())
+        return self._parse_errors.get(source, 0)
 
     @property
     def dropped(self) -> int:
@@ -462,19 +496,59 @@ class FlowStateEngine(HostSpine):
 
     def step(self) -> bool:
         """Flush all pending records into the device table; False if idle.
-        Loops because one tick can exceed the largest batch bucket."""
+        Loops because one tick can exceed the largest batch bucket.
+
+        Native path: the C++ engine emits each generation directly in
+        the packed wire layout into pinned staging (flush_wire) — no
+        per-flush UpdateBatch materialization, no pack_wire column
+        pass; the Python fallback keeps the record-object route. Both
+        feed the identical apply_wire scatter (the dirty-tracking
+        variant fuses the incremental path's per-slot mark into the
+        same dispatch, so the label cache rides for free)."""
         applied = False
+        if self.native:
+            # gate on pending records so the overwrite guard below only
+            # runs ahead of a real flush — flush_wire itself writes the
+            # staging buffer, so the sync must precede the CALL, but an
+            # empty queue must not pay (or reset) it
+            while len(self.batcher):
+                if self._staged_flushes >= 2:
+                    # the staging is double-buffered: flush k reuses
+                    # flush k-2's buffer, and apply dispatch is async
+                    # with the wire as a NON-donated (possibly
+                    # zero-copy) host buffer — drain the in-flight
+                    # applies before the C++ side overwrites it. The
+                    # count persists ACROSS step() calls: the hazard
+                    # spans ticks (this tick's first flush reuses the
+                    # buffer staged two flushes ago, whichever tick
+                    # dispatched its apply), so a per-call counter
+                    # would leave consecutive single-flush steps
+                    # unguarded. Near-free on the common path: the
+                    # apply from two flushes back is all but always
+                    # already retired.
+                    jax.block_until_ready(self.table)
+                    self._staged_flushes = 0
+                if (w := self.batcher.flush_wire()) is None:
+                    break
+                self._apply_wire(w)
+                self._staged_flushes += 1
+                applied = True
+            return applied
         while (batch := self.batcher.flush()) is not None:
-            w = ft.pack_wire(batch)
-            self.wire_bytes += w.nbytes  # padded, i.e. what actually moves
-            if self.dirty is None:
-                self.table = apply_wire_jit(self.table, w)
-            else:
-                self.table, self.dirty = apply_wire_dirty_jit(
-                    self.table, self.dirty, w
-                )
+            self._apply_wire(ft.pack_wire(batch))
             applied = True
         return applied
+
+    def _apply_wire(self, w) -> None:
+        """One packed wire batch into the device table (dirty-fused when
+        the incremental label cache is live)."""
+        self.wire_bytes += w.nbytes  # padded, i.e. what actually moves
+        if self.dirty is None:
+            self.table = apply_wire_jit(self.table, w)
+        else:
+            self.table, self.dirty = apply_wire_dirty_jit(
+                self.table, self.dirty, w
+            )
 
     def features(self):
         """(capacity, 12) device feature matrix (classifier input)."""
@@ -533,19 +607,26 @@ class FlowStateEngine(HostSpine):
         while every other namespace keeps serving untouched. Returns
         the number of evicted flows.
 
-        Python-batcher only: the C++ index has no per-slot source map
-        (the CLI routes multi-source fan-in through the Python batcher
-        for exactly this reason)."""
-        if self.native:
-            raise RuntimeError(
-                "namespace eviction needs the Python batcher's "
-                "per-slot source map (fan-in disables --native-ingest)"
-            )
+        Both spines: the Python index walks its sparse slot_source map;
+        the C++ engine scans its per-slot namespace tags
+        (tck_slots_for_source) — either way the slot set crosses once,
+        the device rows clear in bucketed batches, and the index
+        releases in bulk."""
         # flush first: a pending row for an about-to-clear slot would
         # scatter stale counters into a freed (reassignable) row — the
         # same ordering evict_idle enforces
         self.step()
-        slots = np.asarray(
-            sorted(self.index.slots_for_source(source)), np.int64
-        )
+        # drop the namespace's dangling partial line with its slots, on
+        # BOTH spines: a restarted stream's first chunk must not
+        # complete the dead incarnation's fragment (the fan-in queue's
+        # \x00\n poison seam guards the same boundary from the delivery
+        # side — this covers direct engine callers too)
+        self._tails.pop(source, None)
+        if self.native:
+            self.batcher.reset_tail(source)
+            slots = self.batcher.slots_for_source(source).astype(np.int64)
+        else:
+            slots = np.asarray(
+                sorted(self.index.slots_for_source(source)), np.int64
+            )
         return self._clear_and_release(slots)

@@ -152,18 +152,49 @@ class FanInQueue:
         # source pump threads, the consumer is the serve loop, and the
         # drop counters are read by the obs roster — all cross-thread
         self._lock = threading.Lock()
-        # (sid, records, enq_ts) in arrival order
+        # (sid, payload, n_records, enq_ts, emit_ts) in arrival order;
+        # payload is a record list (the Python-batcher path) or a raw
+        # wire-format bytes blob (the native path — n and emit travel
+        # explicitly because bytes can't carry a stamp attribute)
         self._batches: deque = deque()
         self._queued = 0  # records currently queued
         self._drops: dict[int, int] = {}  # sid → records dropped
         self._accepted: dict[int, int] = {}  # sid → records accepted
+        # raw-mode framing poison: sources whose BYTE stream lost a
+        # chunk (bound drop or eviction purge). Raw chunks can end
+        # mid-line, and the consumer's per-source tail carry would
+        # otherwise splice the pre-drop fragment onto the post-drop
+        # chunk's head — a torn line that might parse as a wrong-but-
+        # valid record. The next accepted byte batch is prefixed with
+        # b"\x00\n" (the collector's torn-read poison idiom): the stale
+        # tail terminates as an unparseable line (counted malformed if
+        # telemetry-shaped) and framing resyncs at a real boundary.
+        self._poisoned: set[int] = set()
 
     def put(self, sid: int, records: list) -> bool:
         """Enqueue one poll batch; False when it was dropped (bound hit
         or an injected enqueue failure — the chaos seam for a queue-full
         drop burst, ABSORBED here by design)."""
-        n = len(records)
+        return self._put(sid, records, len(records), None)
+
+    def put_bytes(self, sid: int, data: bytes, n_records: int,
+                  emit_ts: float | None = None) -> bool:
+        """Raw-wire counterpart of ``put`` — the native-ingest delivery
+        unit: one poll batch as wire-format bytes, its record count for
+        the bound/accounting, and the pump-read emit stamp carried
+        EXPLICITLY (the latency plane's provenance seam: a byte batch
+        has no record object to stamp, so the emit moment rides the
+        queue entry instead — same clock domain, same fold)."""
+        return self._put(sid, data, n_records, emit_ts)
+
+    def _put(self, sid: int, payload, n: int,
+             emit_ts: float | None) -> bool:
+        is_bytes = isinstance(payload, (bytes, bytearray))
         if n == 0:
+            # empty poll (record path, or a genuinely empty byte tick)
+            # — nothing to queue. Raw callers pass n >= 1 for any
+            # nonempty payload (a newline-less pipe fragment counts as
+            # one pending record), so no bytes are ever eaten here.
             return True
         dropped = False
         try:
@@ -176,12 +207,19 @@ class FanInQueue:
                 if self._queued + n > self.max_records:
                     dropped = True
                 else:
-                    self._batches.append((sid, records, enq))
+                    if is_bytes and sid in self._poisoned:
+                        # terminate the consumer's stale pre-drop tail
+                        # at an unparseable boundary (see _poisoned)
+                        self._poisoned.discard(sid)
+                        payload = b"\x00\n" + bytes(payload)
+                    self._batches.append((sid, payload, n, enq, emit_ts))
                     self._queued += n
                     self._accepted[sid] = self._accepted.get(sid, 0) + n
         if dropped:
             with self._lock:
                 self._drops[sid] = self._drops.get(sid, 0) + n
+                if is_bytes:
+                    self._poisoned.add(sid)
             # record OUTSIDE the queue lock: the ring has its own lock
             # and this one stays a leaf (graftlock lock-order)
             if self._recorder is not None:
@@ -191,6 +229,18 @@ class FanInQueue:
                 )
             return False
         return True
+
+    def poison(self, sid: int) -> None:
+        """Force a framing resync for ``sid``'s byte stream: the next
+        accepted byte batch is prefixed with the ``b"\\x00\\n"`` seam
+        (see ``_poisoned``). The tier calls this at namespace eviction
+        and source restart — the CONSUMER's per-source tail can hold
+        the dead incarnation's dangling half line even when the purge
+        found an already-drained queue (nothing queued is not the same
+        as nothing carried), and a restarted worker's fresh collector
+        shares no seam with the old worker's last partial chunk."""
+        with self._lock:
+            self._poisoned.add(sid)
 
     def take(self, exclude=()) -> list[tuple[int, list]]:
         """Pop the OLDEST batch per source (arrival order preserved),
@@ -208,19 +258,23 @@ class FanInQueue:
             kept: deque = deque()
             seen = set(exclude)
             while self._batches:
-                sid, recs, enq = self._batches.popleft()
+                sid, payload, n, enq, emit = self._batches.popleft()
                 if sid in seen:
-                    kept.append((sid, recs, enq))
+                    kept.append((sid, payload, n, enq, emit))
                 else:
                     seen.add(sid)
-                    out.append((sid, recs))
-                    self._queued -= len(recs)
+                    out.append((sid, payload))
+                    self._queued -= n
                     if deq is not None:
-                        self._taken_prov.append((
-                            sid,
-                            recs[0].emit_ts if recs else None,
-                            enq, deq, len(recs),
-                        ))
+                        if emit is None and not isinstance(
+                            payload, (bytes, bytearray)
+                        ):
+                            # record batches carry the stamp on their
+                            # LEAD record (protocol.stamp_records)
+                            emit = (
+                                payload[0].emit_ts if payload else None
+                            )
+                        self._taken_prov.append((sid, emit, enq, deq, n))
             self._batches = kept
         return out
 
@@ -241,18 +295,25 @@ class FanInQueue:
         slots in a namespace nothing will ever quarantine again.
         Returns the records dropped."""
         purged = 0
+        purged_bytes = False
         with self._lock:
             kept: deque = deque()
             while self._batches:
-                s, recs, enq = self._batches.popleft()
-                if s == sid:
-                    purged += len(recs)
+                entry = self._batches.popleft()
+                if entry[0] == sid:
+                    purged += entry[2]
+                    if isinstance(entry[1], (bytes, bytearray)):
+                        purged_bytes = True
                 else:
-                    kept.append((s, recs, enq))
+                    kept.append(entry)
             self._batches = kept
             if purged:
                 self._queued -= purged
                 self._drops[sid] = self._drops.get(sid, 0) + purged
+                if purged_bytes:
+                    # a restarted incarnation's first chunk must not
+                    # splice onto the evicted stream's dangling tail
+                    self._poisoned.add(sid)
         if purged and self._recorder is not None:
             self._recorder.record(
                 "fanin.drop", source=sid, records=purged,
@@ -276,6 +337,14 @@ class FanInQueue:
             return dict(self._accepted)
 
 
+class RawTick(list):
+    """One serve tick of raw wire-format byte batches — ``[(sid,
+    payload), ...]`` ordered by source id, the native-ingest fan-in
+    delivery unit: the serve loop feeds each payload to the C++ engine
+    under its source's namespace (``engine.ingest_bytes(data, sid)``)
+    and no per-flow string ever crosses into Python."""
+
+
 class SourceWorker:
     """One supervised telemetry source pumping into the shared queue.
 
@@ -285,12 +354,21 @@ class SourceWorker:
     access holds ``_state_lock``. A pump that dies for ANY reason —
     stream exhaustion, supervisor budget, injected ``ingest.source_dead``
     fire, even an unexpected exception — lands in DEAD with a ``clean``
-    verdict: only an UNCLEAN death quarantines the namespace."""
+    verdict: only an UNCLEAN death quarantines the namespace.
+
+    ``raw`` selects wire-format byte delivery (the native-ingest fast
+    path): the pump hands the queue one bytes blob per poll tick —
+    capture sources replay their recorded line bytes, synthetic sources
+    render straight to the wire (``SyntheticFlows.tick_bytes``), cmd
+    sources forward raw pipe chunks — and the namespace is applied at
+    the C++ keyer instead of a per-record ``replace`` pass."""
 
     def __init__(self, spec: SourceSpec, queue: FanInQueue, metrics=None,
                  recorder=None, clock=time.monotonic,
-                 stamp: bool = False, prov_clock=time.perf_counter):
+                 stamp: bool = False, prov_clock=time.perf_counter,
+                 raw: bool = False):
         self.spec = spec
+        self._raw = raw
         self._queue = queue
         self._metrics = metrics
         self._recorder = recorder
@@ -450,6 +528,22 @@ class SourceWorker:
                 self._records += len(records)
                 self._last_put_at = self._clock()
 
+    def _deliver_raw(self, data: bytes, n_records: int) -> None:
+        """Raw-wire delivery: one wire-format blob per poll tick. The
+        emit stamp rides the queue entry explicitly (``put_bytes``) —
+        the provenance seam survives even though no record object
+        exists host-side to stamp; an unstamped tier simply passes
+        None. The namespace is NOT applied here: the consumer feeds the
+        bytes to the C++ keyer under this source's id."""
+        sid = self.spec.sid
+        emit = self._prov_clock() if self._stamp else None
+        ok = self._queue.put_bytes(sid, data, n_records, emit)
+        with self._state_lock:
+            self._ticks += 1
+            if ok:
+                self._records += n_records
+                self._last_put_at = self._clock()
+
     def _pace(self, first: bool) -> bool:
         """Gate one pull-paced emission; False when stopping. Lockstep
         waits for the consumer's credit (every tick, including the
@@ -473,8 +567,17 @@ class SourceWorker:
         return not self._stop_evt.is_set()
 
     def _pump_capture(self) -> bool:
-        from .replay import iter_capture
+        from .replay import iter_capture, iter_capture_bytes
 
+        if self._raw:
+            for i, (data, n) in enumerate(
+                iter_capture_bytes(self.spec.path)
+            ):
+                if not self._pace(first=i == 0):
+                    return True  # stopped — clean
+                fault_point("ingest.source_dead")
+                self._deliver_raw(data, n)
+            return True
         for i, tick in enumerate(iter_capture(self.spec.path)):
             if not self._pace(first=i == 0):
                 return True  # stopped — clean
@@ -494,7 +597,14 @@ class SourceWorker:
             if not self._pace(first=i == 0):
                 return True
             fault_point("ingest.source_dead")
-            self._deliver(syn.tick())
+            if self._raw:
+                # straight to the wire format — per-record objects never
+                # exist anywhere on the raw path (each record is one
+                # line, so the newline count IS the record count)
+                data = syn.tick_bytes()
+                self._deliver_raw(data, data.count(b"\n"))
+            else:
+                self._deliver(syn.tick())
             i += 1
         return True
 
@@ -502,13 +612,15 @@ class SourceWorker:
         from .supervisor import SupervisedCollector
 
         coll = SupervisedCollector(
-            self.spec.cmd, raw=False,
+            self.spec.cmd, raw=self._raw,
             max_restarts=self.spec.max_restarts,
             metrics=self._metrics, recorder=self._recorder,
             # pipe-parse emit stamps on the reader thread: the truest
             # emission proxy (captures pipe→pump queue wait; _deliver's
-            # write-once stamp then leaves these untouched)
-            stamp=self._stamp,
+            # write-once stamp then leaves these untouched). Raw mode
+            # has no records to stamp — the pump-read moment rides the
+            # queue entry instead (_deliver_raw).
+            stamp=self._stamp and not self._raw,
         )
         with self._state_lock:
             self._coll = coll
@@ -530,7 +642,17 @@ class SourceWorker:
                     continue
                 fault_point("ingest.source_dead")
                 time.sleep(0.05)  # let the 1 Hz burst of lines arrive
-                self._deliver([rec, *coll.poll_records()])
+                if self._raw:
+                    data = rec + b"".join(coll.poll_records())
+                    # newline count bounds the record tally (noise lines
+                    # included — the C++ parser does the real
+                    # filtering). Floor 1: a pipe chunk ending mid-line
+                    # can carry ZERO newlines, and a 0-record put would
+                    # no-op — silently eating the fragment and tearing
+                    # the engine's per-source tail framing.
+                    self._deliver_raw(data, max(1, data.count(b"\n")))
+                else:
+                    self._deliver([rec, *coll.poll_records()])
             # clean iff we were stopped, or the monitor finished on
             # purpose — a restart-budget exhaustion is a real death
             return (
@@ -554,7 +676,7 @@ class FanInIngest:
     def __init__(self, specs, queue_records: int = 1 << 16,
                  quarantine_s: float = 5.0, metrics=None, recorder=None,
                  clock=time.monotonic, stamp: bool = False,
-                 prov_clock=time.perf_counter):
+                 prov_clock=time.perf_counter, raw: bool = False):
         specs = list(specs)
         sids = [s.sid for s in specs]
         if len(set(sids)) != len(sids):
@@ -571,6 +693,10 @@ class FanInIngest:
         # drains pop_provenance() per assembled tick
         self._stamp = stamp
         self._prov_clock = prov_clock
+        # raw-wire delivery (native ingest): every pump hands the queue
+        # bytes, ticks() yields RawTick batches, and the namespace is
+        # applied by the C++ keyer per (sid, payload) pair
+        self.raw = raw
         self.queue = FanInQueue(
             queue_records, recorder=recorder, prov_clock=prov_clock,
             collect_provenance=stamp,
@@ -584,6 +710,7 @@ class FanInIngest:
             s.sid: SourceWorker(
                 s, self.queue, metrics=metrics, recorder=recorder,
                 clock=clock, stamp=stamp, prov_clock=prov_clock,
+                raw=raw,
             )
             for s in specs
         }
@@ -631,12 +758,21 @@ class FanInIngest:
             old.spec, self.queue, metrics=self._metrics,
             recorder=self._recorder, clock=self._clock,
             stamp=self._stamp, prov_clock=self._prov_clock,
+            raw=self.raw,
         )
         with self._roster_lock:
             self._quarantine.pop(sid, None)
             self._dead_seen.discard(sid)
             self._workers[sid] = fresh
             started = self._started
+        if self.raw:
+            # a restart can land BEFORE the quarantine evicts (it
+            # cancels the pending quarantine above), so no eviction
+            # poison fires — yet the dead worker's last pipe chunk may
+            # have ended mid-line. The fresh worker's collector carries
+            # no seam with that fragment; resync the consumer's tail
+            # framing before the new stream's first chunk.
+            self.queue.poison(sid)
         if self._recorder is not None:
             self._recorder.record("fanin.source_restart", source=sid)
         if self._metrics is not None:
@@ -688,6 +824,15 @@ class FanInIngest:
                     out.append(sid)
         for sid in out:
             self.queue.purge(sid)
+            if self.raw:
+                # the purge poisons only when it found queued byte
+                # batches — but the consumer may have drained the dead
+                # source's last chunk already, leaving its dangling
+                # half line in the engine's per-source tail. Poison
+                # unconditionally: eviction is the namespace boundary,
+                # and anything the old incarnation left mid-line must
+                # not be completed by a restarted stream's first chunk.
+                self.queue.poison(sid)
         return out
 
     # -- serve-loop surface ------------------------------------------------
@@ -764,7 +909,12 @@ class FanInIngest:
             time.sleep(poll_s)
         if not got:
             return None
+        # sid-sorted merge either way: slot assignment then depends only
+        # on the record streams, not thread arrival timing
         got.sort(key=lambda b: b[0])
+        if self.raw:
+            self._publish_metrics()
+            return RawTick(got)
         merged: list[TelemetryRecord] = []
         for _sid, recs in got:
             merged.extend(recs)

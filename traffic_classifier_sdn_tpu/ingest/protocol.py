@@ -114,7 +114,12 @@ def parse_line(line: bytes) -> TelemetryRecord | None:
     if not line.startswith(PREFIX):
         return None
     fields = line.rstrip(b"\n").split(b"\t")[1:]
-    if len(fields) < 8:
+    # exactly 8 fields after the prefix: the wire format emits exactly
+    # 9 columns, so a line with trailing junk fields is corrupt — not
+    # slop to ignore (the C++ parser rejects identically, and the
+    # exactness is what lets the ingest.native_parse fault seam corrupt
+    # a mid-line fragment by appending a bogus field)
+    if len(fields) != 8:
         return None
     try:
         r = TelemetryRecord(

@@ -37,6 +37,33 @@ def iter_capture(path: str) -> Iterator[list[TelemetryRecord]]:
         yield tick
 
 
+def iter_capture_bytes(path: str) -> Iterator[tuple[bytes, int]]:
+    """Raw-wire replay for the native ingest path: yields ``(payload,
+    n_records)`` per poll tick — the SAME tick boundaries as
+    ``iter_capture`` (the time field of valid telemetry lines), but the
+    payload is the capture's original line bytes, so the C++ parser sees
+    exactly what was recorded and the record streams of the two
+    iterators are identical (the byte-identity anchor for native-ingest
+    fan-in). Invalid lines are dropped here like ``iter_capture`` drops
+    them — the validation already ran to find the tick boundary."""
+    tick: list[bytes] = []
+    current_t = None
+    with open(path, "rb") as f:
+        for line in f:
+            r = parse_line(line)
+            if r is None:
+                continue
+            if current_t is not None and r.time != current_t and tick:
+                yield b"".join(tick), len(tick)
+                tick = []
+            current_t = r.time
+            if not line.endswith(b"\n"):
+                line += b"\n"  # final capture line may lack the newline
+            tick.append(line)
+    if tick:
+        yield b"".join(tick), len(tick)
+
+
 @dataclass
 class SyntheticFlows:
     """A population of bidirectional flows with per-class-like rate

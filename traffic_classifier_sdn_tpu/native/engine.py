@@ -46,6 +46,27 @@ def _load():
         lib.tc_engine_destroy.argtypes = [ct.c_void_p]
         lib.tc_engine_feed.restype = ct.c_uint64
         lib.tc_engine_feed.argtypes = [ct.c_void_p, ct.c_char_p, ct.c_uint64]
+        lib.tck_feed_lines.restype = ct.c_uint64
+        lib.tck_feed_lines.argtypes = [
+            ct.c_void_p, ct.c_char_p, ct.c_uint64, ct.c_uint32,
+        ]
+        lib.tck_flush_wire.restype = ct.c_uint64
+        lib.tck_flush_wire.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_void_p, ct.c_uint32,
+            ct.c_uint32,
+        ]
+        lib.tck_reset_tail.restype = None
+        lib.tck_reset_tail.argtypes = [ct.c_void_p, ct.c_uint32]
+        lib.tck_slots_for_source.restype = ct.c_uint32
+        lib.tck_slots_for_source.argtypes = [
+            ct.c_void_p, ct.c_uint32, ct.c_void_p,
+        ]
+        lib.tck_parse_errors_total.restype = ct.c_uint64
+        lib.tck_parse_errors_total.argtypes = [ct.c_void_p]
+        lib.tck_parse_errors.restype = ct.c_uint64
+        lib.tck_parse_errors.argtypes = [ct.c_void_p, ct.c_uint32]
+        lib.tck_source_parsed.restype = ct.c_uint64
+        lib.tck_source_parsed.argtypes = [ct.c_void_p, ct.c_uint32]
         lib.tc_engine_pending.restype = ct.c_uint64
         lib.tc_engine_pending.argtypes = [ct.c_void_p]
         lib.tc_engine_flush.restype = ct.c_uint32
@@ -135,7 +156,11 @@ class NativeBatcher:
         self._max = self.buckets[-1]
         self._h = lib.tc_engine_create(capacity, self._max)
         if not self._h:
-            raise RuntimeError("tc_engine_create failed")
+            raise RuntimeError(
+                "tc_engine_create failed (capacity must be 1..2^30-1 — "
+                "the wire layout packs slot|flags in 32 bits, the same "
+                "bound pack_wire enforces — and max_batch nonzero)"
+            )
         # Reused flush staging buffers (C fills the first n rows; the
         # padded tail is re-zeroed per flush below).
         m = self._max
@@ -147,6 +172,11 @@ class NativeBatcher:
         self._bytes_f = np.empty(m, np.float32)
         self._is_fwd = np.empty(m, np.uint8)
         self._is_create = np.empty(m, np.uint8)
+        # Pinned double-buffered wire staging (flush_wire): C++ writes
+        # the packed (B, 4|6) uint32 matrix straight into these pages —
+        # no per-flush numpy allocation, no Python column work.
+        self._wire_stage = ft.WireStage(self._max)
+        self._buckets_u32 = np.asarray(self.buckets, np.uint32)
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -155,13 +185,55 @@ class NativeBatcher:
             self._h = None
 
     # -- ingest ------------------------------------------------------------
-    def feed(self, data: bytes) -> int:
-        """Bulk byte ingest (the fast path). Returns records parsed."""
-        return int(self._lib.tc_engine_feed(self._h, data, len(data)))
+    def feed(self, data: bytes, source: int = 0) -> int:
+        """Bulk byte ingest (the fast path): one ``tck_feed_lines``
+        call per poll batch, routed entirely in C++ under ``source``'s
+        flow-table namespace (0 = the legacy/default namespace — the
+        exact pre-fan-in key). Returns records parsed.
+
+        Fault site ``ingest.native_parse`` (ABSORBED): a fire simulates
+        one corrupt line at the head of the batch — counted against
+        this source like any real malformed line and skipped; the rest
+        of the batch parses normally and the serve never sees the
+        failure."""
+        from ..utils.faults import FaultInjected, fault_point
+
+        try:
+            fault_point("ingest.native_parse")
+        except FaultInjected:
+            # SUBSTITUTE the batch's lead line with a data-prefixed-
+            # but-invalid one rather than deleting it: raw chunks can
+            # end mid-line, and deleting the head would also delete the
+            # completion of the previous chunk's carried tail — the
+            # engine would then splice that stale tail onto the NEXT
+            # line, a torn frame this site's contract forbids. The
+            # substituted line flows through the real parser and is
+            # counted per source like any wire-born malformed line
+            # (\xff fails both the numeric and the UTF-8 field rules,
+            # so the substitute — or a tail it completes — can never
+            # parse as a valid record). A newline-LESS chunk is a pure
+            # mid-line fragment: corrupt the spanning line IN PLACE by
+            # splicing a bogus \t\xff field a few bytes in (past a
+            # line-starting 'data' prefix, so the corrupt line still
+            # counts) — the extra field breaks the EXACT 9-column rule
+            # both parsers enforce, wherever in the spanning line it
+            # lands. Deleting the fragment and fabricating a terminator
+            # would tear the very framing this branch exists to
+            # preserve.
+            nl = data.find(b"\n")
+            if nl >= 0:
+                data = b"data\t\xff\n" + data[nl + 1:]
+            else:
+                data = data[:4] + b"\t\xff" + data[4:]
+        return int(
+            self._lib.tck_feed_lines(self._h, data, len(data), source)
+        )
 
     def add(self, r: TelemetryRecord) -> bool:
-        """Record-object compatibility shim (tests, mixed pipelines)."""
-        self.feed(format_line(r))
+        """Record-object compatibility shim (tests, mixed pipelines).
+        The record's ``source`` rides into the namespaced keyer — the
+        wire format itself has no source field."""
+        self.feed(format_line(r), r.source)
         return True
 
     def __len__(self) -> int:
@@ -212,6 +284,30 @@ class NativeBatcher:
             is_create=is_create,
         )
 
+    def flush_wire(self) -> "np.ndarray | None":
+        """Pop the oldest pending generation DIRECTLY as a packed wire
+        matrix (flow_table.pack_wire layout) — the zero-copy serving
+        path: C++ writes the padded (B, 4|6) uint32 rows into this
+        batcher's pinned staging pages and the returned view goes
+        straight to the device scatter. None when idle. The staging is
+        double-buffered, so the previous flush's view stays intact
+        while its transfer may still be in flight."""
+        buf = self._wire_stage.buffer()
+        r = int(
+            self._lib.tck_flush_wire(
+                self._h, _ptr(buf), _ptr(self._buckets_u32),
+                len(self._buckets_u32), self.capacity,
+            )
+        )
+        if r == 0:
+            return None
+        return self._wire_stage.view(r & 0xFFFFFFFF, r >> 32)
+
+    def warm_stage(self) -> None:
+        """Touch every wire-staging page (AOT warmup): the first serve
+        tick must not pay the staging buffers' page faults."""
+        self._wire_stage.touch()
+
     # -- bookkeeping -------------------------------------------------------
     @property
     def dropped(self) -> int:
@@ -241,6 +337,36 @@ class NativeBatcher:
                 dst.value.decode(errors="replace"),
             )
         return None
+
+    def reset_tail(self, source: int) -> None:
+        """Drop ``source``'s carried partial line (namespace eviction:
+        a dead incarnation's dangling fragment must never be completed
+        by the restarted stream's first chunk)."""
+        self._lib.tck_reset_tail(self._h, source)
+
+    def slots_for_source(self, source: int) -> np.ndarray:
+        """Every live slot in ``source``'s namespace, ascending — the
+        native eviction set behind ``FlowStateEngine.evict_source``
+        (one ctypes crossing; O(capacity) scan, walked only on a
+        source-death event)."""
+        out = np.empty(self.capacity, np.uint32)
+        n = int(self._lib.tck_slots_for_source(self._h, source, _ptr(out)))
+        return out[:n].copy()
+
+    def parse_errors(self, source: int | None = None) -> int:
+        """Malformed telemetry lines ('data'-prefixed, invalid body)
+        counted and skipped — total, or for one source. Absorbed
+        ``ingest.native_parse`` fires count here too: the fault seam
+        substitutes a genuinely malformed line that the C++ parser
+        rejects and accounts like any wire-born one."""
+        if source is None:
+            return int(self._lib.tck_parse_errors_total(self._h))
+        return int(self._lib.tck_parse_errors(self._h, source))
+
+    def source_parsed(self, source: int) -> int:
+        """Records parsed under ``source``'s namespace (per-source
+        accounting for the fan-in roster)."""
+        return int(self._lib.tck_source_parsed(self._h, source))
 
     def release_slot(self, slot: int) -> None:
         self._lib.tc_engine_release_slot(self._h, slot)

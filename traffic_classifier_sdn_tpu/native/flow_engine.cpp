@@ -115,10 +115,16 @@ uint64_t hash_bytes(const uint8_t* s, size_t len) {
 
 // Fingerprint of dp\0src\0dst (the \0 separators carry the same
 // anti-ambiguity rule as protocol.stable_flow_key: 'ab'+'c' must not
-// collide with 'a'+'bc').
+// collide with 'a'+'bc'). A nonzero ``source`` appends \0 + the 4-byte
+// little-endian source id — the fan-in tier's per-source namespace,
+// mirroring stable_flow_key(source=): source 0 hashes the exact legacy
+// byte string, so pre-fan-in checkpoints restore into the default
+// namespace unchanged, and N sources reporting the same flow tuple
+// occupy N disjoint slots.
 uint64_t flow_fingerprint(const char* dp, size_t dpl, const char* src,
-                          size_t sl, const char* dst, size_t dl) {
-  const size_t total = dpl + sl + dl + 2;
+                          size_t sl, const char* dst, size_t dl,
+                          uint32_t source) {
+  const size_t total = dpl + sl + dl + 2 + (source != 0 ? 5 : 0);
   uint8_t stackbuf[512];
   std::vector<uint8_t> heapbuf;
   uint8_t* buf = stackbuf;
@@ -131,6 +137,11 @@ uint64_t flow_fingerprint(const char* dp, size_t dpl, const char* src,
   std::memcpy(buf + dpl + 1, src, sl);
   buf[dpl + 1 + sl] = 0;
   std::memcpy(buf + dpl + 2 + sl, dst, dl);
+  if (source != 0) {
+    size_t o = dpl + 2 + sl + dl;
+    buf[o] = 0;
+    std::memcpy(buf + o + 1, &source, 4);  // little-endian host assumed
+  }
   return hash_bytes(buf, total);
 }
 
@@ -267,10 +278,25 @@ struct Engine {
   std::vector<uint8_t> slot_used;
   std::vector<std::string> slot_src;
   std::vector<std::string> slot_dst;
+  // Per-slot telemetry-source namespace (0 = the default/legacy
+  // namespace) — the reverse map behind tck_slots_for_source, i.e. the
+  // native counterpart of FlowIndex.slot_source: a dead source's
+  // quarantine eviction clears exactly its own slots. A flat vector,
+  // not a sparse map: one uint32 per slot is 4 MB at 2^20 capacity and
+  // the write is free inside the create path's cache lines.
+  std::vector<uint32_t> slot_source;
   std::vector<uint32_t> free_slots;
   uint32_t next_slot = 0;
   uint64_t dropped = 0;
   uint64_t parsed = 0;
+  // Malformed telemetry: lines that carry the 'data' prefix but fail
+  // the parse (bad int, non-UTF8 field, too few fields). Noise lines
+  // (Ryu logs, headers) are NOT errors — the reference's own stdout
+  // interleaves them by design. Keyed per source so the fan-in tier
+  // can attribute a corrupt feed to the switch that sent it.
+  uint64_t parse_errors = 0;
+  std::unordered_map<uint32_t, uint64_t> src_parse_errors;
+  std::unordered_map<uint32_t, uint64_t> src_parsed;
   int32_t last_time = 0;  // max telemetry timestamp seen (eviction clock)
   std::deque<Generation> gens;
   // A RUN is a maximal sequence of coalescible generations: it ends at a
@@ -283,7 +309,11 @@ struct Engine {
   uint32_t run_seq = 0;
   std::vector<uint32_t> occ_epoch;
   std::vector<uint8_t> occ_bits;
-  std::string tail;  // partial line carried across feed() calls
+  // Per-source partial-line carry across feed calls: N sources deliver
+  // interleaved byte chunks, and source A's half line must never be
+  // completed by source B's next chunk. Source 0 is the legacy single
+  // feed's tail.
+  std::unordered_map<uint32_t, std::string> tails;
   int last_flush_conflict = 0;  // conflict_start of the last popped gen
   // Serializes every public entry point (see the extern "C" contract
   // below): ctypes releases the GIL for the duration of a foreign
@@ -296,7 +326,7 @@ struct Engine {
 
   explicit Engine(uint32_t cap, uint32_t mb)
       : capacity(cap), max_batch(mb), slot_fp(cap, 0), slot_used(cap, 0),
-        slot_src(cap), slot_dst(cap),
+        slot_src(cap), slot_dst(cap), slot_source(cap, 0),
         occ_epoch(static_cast<size_t>(cap) * 2, 0),
         occ_bits(static_cast<size_t>(cap) * 2, 0) {}
 };
@@ -408,15 +438,23 @@ void push_row(Engine* e, uint32_t slot, uint8_t is_fwd, uint8_t is_create,
   g->rows.push_back(Row{slot, time, pkts, bytes, is_fwd, is_create});
 }
 
+// parse_rec outcomes: noise (no 'data' prefix — Ryu logs/headers, not
+// an error), a valid record, or a malformed telemetry line (counted per
+// source and skipped — never a crash, never a torn row).
+enum ParseResult { kNoise = 0, kValid = 1, kMalformed = 2 };
+
 // Parse one complete line (no trailing \n) without touching engine state.
-// Returns true iff it is a valid telemetry record.
-bool parse_rec(const char* line, size_t len, bool eager_rfp, ParsedRec* out) {
+int parse_rec(const char* line, size_t len, bool eager_rfp, uint32_t source,
+              ParsedRec* out) {
   // prefix match, like the reference's line.startswith('data')
   // (traffic_classifier.py:152)
-  if (len < 4 || std::memcmp(line, "data", 4) != 0) return false;
-  // split on \t, drop field 0, need >= 8 remaining. memchr (SIMD in
-  // libc) instead of a per-byte scan — the split was ~a third of the
-  // single-thread parse cost at 56 B/line.
+  if (len < 4 || std::memcmp(line, "data", 4) != 0) return kNoise;
+  // split on \t, drop field 0, need EXACTLY 8 remaining — the wire
+  // format emits exactly 9 columns, so trailing junk fields are a
+  // corrupt line, not slop to ignore (and the Python parser rejects
+  // identically). memchr (SIMD in libc) instead of a per-byte scan —
+  // the split was ~a third of the single-thread parse cost at
+  // 56 B/line.
   const char* f[16];
   size_t fl[16];
   int nf = 0;
@@ -434,19 +472,19 @@ bool parse_rec(const char* line, size_t len, bool eager_rfp, ParsedRec* out) {
     nf++;
     start = static_cast<size_t>(t - line) + 1;
   }
-  if (nf < 9) return false;
+  if (nf != 9) return kMalformed;
   int64_t time, pkts, bytes;
-  if (!parse_i64(f[1], fl[1], &time)) return false;
-  if (!parse_i64(f[7], fl[7], &pkts)) return false;
-  if (!parse_i64(f[8], fl[8], &bytes)) return false;
+  if (!parse_i64(f[1], fl[1], &time)) return kMalformed;
+  if (!parse_i64(f[7], fl[7], &pkts)) return kMalformed;
+  if (!parse_i64(f[8], fl[8], &bytes)) return kMalformed;
   // Cumulative counters can't be negative; a signed value here is a
   // corrupt line (and would otherwise wrap to ~1.8e19 via the uint64_t
   // cast below, diverging from the Python parser, which also rejects).
-  if (pkts < 0 || bytes < 0) return false;
+  if (pkts < 0 || bytes < 0) return kMalformed;
   // the Python oracle decodes datapath/ports/MACs as UTF-8 and rejects
   // the line on failure; match it (fields 2..6 are the string fields)
   for (int k = 2; k <= 6; k++) {
-    if (!utf8_valid(f[k], fl[k])) return false;
+    if (!utf8_valid(f[k], fl[k])) return kMalformed;
   }
   // f[2]=datapath f[4]=eth_src f[5]=eth_dst (f[3]=in_port f[6]=out_port
   // are carried by the wire format but unused for keying, same as the
@@ -460,22 +498,24 @@ bool parse_rec(const char* line, size_t len, bool eager_rfp, ParsedRec* out) {
   out->time = static_cast<int32_t>(time);
   out->pkts = static_cast<uint64_t>(pkts);
   out->bytes = static_cast<uint64_t>(bytes);
-  out->fp = flow_fingerprint(f[2], fl[2], f[4], fl[4], f[5], fl[5]);
+  out->fp = flow_fingerprint(f[2], fl[2], f[4], fl[4], f[5], fl[5], source);
   if (eager_rfp) {
     // worker threads pre-hash the reverse key too: the sequential router
     // then never hashes, only probes
-    out->rfp = flow_fingerprint(f[2], fl[2], f[5], fl[5], f[4], fl[4]);
+    out->rfp =
+        flow_fingerprint(f[2], fl[2], f[5], fl[5], f[4], fl[4], source);
     out->has_rfp = 1;
   } else {
     out->has_rfp = 0;
   }
-  return true;
+  return kValid;
 }
 
 // Route one parsed record (the FlowIndex.assign logic). MUST run in
 // original record order — slot assignment is order-dependent and the
-// Python oracle is sequential.
-void route_rec(Engine* e, const ParsedRec& r) {
+// Python oracle is sequential. ``source`` tags a newly created slot's
+// namespace; hits already carry the source in their fingerprint.
+void route_rec(Engine* e, const ParsedRec& r, uint32_t source) {
   uint32_t* hit = e->key_to_slot.find(r.fp);
   if (hit != nullptr) {
     push_row(e, *hit, 1, 0, r.time, r.pkts, r.bytes);
@@ -483,7 +523,7 @@ void route_rec(Engine* e, const ParsedRec& r) {
     uint64_t rfp = r.has_rfp
                        ? r.rfp
                        : flow_fingerprint(r.dp, r.dp_len, r.dst, r.dst_len,
-                                          r.src, r.src_len);
+                                          r.src, r.src_len, source);
     hit = e->key_to_slot.find(rfp);
     if (hit != nullptr) {
       push_row(e, *hit, 0, 0, r.time, r.pkts, r.bytes);
@@ -505,6 +545,7 @@ void route_rec(Engine* e, const ParsedRec& r) {
       e->slot_used[slot] = 1;
       e->slot_src[slot].assign(r.src, r.src_len);
       e->slot_dst[slot].assign(r.dst, r.dst_len);
+      e->slot_source[slot] = source;
       push_row(e, slot, 1, 1, r.time, r.pkts, r.bytes);
     }
   }
@@ -512,9 +553,15 @@ void route_rec(Engine* e, const ParsedRec& r) {
   if (r.time > e->last_time) e->last_time = r.time;
 }
 
-inline void parse_and_route(Engine* e, const char* line, size_t len) {
+inline void parse_and_route(Engine* e, const char* line, size_t len,
+                            uint32_t source, uint64_t* errors) {
   ParsedRec r;
-  if (parse_rec(line, len, /*eager_rfp=*/false, &r)) route_rec(e, r);
+  int res = parse_rec(line, len, /*eager_rfp=*/false, source, &r);
+  if (res == kValid) {
+    route_rec(e, r, source);
+  } else if (res == kMalformed) {
+    ++*errors;
+  }
 }
 
 // Route a parsed block with the key-map probe lines prefetched: at ~1M
@@ -530,7 +577,8 @@ inline void parse_and_route(Engine* e, const char* line, size_t len) {
 // prefetched map line stays L1/L2-resident until its record routes.
 constexpr size_t kRouteBlock = 64;
 
-inline void route_block(Engine* e, const ParsedRec* recs, size_t n) {
+inline void route_block(Engine* e, const ParsedRec* recs, size_t n,
+                        uint32_t source) {
   const FpMap& m = e->key_to_slot;
   for (size_t i = 0; i < n; i++) {
     size_t b = recs[i].fp & m.mask;
@@ -540,13 +588,16 @@ inline void route_block(Engine* e, const ParsedRec* recs, size_t n) {
     __builtin_prefetch(&m.vals[rb]);
     __builtin_prefetch(&m.keys[rb]);
   }
-  for (size_t i = 0; i < n; i++) route_rec(e, recs[i]);
+  for (size_t i = 0; i < n; i++) route_rec(e, recs[i], source);
 }
 
 // Parse every line in [buf+begin, buf+end) into out (telemetry lines
-// only). begin must sit at a line start; end at a line end (past '\n').
+// only; malformed lines counted into *errors). begin must sit at a line
+// start; end at a line end (past '\n'). Runs on worker threads WITHOUT
+// the engine lock — it touches no engine state, only its own outputs.
 void parse_region(const char* buf, size_t begin, size_t end,
-                  std::vector<ParsedRec>* out) {
+                  uint32_t source, std::vector<ParsedRec>* out,
+                  uint64_t* errors) {
   size_t start = begin;
   while (start < end) {
     const char* nl = static_cast<const char*>(
@@ -554,17 +605,22 @@ void parse_region(const char* buf, size_t begin, size_t end,
     if (nl == nullptr) break;  // caller guarantees end is past a '\n'
     size_t i = static_cast<size_t>(nl - buf);
     ParsedRec r;
-    if (parse_rec(buf + start, i - start, /*eager_rfp=*/true, &r))
+    int res = parse_rec(buf + start, i - start, /*eager_rfp=*/true,
+                        source, &r);
+    if (res == kValid) {
       out->push_back(r);
+    } else if (res == kMalformed) {
+      ++*errors;
+    }
     start = i + 1;
   }
 }
 
 // Threaded feed: split [begin, end) at line boundaries, parse in
 // parallel, route sequentially. Only called when end-begin is large and
-// the host has >1 core.
-void feed_threaded(Engine* e, const char* buf, size_t begin, size_t end,
-                   size_t nthreads) {
+// the host has >1 core. Returns the malformed-line count.
+uint64_t feed_threaded(Engine* e, const char* buf, size_t begin, size_t end,
+                       size_t nthreads, uint32_t source) {
   std::vector<size_t> cut(nthreads + 1, begin);
   cut[nthreads] = end;
   size_t span = (end - begin) / nthreads;
@@ -577,20 +633,25 @@ void feed_threaded(Engine* e, const char* buf, size_t begin, size_t end,
     cut[t] = c < cut[t - 1] ? cut[t - 1] : c;
   }
   std::vector<std::vector<ParsedRec>> outs(nthreads);
+  std::vector<uint64_t> errs(nthreads, 0);
   std::vector<std::thread> workers;
   workers.reserve(nthreads - 1);
   for (size_t t = 1; t < nthreads; t++) {
-    workers.emplace_back(parse_region, buf, cut[t], cut[t + 1], &outs[t]);
+    workers.emplace_back(parse_region, buf, cut[t], cut[t + 1], source,
+                         &outs[t], &errs[t]);
   }
-  parse_region(buf, cut[0], cut[1], &outs[0]);
+  parse_region(buf, cut[0], cut[1], source, &outs[0], &errs[0]);
   for (auto& w : workers) w.join();
+  uint64_t errors = 0;
   for (size_t t = 0; t < nthreads; t++) {
+    errors += errs[t];
     const std::vector<ParsedRec>& rs = outs[t];
     for (size_t i = 0; i < rs.size(); i += kRouteBlock) {
       size_t n = rs.size() - i < kRouteBlock ? rs.size() - i : kRouteBlock;
-      route_block(e, rs.data() + i, n);
+      route_block(e, rs.data() + i, n, source);
     }
   }
+  return errors;
 }
 
 // Free one slot back to the allocator. Callers hold e->mu.
@@ -600,45 +661,33 @@ void release_slot_locked(Engine* e, uint32_t slot) {
   e->slot_used[slot] = 0;
   e->slot_src[slot].clear();
   e->slot_dst[slot].clear();
+  // reset the namespace tag: a reused slot must never inherit a dead
+  // source's namespace (the next create stamps its own)
+  e->slot_source[slot] = 0;
   e->free_slots.push_back(slot);
 }
 
-}  // namespace
-
-// Concurrency contract: every function below except tc_engine_create /
-// tc_engine_destroy takes the engine mutex, so feed, flush, and the
-// bookkeeping queries may be called from different threads
-// concurrently. Destruction is the caller's ordering problem (as with
-// any handle API): no call may race tc_engine_destroy.
-extern "C" {
-
-void* tc_engine_create(uint32_t capacity, uint32_t max_batch) {
-  // capacity is bounded below the FpMap sentinel slot values
-  if (capacity == 0 || max_batch == 0 || capacity >= kTomb) return nullptr;
-  return new Engine(capacity, max_batch);
-}
-
-void tc_engine_destroy(void* h) { delete static_cast<Engine*>(h); }
-
-// Feed raw bytes in arbitrary chunks (partial lines are carried over).
-// Returns the number of telemetry records parsed from this chunk.
-uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len) {
-  Engine* e = static_cast<Engine*>(h);
-  std::lock_guard<std::mutex> g(e->mu);
+// Feed raw bytes in arbitrary chunks (partial lines are carried over
+// per source). Returns the number of telemetry records parsed from this
+// chunk. Callers hold e->mu.
+uint64_t feed_locked(Engine* e, const char* buf, uint64_t len,
+                     uint32_t source) {
   uint64_t before = e->parsed;
+  uint64_t errors = 0;
+  std::string& tail = e->tails[source];
   size_t begin = 0;
-  if (!e->tail.empty()) {
+  if (!tail.empty()) {
     // complete the carried partial line first (routes before anything
     // parsed from this chunk — order preserved)
     const char* p = static_cast<const char*>(std::memchr(buf, '\n', len));
     if (p == nullptr) {
-      e->tail.append(buf, len);
+      tail.append(buf, len);
       return 0;
     }
     size_t nl = static_cast<size_t>(p - buf);
-    e->tail.append(buf, nl);
-    parse_and_route(e, e->tail.data(), e->tail.size());
-    e->tail.clear();
+    tail.append(buf, nl);
+    parse_and_route(e, tail.data(), tail.size(), source, &errors);
+    tail.clear();
     begin = nl + 1;
   }
   size_t last_nl = len;  // one past the final '\n'
@@ -658,7 +707,7 @@ uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len) {
         forced > 0 ? static_cast<size_t>(forced) : (hw > 8 ? 8 : hw);
     const size_t threshold = forced > 0 ? 1 : (1u << 21);
     if (nthreads >= 2 && last_nl - begin >= threshold) {
-      feed_threaded(e, buf, begin, last_nl, nthreads);
+      errors += feed_threaded(e, buf, begin, last_nl, nthreads, source);
     } else {
       // block-parse then route-with-prefetch (see route_block)
       ParsedRec recs[kRouteBlock];
@@ -669,20 +718,78 @@ uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len) {
             std::memchr(buf + start, '\n', last_nl - start));
         if (nl == nullptr) break;
         size_t i = static_cast<size_t>(nl - buf);
-        if (parse_rec(buf + start, i - start, /*eager_rfp=*/true,
-                      &recs[nr])) {
+        int res = parse_rec(buf + start, i - start, /*eager_rfp=*/true,
+                            source, &recs[nr]);
+        if (res == kValid) {
           if (++nr == kRouteBlock) {
-            route_block(e, recs, nr);
+            route_block(e, recs, nr, source);
             nr = 0;
           }
+        } else if (res == kMalformed) {
+          errors++;
         }
         start = i + 1;
       }
-      route_block(e, recs, nr);
+      route_block(e, recs, nr, source);
     }
   }
-  if (last_nl < len) e->tail.append(buf + last_nl, len - last_nl);
-  return e->parsed - before;
+  if (last_nl < len) tail.append(buf + last_nl, len - last_nl);
+  uint64_t n = e->parsed - before;
+  // per-source accounting amortized to one map touch per CALL, never
+  // per record — the per-record hot loop stays map-free
+  if (n) e->src_parsed[source] += n;
+  if (errors) {
+    e->parse_errors += errors;
+    e->src_parse_errors[source] += errors;
+  }
+  return n;
+}
+
+}  // namespace
+
+// Concurrency contract: every function below except tc_engine_create /
+// tc_engine_destroy takes the engine mutex, so feed, flush, and the
+// bookkeeping queries may be called from different threads
+// concurrently. Destruction is the caller's ordering problem (as with
+// any handle API): no call may race tc_engine_destroy.
+extern "C" {
+
+void* tc_engine_create(uint32_t capacity, uint32_t max_batch) {
+  // capacity is bounded below the FpMap sentinel slot values AND below
+  // the wire layout's flag bits: tck_flush_wire packs slot | fwd<<31 |
+  // create<<30 (and pads with slot == capacity), so any slot touching
+  // bit 30 would silently corrupt direction/create semantics. pack_wire
+  // raises for the same bound on the Python path — fail loudly here too.
+  if (capacity == 0 || max_batch == 0 || capacity >= (1u << 30)) {
+    return nullptr;
+  }
+  return new Engine(capacity, max_batch);
+}
+
+void tc_engine_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+// Feed raw bytes in arbitrary chunks (partial lines are carried over).
+// Returns the number of telemetry records parsed from this chunk.
+// Legacy single-source entry: the default namespace (source 0) —
+// bit-for-bit the pre-fan-in behavior.
+uint64_t tc_engine_feed(void* h, const char* buf, uint64_t len) {
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return feed_locked(e, buf, len, 0);
+}
+
+// THE fan-in wire entry: one call per (source, poll batch) — raw pipe /
+// capture / synthetic bytes routed entirely in C++ under the source's
+// namespace (fingerprints fold the source id; new slots are tagged for
+// tck_slots_for_source). Per-source partial-line tails keep framing
+// correct across interleaved multi-source chunks. Malformed telemetry
+// lines ('data' prefix, invalid body) are counted per source and
+// skipped — never a crash, never a torn row.
+uint64_t tck_feed_lines(void* h, const char* buf, uint64_t len,
+                        uint32_t source) {
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return feed_locked(e, buf, len, source);
 }
 
 uint64_t tc_engine_pending(void* h) {
@@ -733,6 +840,124 @@ int tc_engine_last_flush_conflict(void* h) {
   Engine* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
   return e->last_flush_conflict;
+}
+
+// Pop the oldest generation DIRECTLY into the packed uint32 wire layout
+// (core/flow_table.pack_wire): one pass from the C++ rows into the
+// caller's pinned staging buffer, zero per-flush numpy allocation or
+// Python column work. ``wire`` must hold >= max_batch*6 uint32; rows
+// are written TIGHT at the chosen width (4 compact / 6 full), padded
+// with pad_slot rows (is_fwd set, everything else zero — exactly
+// pack_wire's padding) up to the smallest admitting bucket from
+// ``buckets`` (ascending, last entry >= max_batch). Returns
+// (width << 32) | padded_rows, or 0 when nothing is pending. The width
+// rule matches pack_wire bit-for-bit: compact whenever every counter's
+// float32 image is < 2^31, so the device-side unpack reconstructs
+// identical f32 lanes.
+uint64_t tck_flush_wire(void* h, uint32_t* wire, const uint32_t* buckets,
+                        uint32_t n_buckets, uint32_t pad_slot) {
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> guard(e->mu);
+  while (!e->gens.empty() && e->gens.front().rows.empty()) {
+    e->gens.pop_front();
+  }
+  if (e->gens.empty() || n_buckets == 0) return 0;
+  const Generation& g = e->gens.front();
+  e->last_flush_conflict = g.conflict_start ? 1 : 0;
+  const uint32_t n = static_cast<uint32_t>(g.rows.size());
+  constexpr float kLim = 2147483648.0f;  // 2^31 as float32
+  bool compact = true;
+  for (uint32_t i = 0; i < n; i++) {
+    const Row& r = g.rows[i];
+    if (static_cast<float>(r.pkts) >= kLim ||
+        static_cast<float>(r.bytes) >= kLim) {
+      compact = false;
+      break;
+    }
+  }
+  uint32_t padded = buckets[n_buckets - 1];
+  for (uint32_t b = 0; b < n_buckets; b++) {
+    if (n <= buckets[b]) {
+      padded = buckets[b];
+      break;
+    }
+  }
+  const uint32_t w = compact ? 4 : 6;
+  for (uint32_t i = 0; i < n; i++) {
+    const Row& r = g.rows[i];
+    uint32_t* row = wire + static_cast<size_t>(i) * w;
+    row[0] = r.slot | (static_cast<uint32_t>(r.is_fwd) << 31) |
+             (static_cast<uint32_t>(r.is_create) << 30);
+    row[1] = static_cast<uint32_t>(r.time);
+    row[2] = static_cast<uint32_t>(r.pkts & 0xFFFFFFFFu);
+    if (compact) {
+      row[3] = static_cast<uint32_t>(r.bytes & 0xFFFFFFFFu);
+    } else {
+      float pf = static_cast<float>(r.pkts);
+      float bf = static_cast<float>(r.bytes);
+      std::memcpy(&row[3], &pf, 4);
+      row[4] = static_cast<uint32_t>(r.bytes & 0xFFFFFFFFu);
+      std::memcpy(&row[5], &bf, 4);
+    }
+  }
+  // padding rows: scratch slot with the fwd flag, zeros elsewhere — a
+  // clean no-op under apply_wire, bit-identical to pack_wire's pad
+  const uint32_t pad0 = pad_slot | (1u << 31);
+  for (uint32_t i = n; i < padded; i++) {
+    uint32_t* row = wire + static_cast<size_t>(i) * w;
+    row[0] = pad0;
+    std::memset(row + 1, 0, (w - 1) * sizeof(uint32_t));
+  }
+  e->gens.pop_front();
+  return (static_cast<uint64_t>(w) << 32) | padded;
+}
+
+// Every in-use slot in ``source``'s namespace, ascending — the native
+// half of FlowStateEngine.evict_source (the caller clears the device
+// rows, then releases these slots in bulk). O(capacity) scan, but only
+// walked on a source-death event, never per tick — the same contract
+// as FlowIndex.slots_for_source. ``out`` must hold >= capacity slots.
+uint32_t tck_slots_for_source(void* h, uint32_t source, uint32_t* out) {
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  uint32_t n = 0;
+  for (uint32_t s = 0; s < e->capacity; s++) {
+    if (e->slot_used[s] && e->slot_source[s] == source) out[n++] = s;
+  }
+  return n;
+}
+
+// Drop ``source``'s carried partial line — the native half of
+// FlowStateEngine.evict_source's framing reset. The dead incarnation's
+// dangling fragment must not be completed by a restarted stream's
+// first chunk (the fan-in queue's \x00\n poison seam guards the same
+// boundary from the delivery side; this guards direct engine callers).
+void tck_reset_tail(void* h, uint32_t source) {
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  e->tails.erase(source);
+}
+
+// Malformed-telemetry accounting ('data'-prefixed lines that failed the
+// parse — noise lines are not errors), total and per source.
+uint64_t tck_parse_errors_total(void* h) {
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return e->parse_errors;
+}
+
+uint64_t tck_parse_errors(void* h, uint32_t source) {
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  auto it = e->src_parse_errors.find(source);
+  return it == e->src_parse_errors.end() ? 0 : it->second;
+}
+
+uint64_t tck_source_parsed(void* h, uint32_t source) {
+  Engine* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  auto it = e->src_parsed.find(source);
+  return it == e->src_parsed.end() ? 0 : it->second;
 }
 
 uint64_t tc_engine_dropped(void* h) {

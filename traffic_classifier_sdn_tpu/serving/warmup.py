@@ -114,6 +114,15 @@ def warmup_serving(engine, predict, params, *, table_rows: int,
     dirty_scratch = (
         jnp.ones(capacity + 1, bool) if track_dirty else None
     )
+    # native ingest: fault in the pinned wire-staging pages (the C++
+    # engine writes packed batches straight into them — their lazy
+    # first-touch allocation must not land inside serving tick one)
+    if getattr(engine, "native", False) and hasattr(
+        engine.batcher, "warm_stage"
+    ):
+        engine.batcher.warm_stage()
+        warmed.append("wire_stage")
+
     for b in engine.buckets:
         if b > limit:
             break

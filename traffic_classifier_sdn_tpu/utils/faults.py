@@ -83,6 +83,14 @@ SITES: dict[str, str] = {
         "window exactly its own slots are evicted, while every other "
         "source keeps serving fresh labels every tick"
     ),
+    "ingest.native_parse": (
+        "native/engine.NativeBatcher.feed — one line of a native-ingest "
+        "poll batch is corrupt (a fire == a torn/garbled wire line at "
+        "the C++ parse seam); ABSORBED exactly like a real malformed "
+        "line: counted against ITS source (parse_errors) and skipped, "
+        "the rest of the batch parses normally — never a crash, never "
+        "a torn row, and every other source's telemetry is untouched"
+    ),
     "obs.stamp": (
         "ingest/protocol.stamp_records — the latency-provenance emit "
         "stamp itself fails; ABSORBED at the stamping seam: the batch "
