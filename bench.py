@@ -621,8 +621,9 @@ def measure(batches: list[int]) -> None:
         # budget stop mid-race still lands every variant's rate.
         want_knn = None
         knn_variants = (
-            ("argmax", "hier", "hier256", "hier512") if on_tpu
-            else ("argmax", "hier")
+            ("argmax", "hier", "hier256", "hier512", "screened",
+             "screened128") if on_tpu
+            else ("argmax", "hier", "screened")
         )
         raced: list[tuple[float, str]] = []
         for impl in knn_variants:
@@ -697,15 +698,31 @@ def measure(batches: list[int]) -> None:
                     ski.import_knn(f"{MODELS_DIR}/KNeighbors")
                 )
                 Xnk = X_big[:fam_batch]
+                # the default entry is the PRUNED exact engine; the
+                # original blocked full scan stays callable for the
+                # same-run A/B (vote-for-vote identical — enforced)
                 sec_nk = _timed_host(lambda: hk.predict(Xnk))
                 line["knn_native_topk_flows_per_sec"] = round(
                     fam_batch / sec_nk, 1
+                )
+                sec_nu = _timed_host(lambda: hk.predict_unpruned(Xnk))
+                line["knn_native_unpruned_topk_flows_per_sec"] = round(
+                    fam_batch / sec_nu, 1
+                )
+                line["knn_native_prune_speedup"] = round(
+                    sec_nu / sec_nk, 3
                 )
                 if want_knn is None:
                     want_knn = np.asarray(
                         jax.jit(knn_mod.predict)(knn_params, Xd32)
                     )
                 got_nk = hk.predict(ds.X.astype(np.float32))
+                if (got_nk
+                        != hk.predict_unpruned(
+                            ds.X.astype(np.float32))).any():
+                    raise RuntimeError(
+                        "pruned/unpruned native divergence"
+                    )
                 pct_nk = float((got_nk == want_knn).mean() * 100.0)
                 line["knn_native_parity_pct"] = round(pct_nk, 3)
                 if pct_nk == 100.0 and sec_nk < best_sec:
@@ -716,6 +733,35 @@ def measure(batches: list[int]) -> None:
                     line["knn_top_k_impl"] = "native"
             except Exception as e:  # noqa: BLE001 — build may be absent
                 line["knn_native_error"] = f"{type(e).__name__}: {e}"[:120]
+            emit()
+        # IVF tier (ops/knn_ivf.py): measured for the record, NEVER
+        # promoted — it is approximate (explicit --knn-topk ivf opt-in
+        # only; recall evidence lives in knn_ivf_recall_cpu.json via
+        # tools/bench_knn.py, armed in tools/tpu_day.sh for the chip)
+        if not out_of_time():
+            print("# knn ivf (approximate; not promotable)", flush=True)
+            try:
+                from traffic_classifier_sdn_tpu.ops import knn_ivf
+
+                ivf = knn_ivf.build(knn_params)
+
+                def ivf_sum(p, X):
+                    return jnp.sum(knn_ivf.predict(p, X)).astype(
+                        jnp.float32
+                    )
+
+                sec_iv = _timed_loop(
+                    ivf_sum, ivf, Xf, _loop_iters(fam_batch)
+                )
+                line["knn_ivf_flows_per_sec"] = round(
+                    fam_batch / sec_iv, 1
+                )
+                line["knn_ivf_nprobe"] = ivf.nprobe
+                line["knn_ivf_recall_at_1"] = round(
+                    knn_ivf.recall_at_1(ivf, Xd32), 5
+                )
+            except Exception as e:  # noqa: BLE001
+                line["knn_ivf_error"] = f"{type(e).__name__}: {e}"[:120]
             emit()
         # fused Pallas kernel (ops/pallas_knn): distance + running top-k
         # in VMEM, the (N, S) similarity never touching HBM. Own guard

@@ -1,0 +1,184 @@
+"""IVF approximate KNN (ops/knn_ivf.py + the native mirror).
+
+The tier's honesty anchors: nprobe == n_lists IS the exact search
+bit-for-bit (votes included — the candidate set covers the partition
+and tie order re-sorts to ascending corpus index), the recall harness
+reads 1.0 there by construction, probe sets holding fewer than k real
+members vote over the real ones only (the sentinel can never vote),
+and serving reaches the tier ONLY through the explicit opt-in
+(`--knn-topk ivf` — the default resolution never builds an index).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from traffic_classifier_sdn_tpu.models import knn
+from traffic_classifier_sdn_tpu.ops import knn_ivf
+
+
+def _corpus(rng, S, k=5, n_cls=6):
+    theta = rng.gamma(2.0, 100.0, (n_cls, 12))
+    conv = -(-S // 8)
+    ccls = rng.randint(0, n_cls, conv)
+    base = rng.gamma(2.0, 1.0, (conv, 12)) * theta[ccls]
+    rows, ys = [], []
+    for i in range(conv):
+        t = np.sort(rng.uniform(0.1, 1.0, 8))[:, None]
+        rows.append(np.abs(base[i] * t * (1 + rng.normal(0, 0.02, (8, 12)))))
+        ys += [int(ccls[i])] * 8
+    return {
+        "fit_X": np.concatenate(rows)[:S],
+        "y": np.asarray(ys[:S], np.int32),
+        "n_neighbors": k,
+        "classes": np.arange(n_cls),
+    }
+
+
+@pytest.fixture(scope="module")
+def ivf_setup():
+    rng = np.random.RandomState(7)
+    d = _corpus(rng, 1024)
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    ivf = knn_ivf.build(params, nprobe=2, seed=0)
+    sel = rng.choice(1024, 257)
+    X = jnp.asarray(np.abs(
+        d["fit_X"][sel] * (1 + rng.normal(0, 0.05, (257, 12)))
+    ).astype(np.float32))
+    return d, params, ivf, X
+
+
+def test_nprobe_equals_K_is_exact_bitwise(ivf_setup):
+    """THE anchor: every list probed == the exact sort path, votes and
+    labels bit-for-bit (candidate re-sort restores the full-row tie
+    order)."""
+    _d, params, ivf, X = ivf_setup
+    K = ivf.n_lists
+    want_v = np.asarray(jax.jit(knn.neighbor_votes)(params, X))
+    got_v = np.asarray(jax.jit(
+        lambda p, x: knn_ivf.neighbor_votes_ivf(p, x, nprobe=K)
+    )(ivf, X))
+    np.testing.assert_array_equal(got_v, want_v)
+    want = np.asarray(jax.jit(knn.predict)(params, X))
+    got = np.asarray(jax.jit(
+        lambda p, x: knn_ivf.predict(p, x, nprobe=K)
+    )(ivf, X))
+    np.testing.assert_array_equal(got, want)
+    # the recall harness must read exactly 1.0 there
+    assert knn_ivf.recall_at_1(ivf, X, nprobe=K) == 1.0
+
+
+def test_nprobe_clamps_past_K(ivf_setup):
+    _d, _params, ivf, X = ivf_setup
+    a = np.asarray(knn_ivf.predict(ivf, X, nprobe=ivf.n_lists))
+    b = np.asarray(knn_ivf.predict(ivf, X, nprobe=ivf.n_lists + 50))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_recall_monotone_and_default_positive(ivf_setup):
+    """More probes can only help: recall@1 is non-decreasing in nprobe
+    on a fixed query set, and the shipped default is sane on
+    flow-shaped data."""
+    _d, _params, ivf, X = ivf_setup
+    r = [knn_ivf.recall_at_1(ivf, X, nprobe=n) for n in (1, 2, 4, ivf.n_lists)]
+    assert all(b >= a - 1e-12 for a, b in zip(r, r[1:])), r
+    assert r[-1] == 1.0
+    assert knn_ivf.recall_at_1(ivf, X) >= 0.9  # shipped default, jittered
+
+
+def test_chunked_matches_unchunked(ivf_setup):
+    _d, _params, ivf, X = ivf_setup
+    np.testing.assert_array_equal(
+        np.asarray(knn_ivf.predict_chunked(ivf, X, row_chunk=64)),
+        np.asarray(knn_ivf.predict(ivf, X)),
+    )
+
+
+def test_predict_scores_argmax_is_predict(ivf_setup):
+    _d, _params, ivf, X = ivf_setup
+    lab, sc = jax.jit(knn_ivf.predict_scores)(ivf, X)
+    np.testing.assert_array_equal(
+        np.asarray(lab), np.argmax(np.asarray(sc), axis=-1)
+    )
+
+
+def test_sparse_probe_votes_over_real_members_only():
+    """A probe set with fewer than k real members: the sentinel padding
+    must never vote — total votes == real candidate count."""
+    rng = np.random.RandomState(3)
+    # two far-apart blobs: probing ONE list yields only its members
+    a = np.abs(rng.normal(10.0, 0.1, (3, 12)))
+    b = np.abs(rng.normal(1e6, 0.1, (61, 12)))
+    d = {
+        "fit_X": np.concatenate([a, b]),
+        "y": np.asarray([0] * 3 + [1] * 61, np.int32),
+        "n_neighbors": 5,
+        "classes": np.arange(6),
+    }
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    ivf = knn_ivf.build(params, n_clusters=2, nprobe=1, seed=0)
+    X = jnp.asarray(np.abs(
+        rng.normal(10.0, 0.1, (8, 12))
+    ).astype(np.float32))
+    votes = np.asarray(knn_ivf.neighbor_votes_ivf(ivf, X, nprobe=1))
+    # the near blob holds only 3 members < k=5: exactly 3 real votes,
+    # all for class 0 — the sentinel contributed nothing
+    assert (votes.sum(axis=1) == 3).all()
+    assert (votes[:, 0] == 3).all()
+    labels = np.asarray(knn_ivf.predict(ivf, X, nprobe=1))
+    assert (labels == 0).all()
+
+
+def test_native_mirror_matches_exact_at_full_probe(ivf_setup):
+    from traffic_classifier_sdn_tpu.native import knn as native_knn
+
+    if not native_knn.available():
+        pytest.skip("g++ build unavailable")
+    d, params, ivf, X = ivf_setup
+    h = native_knn.NativeKnn(d)
+    assign = knn_ivf.assignments(
+        np.asarray(params.fit_X), np.asarray(ivf.centers)
+    )
+    h.build_ivf(np.asarray(ivf.centers), assign)
+    Xn = np.asarray(X)
+    np.testing.assert_array_equal(
+        h.predict_ivf(Xn, ivf.n_lists), h.predict(Xn)
+    )
+    np.testing.assert_array_equal(
+        h.votes_ivf(Xn, ivf.n_lists), h.votes(Xn)
+    )
+
+
+def test_serving_requires_explicit_opt_in(monkeypatch):
+    """The default serving resolution NEVER builds an IVF index — the
+    approximate tier is reachable only through the explicit opt-in
+    (and then resolves to the native mirror where it builds)."""
+    import traffic_classifier_sdn_tpu.models as models
+
+    rng = np.random.RandomState(1)
+    d = _corpus(rng, 256)
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    monkeypatch.delenv("TCSDN_KNN_TOPK", raising=False)
+    called = []
+    real_build = knn_ivf.build
+    monkeypatch.setattr(knn_ivf, "build", lambda *a, **k: (
+        called.append(1), real_build(*a, **k))[1])
+    fn, _p = models._build_serving_path("knn", params)
+    assert not called, "default resolution must not touch the IVF tier"
+    monkeypatch.setenv("TCSDN_KNN_TOPK", "ivf4")
+    fn, p = models._build_serving_path("knn", params)
+    assert called, "the opt-in resolves through knn_ivf.build"
+    # and the resolved predict serves labels
+    X = jnp.asarray(np.abs(d["fit_X"][:16]).astype(np.float32))
+    labels = np.asarray(fn(p, X))
+    assert labels.shape == (16,)
+
+
+def test_build_validates_nprobe():
+    rng = np.random.RandomState(0)
+    d = _corpus(rng, 128)
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="nprobe"):
+        knn_ivf.build(params, nprobe=0)

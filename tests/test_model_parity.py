@@ -287,6 +287,51 @@ def test_knn_hier_topk_matches_sort_topk(reference_models_dir,
     np.testing.assert_array_equal(a, b)
 
 
+def test_knn_screened_topk_matches_sort_topk_bitwise():
+    """The bound-screened group selection must order indices
+    bitwise-identically to one lax.top_k over the full row — including
+    ties (the survivor-group selection provably contains every true
+    top-k element, and the ascending re-sort of the selected groups
+    restores the global-index tie order; proof on
+    models/knn._topk_screened_idx) — across group widths exercising
+    exact-fit, padding, single-group, and the G < k sort fallback.
+    Massively tied integer values make any screening slip visible."""
+    import jax
+    from jax import lax
+
+    from traffic_classifier_sdn_tpu.models.knn import _topk_screened_idx
+
+    rng = np.random.RandomState(4)
+    sim = jnp.asarray(rng.randint(0, 7, (64, 333)).astype(np.float32))
+    _, want_idx = lax.top_k(sim, 5)
+    for group in (8, 32, 111, 333, 512):
+        got_idx = _topk_screened_idx(sim, 5, group=group)
+        np.testing.assert_array_equal(
+            np.asarray(got_idx), np.asarray(want_idx),
+            err_msg=f"{group=}",
+        )
+    # G < k: 333 columns at group 128 → 3 groups < k=5 → sort fallback
+    got_idx = _topk_screened_idx(sim, 5, group=128)
+    np.testing.assert_array_equal(np.asarray(got_idx), np.asarray(want_idx))
+
+
+def test_knn_screened_predict_matches_sort_reference(
+    reference_models_dir, flow_dataset,
+):
+    """End-to-end on the reference corpus: screened labels == sort
+    labels under jit (the serving-path pair)."""
+    import jax
+
+    d = ski.import_knn(_ref_path(reference_models_dir, "knn"))
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    Xd = jnp.asarray(flow_dataset.X[:1024], jnp.float32)
+    a = np.asarray(jax.jit(
+        lambda p, X: knn.predict(p, X, top_k_impl="screened")
+    )(params, Xd))
+    b = np.asarray(jax.jit(knn.predict)(params, Xd))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_knn_big_corpus_streaming_matches_full(reference_models_dir,
                                                flow_dataset):
     """The corpus-streaming scan (single-chip big-corpus path) must

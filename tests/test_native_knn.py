@@ -7,6 +7,16 @@ few-distinct-integer corpora make every distance exactly representable
 in BOTH the f32 dot-expansion (XLA fast path) and the f64 diff-square
 form, so a tie-order divergence cannot hide behind rounding — the same
 pattern as tests/test_pallas_knn.py.
+
+The default predict/votes run the PRUNED engine (cluster triangle
+screens + f32 SIMD screen + early abandon); ``predict_unpruned`` /
+``votes_unpruned`` keep the original blocked full scan callable as the
+in-process parity oracle. The pruned-parity suite below pins them
+vote-for-vote and tie-order equal on the corpora where any screening
+slip would flip a label: duplicate points (the winner decided purely by
+index tie order), zero-variance features, k=1 and k=S edges, degenerate
+all-identical corpora (every triangle bound ties), and non-finite
+queries (the full-scan fallback).
 """
 
 import os
@@ -94,6 +104,139 @@ def test_float_feature_labels_match(reference_models_dir):
     X = np.abs(rng.gamma(1.5, 200.0, (1024, 12))).astype(np.float32)
     want = np.asarray(jax.jit(knn.predict)(params, jnp.asarray(X)))
     np.testing.assert_array_equal(h.predict(X), want)
+
+
+# ---------------------------------------------------------------------------
+# pruned engine vs the unpruned oracle (and the lax.top_k reference)
+# ---------------------------------------------------------------------------
+
+
+def _flow_corpus(rng, S, n_cls=6):
+    """Conversation-structured corpus — the serving geometry."""
+    theta = rng.gamma(2.0, 100.0, (n_cls, 12))
+    conv = -(-S // 8)  # ceil: rows cover S for ANY size, sliced below
+    ccls = rng.randint(0, n_cls, conv)
+    base = rng.gamma(2.0, 1.0, (conv, 12)) * theta[ccls]
+    rows, ys = [], []
+    for i in range(conv):
+        t = np.sort(rng.uniform(0.1, 1.0, 8))[:, None]
+        rows.append(np.abs(base[i] * t * (1 + rng.normal(0, 0.02, (8, 12)))))
+        ys += [int(ccls[i])] * 8
+    return np.concatenate(rows)[:S], np.asarray(ys[:S], np.int32)
+
+
+def _assert_pruned_matches_unpruned(d, X):
+    h = native_knn.NativeKnn(d)
+    np.testing.assert_array_equal(h.predict(X), h.predict_unpruned(X))
+    np.testing.assert_array_equal(h.votes(X), h.votes_unpruned(X))
+    return h
+
+
+@pytest.mark.parametrize("S,k", [(31, 5), (33, 5), (257, 5), (900, 1),
+                                 (900, 5), (64, 64), (4448, 5)])
+def test_pruned_parity_chunk_shapes_and_k_edges(S, k):
+    """Vote-for-vote parity across chunk-straddling corpus sizes
+    (kEChunk=32 boundaries) and the k=1 / k=S edges, on flow-shaped
+    data plus serving-jittered queries."""
+    rng = np.random.RandomState(S * 131 + k)
+    fit, y = _flow_corpus(rng, S)
+    d = {"fit_X": fit, "y": y, "n_neighbors": k, "classes": np.arange(6)}
+    sel = rng.choice(S, 257)
+    X = np.abs(fit[sel] * (1 + rng.normal(0, 0.05, (257, 12)))).astype(
+        np.float32
+    )
+    _assert_pruned_matches_unpruned(d, X)
+
+
+def test_pruned_parity_vs_sort_reference_on_ties():
+    """Three-way pin on the integer tie suite: pruned == unpruned ==
+    jitted lax.top_k labels (exactly representable distances — a
+    tie-order slip cannot hide behind rounding)."""
+    rng = np.random.RandomState(3)
+    d = _tie_dict(rng, 900)
+    X = rng.randint(0, 4, (101, 12)).astype(np.float32)
+    h = _assert_pruned_matches_unpruned(d, X)
+    params = knn.from_numpy(d, dtype=jnp.float32)
+    want = np.asarray(jax.jit(knn.predict)(params, jnp.asarray(X)))
+    np.testing.assert_array_equal(h.predict(X), want)
+
+
+def test_pruned_parity_duplicate_points_and_zero_variance():
+    """Duplicate corpus rows (the label is decided purely by index tie
+    order) and zero-variance feature columns (degenerate geometry for
+    the cluster index)."""
+    rng = np.random.RandomState(11)
+    base = np.abs(rng.gamma(2.0, 100.0, (40, 12)))
+    fit = np.repeat(base, 8, axis=0)  # every point 8x duplicated
+    fit[:, 3] = 7.0   # zero-variance features
+    fit[:, 9] = 0.0
+    d = {
+        "fit_X": fit,
+        "y": rng.randint(0, 6, 320).astype(np.int32),
+        "n_neighbors": 5,
+        "classes": np.arange(6),
+    }
+    X = fit[rng.choice(320, 100)].astype(np.float32)  # exact-hit queries
+    _assert_pruned_matches_unpruned(d, X)
+
+
+def test_pruned_parity_all_identical_corpus():
+    """The degenerate every-bound-ties corpus: zero pruning power, but
+    the screens must stay lossless (tie order decides everything)."""
+    d = {
+        "fit_X": np.full((300, 12), 41.5),
+        "y": (np.arange(300) % 6).astype(np.int32),
+        "n_neighbors": 5,
+        "classes": np.arange(6),
+    }
+    X = np.full((37, 12), 41.5, np.float32)
+    h = _assert_pruned_matches_unpruned(d, X)
+    # k=5 nearest are indices 0..4 -> labels [0,1,2,3,4]: first-max
+    # argmax -> class 0 on every query
+    assert (h.predict(X) == 0).all()
+
+
+def test_pruned_parity_nonfinite_queries():
+    """nan/inf query rows take the full-scan fallback — parity with the
+    unpruned path holds on every input, not just finite ones."""
+    rng = np.random.RandomState(5)
+    fit, y = _flow_corpus(rng, 300)
+    d = {"fit_X": fit, "y": y, "n_neighbors": 5, "classes": np.arange(6)}
+    bad = np.abs(rng.gamma(2.0, 10.0, (13, 12))).astype(np.float32)
+    bad[0] = np.nan
+    bad[1] = np.inf
+    bad[2] = -np.inf
+    bad[3, 7] = np.nan  # one poisoned feature
+    _assert_pruned_matches_unpruned(d, bad)
+
+
+def test_screen_stats_accumulate():
+    """The screen accounting the serving counters diff: screened grows
+    with pruning work, queries counts every call, and the degenerate
+    corpus (no pruning power) still counts queries."""
+    rng = np.random.RandomState(9)
+    fit, y = _flow_corpus(rng, 900)
+    d = {"fit_X": fit, "y": y, "n_neighbors": 5, "classes": np.arange(6)}
+    h = native_knn.NativeKnn(d)
+    assert h.screen_stats() == (0, 0, 0)
+    X = np.abs(fit[rng.choice(900, 64)]).astype(np.float32)
+    h.predict(X)
+    scr, _ab, q = h.screen_stats()
+    assert q == 64 and scr > 0
+    h.votes(X)
+    scr2, _ab2, q2 = h.screen_stats()
+    assert q2 == 128 and scr2 >= scr
+
+
+def test_ivf_requires_build_and_validates():
+    rng = np.random.RandomState(2)
+    h = native_knn.NativeKnn(_tie_dict(rng, 64))
+    with pytest.raises(RuntimeError, match="no IVF index"):
+        h.predict_ivf(np.zeros((4, 12), np.float32), 2)
+    with pytest.raises(ValueError, match="rc=2"):
+        # out-of-range assignment rejected by the C++ side
+        h.build_ivf(np.zeros((4, 12), np.float32),
+                    np.full(64, 9, np.int32))
 
 
 def test_guards():

@@ -76,6 +76,47 @@ def _build_model(args):
             sys.exit("host-native kernels are single-device host "
                      "serving; use a device kernel with --shards")
         return predict, sp, raw_predict
+    if args.model == "knn-synth":
+        # a KNN corpus fit on flow-shaped synthetic data at bench time —
+        # the reference-pickle-free KNN serving bench (mirror of
+        # forest-synth above) so the serving-regime KNN cost is
+        # A/B-able in CI containers; resolves through the same serving
+        # path (honors --knn-topk / TCSDN_KNN_TOPK — sort, screened,
+        # native, ivf all race on identical corpora). The corpus is
+        # conversation-structured (cumulative snapshot rows per flow),
+        # the geometry the pruned native tier and the IVF quantizer
+        # actually see in serving.
+        from traffic_classifier_sdn_tpu.models import make_loaded_model
+        from traffic_classifier_sdn_tpu.models.base import ClassList
+        from traffic_classifier_sdn_tpu.train import knn as tknn
+
+        rng = np.random.RandomState(1)
+        n_cls = 6
+        S = args.synth_corpus
+        theta = rng.gamma(2.0, 100.0, (n_cls, 12))
+        conv = -(-S // 8)  # ceil: rows cover S for ANY size
+        ccls = rng.randint(0, n_cls, conv)
+        base = rng.gamma(2.0, 1.0, (conv, 12)) * theta[ccls]
+        rows, ys = [], []
+        for i in range(conv):
+            t = np.sort(rng.uniform(0.1, 1.0, 8))[:, None]
+            rows.append(np.abs(
+                base[i] * t * (1 + rng.normal(0, 0.02, (8, 12)))
+            ))
+            ys += [int(ccls[i])] * 8
+        Xtr = np.concatenate(rows)[:S].astype(np.float32)
+        ytr = np.asarray(ys[:S])
+        params = tknn.fit(Xtr, ytr, n_neighbors=5, n_classes=n_cls)
+        m = make_loaded_model(
+            "knn", params,
+            ClassList(tuple(f"class{i}" for i in range(n_cls))),
+        )
+        raw_predict, sp = m.serving_path()
+        predict = jit_serving_fn(raw_predict)
+        if getattr(raw_predict, "host_native", False) and args.shards >= 1:
+            sys.exit("host-native kernels are single-device host "
+                     "serving; use a device kernel with --shards")
+        return predict, sp, raw_predict
     if args.model in ("forest", "knn"):
         # the reference checkpoint through the serving-path resolution —
         # honors TCSDN_FOREST_KERNEL / TCSDN_KNN_TOPK, so the chip day
@@ -732,14 +773,22 @@ def main() -> None:
     )
     ap.add_argument("--table-rows", type=int, default=64)
     ap.add_argument(
-        "--model", choices=("gnb", "forest", "knn", "forest-synth"),
+        "--model",
+        choices=("gnb", "forest", "knn", "forest-synth", "knn-synth"),
         default="gnb",
         help="predict stage: gnb (cheapest full-table predict; the CPU "
         "default), forest (the flagship 100-tree checkpoint), or knn "
         "(the KNeighbors checkpoint) — the latter two resolve through "
         "the serving path and honor TCSDN_FOREST_KERNEL / "
         "TCSDN_KNN_TOPK, so the raced kernels A/B directly in this "
-        "bench",
+        "bench; forest-synth / knn-synth fit at bench time on "
+        "flow-shaped synthetic data (reference-pickle-free — the CI "
+        "twins; knn-synth also honors --knn-topk via the env rule)",
+    )
+    ap.add_argument(
+        "--synth-corpus", type=int, default=4448,
+        help="corpus rows for --model knn-synth (default 4448, the "
+        "reference KNeighbors scale)",
     )
     ap.add_argument(
         "--shards", type=int, default=0,

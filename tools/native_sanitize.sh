@@ -24,6 +24,15 @@
 #          tc_engine_feed / tck_feed_lines / flush / bookkeeping-poll
 #          threads — the engine's mutex contract, checked for real (a
 #          lock removal fails this phase with TSan exit 66, verified).
+#   knn_asan  ASan+UBSan build of the pruned-KNN driver
+#          (tools/sanitize_knn.cpp + knn_eval.cpp): pruned-vs-unpruned
+#          vote parity self-checks, IVF builds + nprobe clamps, the
+#          DEGENERATE all-identical-points corpus (every triangle bound
+#          ties), a k == S corpus, non-finite queries, and concurrent
+#          mixed-entry-point calls over one shared handle.
+#   knn_tsan  TSan build of the same driver — the evaluator's
+#          read-only-after-build contract plus the relaxed-atomic
+#          screen counters under 4 concurrent predict threads.
 #
 # Exits 0 iff every phase is clean, and always writes a machine-readable
 # per-phase summary (JSON) to $NATIVE_SANITIZE_SUMMARY (default: a
@@ -45,6 +54,8 @@ asan_status=fail
 ubsan_status=fail
 asan_engine_status=fail
 tsan_status=fail
+knn_asan_status=fail
+knn_tsan_status=fail
 
 # ---- phase 1: asan (ASan+UBSan on the ctypes evaluators) -------------------
 echo "=== phase asan: forest_eval + knn_eval under ASan+UBSan"
@@ -168,16 +179,42 @@ then
   echo "flow_engine: tsan clean"
 fi
 
+# ---- phase 4: knn_asan (pruned KNN driver under ASan+UBSan) ----------------
+echo "=== phase knn_asan: pruned/IVF knn_eval driver under ASan+UBSan"
+if g++ -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
+     -std=c++17 -pthread -o "$WORK/knn_asan_drv" \
+     tools/sanitize_knn.cpp \
+     traffic_classifier_sdn_tpu/native/knn_eval.cpp \
+   && ASAN_OPTIONS=detect_leaks=0 "$WORK/knn_asan_drv" \
+   && ASAN_OPTIONS=detect_leaks=0 TC_KNN_THREADS=4 "$WORK/knn_asan_drv"
+then
+  knn_asan_status=pass
+  echo "knn_eval: asan clean"
+fi
+
+# ---- phase 5: knn_tsan (concurrent pruned/IVF predicts) --------------------
+echo "=== phase knn_tsan: concurrent knn_eval predicts under TSan"
+if g++ -O1 -g -fsanitize=thread \
+     -std=c++17 -pthread -o "$WORK/knn_tsan_drv" \
+     tools/sanitize_knn.cpp \
+     traffic_classifier_sdn_tpu/native/knn_eval.cpp \
+   && TSAN_OPTIONS=halt_on_error=1 TC_KNN_THREADS=4 "$WORK/knn_tsan_drv"
+then
+  knn_tsan_status=pass
+  echo "knn_eval: tsan clean"
+fi
+
 # ---- summary ---------------------------------------------------------------
-printf '{"phases": [{"name": "asan", "status": "%s"}, {"name": "ubsan", "status": "%s"}, {"name": "asan_engine", "status": "%s"}, {"name": "tsan", "status": "%s"}], "ok": %s}\n' \
+printf '{"phases": [{"name": "asan", "status": "%s"}, {"name": "ubsan", "status": "%s"}, {"name": "asan_engine", "status": "%s"}, {"name": "tsan", "status": "%s"}, {"name": "knn_asan", "status": "%s"}, {"name": "knn_tsan", "status": "%s"}], "ok": %s}\n' \
   "$asan_status" "$ubsan_status" "$asan_engine_status" "$tsan_status" \
-  "$([ "$asan_status$ubsan_status$asan_engine_status$tsan_status" = passpasspasspass ] \
+  "$knn_asan_status" "$knn_tsan_status" \
+  "$([ "$asan_status$ubsan_status$asan_engine_status$tsan_status$knn_asan_status$knn_tsan_status" = passpasspasspasspasspass ] \
      && echo true || echo false)" > "$SUMMARY"
 cat "$SUMMARY"
 
-if [ "$asan_status$ubsan_status$asan_engine_status$tsan_status" = passpasspasspass ]; then
+if [ "$asan_status$ubsan_status$asan_engine_status$tsan_status$knn_asan_status$knn_tsan_status" = passpasspasspasspasspass ]; then
   echo "native_sanitize: all clean (summary: $SUMMARY)"
   exit 0
 fi
-echo "native_sanitize: FAILURES (asan=$asan_status ubsan=$ubsan_status asan_engine=$asan_engine_status tsan=$tsan_status)" >&2
+echo "native_sanitize: FAILURES (asan=$asan_status ubsan=$ubsan_status asan_engine=$asan_engine_status tsan=$tsan_status knn_asan=$knn_asan_status knn_tsan=$knn_tsan_status)" >&2
 exit 1

@@ -1,0 +1,224 @@
+// Sanitizer driver for the pruned/IVF KNN evaluator (knn_eval.cpp) —
+// the KNN sibling of tools/sanitize_feed_flush.cpp. Build with
+// ASan/UBSan or TSan and run via tools/native_sanitize.sh (phases
+// knn_asan / knn_tsan). TC_KNN_THREADS > 1 drives CONCURRENT
+// tck_predict / tck_votes / tck_predict_unpruned / tck_predict_ivf /
+// tck_screen_stats calls over one shared handle — the evaluator's
+// read-only-after-build contract, checked for real.
+//
+// Phases per corpus:
+//   1. build + single-thread parity self-check: pruned vs unpruned
+//      vote-for-vote over predict AND votes (exit 1 on divergence);
+//   2. IVF build (stride-spread assignment — every list nonempty) +
+//      nprobe sweep incl. nprobe > K (clamp) and nprobe == K, which
+//      must equal the pruned exact predict bit-for-bit;
+//   3. TC_KNN_THREADS concurrent mixed-entry-point workers over
+//      OVERLAPPING query slices + a stats poller;
+//   4. non-finite queries (nan/±inf rows) through every entry point.
+//
+// Corpora: a gamma-mixture at chunk-straddling sizes, the DEGENERATE
+// all-identical-points corpus (every triangle bound ties — the screens
+// must stay lossless with zero pruning power), and a k == S corpus
+// (the whole corpus IS the top-k: nothing may be screened away).
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void *tck_create(uint32_t S, uint32_t F, uint32_t C, uint32_t k,
+                 const float *fit_X, const int32_t *fit_y);
+void tck_destroy(void *h);
+void tck_predict(void *h, const float *X, uint64_t N, uint32_t F,
+                 int32_t *out);
+void tck_votes(void *h, const float *X, uint64_t N, uint32_t F,
+               int32_t *out);
+void tck_predict_unpruned(void *h, const float *X, uint64_t N,
+                          uint32_t F, int32_t *out);
+void tck_votes_unpruned(void *h, const float *X, uint64_t N, uint32_t F,
+                        int32_t *out);
+int32_t tck_ivf_build(void *h, uint32_t K, const float *centers,
+                      const int32_t *assign);
+void tck_predict_ivf(void *h, const float *X, uint64_t N, uint32_t F,
+                     uint32_t nprobe, int32_t *out);
+void tck_votes_ivf(void *h, const float *X, uint64_t N, uint32_t F,
+                   uint32_t nprobe, int32_t *out);
+void tck_screen_stats(void *h, uint64_t *out);
+}
+
+namespace {
+
+constexpr uint32_t F = 12;
+constexpr uint32_t C = 6;
+
+std::atomic<int> failures{0};
+
+void check(bool ok, const char *what) {
+    if (!ok) {
+        std::fprintf(stderr, "sanitize_knn: FAIL %s\n", what);
+        ++failures;
+    }
+}
+
+void drive_corpus(const std::vector<float> &fit,
+                  const std::vector<int32_t> &y, uint32_t S, uint32_t k,
+                  int threads, const char *name) {
+    void *h = tck_create(S, F, C, k, fit.data(), y.data());
+    if (!h) {
+        std::fprintf(stderr, "sanitize_knn: create rejected %s\n", name);
+        ++failures;
+        return;
+    }
+    std::mt19937 rng(99);
+    std::normal_distribution<double> nj(0.0, 0.05);
+    const uint64_t N = 513;  // non-multiple-of-8: query-block tail
+    std::vector<float> X(N * F);
+    for (uint64_t q = 0; q < N; ++q) {
+        const uint32_t src = rng() % S;
+        for (uint32_t f = 0; f < F; ++f)
+            X[q * F + f] =
+                float(std::abs(fit[src * F + f] * (1.0 + nj(rng))));
+    }
+    // 1. parity self-check, single thread
+    std::vector<int32_t> a(N), b(N), va(N * C), vb(N * C);
+    tck_predict(h, X.data(), N, F, a.data());
+    tck_predict_unpruned(h, X.data(), N, F, b.data());
+    check(std::memcmp(a.data(), b.data(), N * 4) == 0, name);
+    tck_votes(h, X.data(), N, F, va.data());
+    tck_votes_unpruned(h, X.data(), N, F, vb.data());
+    check(std::memcmp(va.data(), vb.data(), N * C * 4) == 0, name);
+    // 2. IVF: stride assignment (deterministic, every list nonempty)
+    const uint32_t K = S < 8 ? 1 : 8;
+    std::vector<float> centers(size_t(K) * F, 0.0f);
+    std::vector<int32_t> assign(S);
+    std::vector<uint32_t> counts(K, 0);
+    for (uint32_t s = 0; s < S; ++s) {
+        assign[s] = int32_t(s % K);
+        ++counts[s % K];
+        for (uint32_t f = 0; f < F; ++f)
+            centers[(s % K) * F + f] += fit[s * F + f];
+    }
+    for (uint32_t c = 0; c < K; ++c)
+        for (uint32_t f = 0; f < F; ++f)
+            centers[c * F + f] /= float(counts[c]);
+    check(tck_ivf_build(h, K, centers.data(), assign.data()) == 0,
+          "ivf_build");
+    std::vector<int32_t> iv(N), ivv(N * C);
+    for (uint32_t npb : {1u, 3u, K, K + 7u}) {  // incl. clamp past K
+        tck_predict_ivf(h, X.data(), N, F, npb, iv.data());
+        tck_votes_ivf(h, X.data(), N, F, npb, ivv.data());
+        if (npb >= K)  // every list probed == the exact search
+            check(std::memcmp(iv.data(), a.data(), N * 4) == 0,
+                  "ivf nprobe>=K exact");
+    }
+    // 3. concurrent mixed entry points over overlapping slices
+    std::vector<std::thread> ts;
+    for (int t = 0; t < threads; ++t) {
+        ts.emplace_back([&, t] {
+            std::vector<int32_t> out(N), vout(N * C);
+            for (int it = 0; it < 4; ++it) {
+                switch ((t + it) % 4) {
+                case 0:
+                    tck_predict(h, X.data(), N, F, out.data());
+                    check(std::memcmp(out.data(), a.data(),
+                                      N * 4) == 0,
+                          "concurrent pruned parity");
+                    break;
+                case 1:
+                    tck_votes(h, X.data(), N, F, vout.data());
+                    break;
+                case 2:
+                    tck_predict_unpruned(h, X.data(), N, F,
+                                         out.data());
+                    break;
+                default:
+                    tck_predict_ivf(h, X.data(), N, F, 3,
+                                    out.data());
+                }
+                uint64_t st[3];
+                tck_screen_stats(h, st);  // live accounting poll
+            }
+        });
+    }
+    for (auto &t : ts) t.join();
+    // 4. non-finite queries through every entry point (parity incl.)
+    std::vector<float> bad(16 * F, 0.0f);
+    for (uint32_t f = 0; f < F; ++f) {
+        bad[0 * F + f] = std::numeric_limits<float>::quiet_NaN();
+        bad[1 * F + f] = std::numeric_limits<float>::infinity();
+        bad[2 * F + f] = -std::numeric_limits<float>::infinity();
+    }
+    bad[3 * F + 5] = std::numeric_limits<float>::quiet_NaN();
+    std::vector<int32_t> ba(16), bb(16), bv(16 * C);
+    tck_predict(h, bad.data(), 16, F, ba.data());
+    tck_predict_unpruned(h, bad.data(), 16, F, bb.data());
+    check(std::memcmp(ba.data(), bb.data(), 16 * 4) == 0,
+          "nonfinite parity");
+    tck_votes(h, bad.data(), 16, F, bv.data());
+    tck_predict_ivf(h, bad.data(), 16, F, 2, ba.data());
+    tck_destroy(h);
+    std::fprintf(stderr, "sanitize_knn: corpus %s ok\n", name);
+}
+
+}  // namespace
+
+int main() {
+    const char *env = std::getenv("TC_KNN_THREADS");
+    const int threads = env ? std::atoi(env) : 1;
+    std::mt19937 rng(7);
+    std::gamma_distribution<double> g1(2.0, 100.0), g2(2.0, 1.0);
+
+    // gamma mixture at chunk-straddling sizes (kEChunk=32 boundaries)
+    for (uint32_t S : {31u, 32u, 33u, 255u, 257u, 900u}) {
+        std::vector<float> theta(C * F);
+        for (auto &v : theta) v = float(g1(rng));
+        std::vector<float> fit(size_t(S) * F);
+        std::vector<int32_t> y(S);
+        for (uint32_t s = 0; s < S; ++s) {
+            y[s] = int32_t(rng() % C);
+            for (uint32_t f = 0; f < F; ++f)
+                fit[s * F + f] = float(g2(rng)) * theta[y[s] * F + f];
+        }
+        char name[32];
+        std::snprintf(name, sizeof(name), "gamma-S%u", S);
+        drive_corpus(fit, y, S, 5, threads, name);
+    }
+
+    // DEGENERATE: all points identical — every bound ties, screens
+    // must stay lossless with zero pruning power
+    {
+        const uint32_t S = 300;
+        std::vector<float> fit(size_t(S) * F, 41.5f);
+        std::vector<int32_t> y(S);
+        for (uint32_t s = 0; s < S; ++s) y[s] = int32_t(s % C);
+        drive_corpus(fit, y, S, 5, threads, "all-identical");
+    }
+
+    // k == S: the whole corpus is the top-k — nothing may screen away
+    {
+        const uint32_t S = 48;
+        std::vector<float> fit(size_t(S) * F);
+        std::vector<int32_t> y(S);
+        for (uint32_t s = 0; s < S; ++s) {
+            y[s] = int32_t(rng() % C);
+            for (uint32_t f = 0; f < F; ++f)
+                fit[s * F + f] = float(g2(rng)) * 100.0f;
+        }
+        drive_corpus(fit, y, S, S, threads, "k-equals-S");
+    }
+
+    if (failures.load()) {
+        std::fprintf(stderr, "sanitize_knn: %d FAILURES\n",
+                     failures.load());
+        return 1;
+    }
+    std::fprintf(stderr, "sanitize_knn: all clean (threads=%d)\n",
+                 threads);
+    return 0;
+}

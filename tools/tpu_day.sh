@@ -102,7 +102,27 @@ if [ -f tools/bench_openset.py ]; then
   fi
 fi
 
-# chip-day allowance: one warm process gets time for every race stage
+# KNN kernel evidence on chip: the pruned-exact A/B + the IVF recall
+# sweep (tools/bench_knn.py; short kernels — the sweep reuses one warm
+# process). Writes *_cpu.json paths by default; land the chip twins
+# explicitly so the CPU evidence is never overwritten by a chip run.
+if [ -f tools/bench_knn.py ]; then
+  run_step 1200 /tmp/tpu_day_knn.log python tools/bench_knn.py \
+    --platform default \
+    --out-prune /tmp/knn_prune_chip.json \
+    --out-recall /tmp/knn_ivf_recall_chip.json
+  if [ "$STEP_OK" = 1 ] \
+      && grep -q '"platform": "tpu"' /tmp/knn_prune_chip.json; then
+    cp /tmp/knn_prune_chip.json docs/artifacts/knn_prune_tpu.json
+    cp /tmp/knn_ivf_recall_chip.json \
+      docs/artifacts/knn_ivf_recall_tpu.json
+    echo "tpu_day: knn prune + ivf recall landed"
+  fi
+fi
+
+# chip-day allowance: one warm process gets time for every race stage —
+# including the 4-way+ KNN top-k chip race (sort/argmax/hier*/screened*
+# now race inside bench.py stage 4b; the parity-gated winner promotes)
 # (the driver's own end-of-round run keeps bench.py's 560 s default)
 TCSDN_BENCH_BUDGET=1500
 export TCSDN_BENCH_BUDGET

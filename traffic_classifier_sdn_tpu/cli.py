@@ -476,6 +476,17 @@ def _build_parser() -> argparse.ArgumentParser:
         "recompiling",
     )
     p.add_argument(
+        "--knn-topk", default=None, metavar="IMPL",
+        help="KNN serving top-k implementation (models/__init__.py "
+        "resolve_knn_topk): sort (default), argmax, hier[<group>], "
+        "screened[<group>], pallas (TPU-only), native (exact-f64 C++ "
+        "host evaluator — single-device host serving), or ivf[<nprobe>] "
+        "(the APPROXIMATE cluster-probed tier, ops/knn_ivf.py — "
+        "explicit opt-in with a measured recall artifact). The flag "
+        "wins over the TCSDN_KNN_TOPK env var (kept as fallback); "
+        "unknown values are a usage error",
+    )
+    p.add_argument(
         "--profile-dir", default=None,
         help="capture a jax.profiler trace of the run into this directory",
     )
@@ -2024,7 +2035,21 @@ def main(argv=None) -> None:
     from .utils.metrics import global_metrics
 
     global_metrics.reset()  # per-run metrics, even for embedded reuse
-    args = _build_parser().parse_args(argv)
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+    if args.knn_topk is not None:
+        # validate at parse time (clean usage error, exit 2 — never a
+        # traceback) and publish through the env var so EVERY serving-
+        # path resolution — boot, degrade-rung rebuilds, drift
+        # promotions — sees the same choice (flag wins; env kept as
+        # fallback when the flag is absent)
+        from .models import resolve_knn_topk
+
+        try:
+            resolve_knn_topk(args.knn_topk)
+        except ValueError as e:
+            parser.error(f"--knn-topk: {e}")
+        os.environ["TCSDN_KNN_TOPK"] = args.knn_topk
     if args.config:
         from . import config as config_mod
 

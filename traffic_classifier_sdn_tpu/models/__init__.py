@@ -28,6 +28,76 @@ MODEL_MODULES = {
     "forest": forest,
 }
 
+# the --knn-topk / TCSDN_KNN_TOPK menu (usage text shared by the CLI
+# flag error and resolve_knn_topk's ValueError)
+KNN_TOPK_CHOICES = (
+    "sort, argmax, hier[<group>], screened[<group>], pallas, native, "
+    "ivf[<nprobe>]"
+)
+_KNN_TOPK_WARNED: set[str] = set()
+
+
+def resolve_knn_topk(value: str | None = None) -> str:
+    """Resolve and validate the serving KNN top-k implementation: an
+    explicit value (the ``--knn-topk`` flag) wins, else the
+    ``TCSDN_KNN_TOPK`` env fallback, else ``sort``. Unknown names raise
+    ``ValueError`` with the menu (cli.py surfaces it as a clean usage
+    error, not a traceback); numeric-suffix forms are checked for shape
+    here and for corpus-dependent bounds (hier's group ≥ n_neighbors)
+    at serving-path build time.
+
+    This is the ONE resolution point, so the serving-semantics warnings
+    fire here — once per process per implementation, not once per
+    serving-path build (drift promotions rebuild the path on every
+    swap): ``native`` ranks by exact f64 distances and can diverge from
+    the default f32 device ranking on near-ties (ADVICE r5, no same-run
+    parity gate at serving time); ``ivf`` is the APPROXIMATE tier — an
+    explicit opt-in served with a measured recall artifact
+    (docs/artifacts/knn_ivf_recall_cpu.json), never a silent
+    substitute."""
+    import os
+    import sys
+
+    impl = value if value is not None else os.environ.get(
+        "TCSDN_KNN_TOPK", "sort"
+    )
+    if impl not in ("sort", "argmax", "pallas", "native", "hier",
+                    "screened", "ivf"):
+        for prefix in ("screened", "hier", "ivf"):
+            suffix = impl[len(prefix):]
+            # a zero suffix (group/nprobe) is never valid for ANY
+            # corpus — reject at resolve time so the CLI's usage-error
+            # contract holds (corpus-dependent bounds still land at
+            # serving-path build)
+            if (impl.startswith(prefix) and suffix.isdecimal()
+                    and int(suffix) >= 1):
+                break
+        else:
+            raise ValueError(
+                f"unknown KNN top-k implementation {impl!r} "
+                f"(--knn-topk / TCSDN_KNN_TOPK; choose from: "
+                f"{KNN_TOPK_CHOICES})"
+            )
+    if impl == "native" and "native" not in _KNN_TOPK_WARNED:
+        _KNN_TOPK_WARNED.add("native")
+        print(
+            "NOTE: TCSDN_KNN_TOPK=native ranks by exact f64 "
+            "distances; labels can differ from the default f32 "
+            "device ranking on near-ties (no same-run parity gate "
+            "at serving time)",
+            file=sys.stderr,
+        )
+    if impl.startswith("ivf") and "ivf" not in _KNN_TOPK_WARNED:
+        _KNN_TOPK_WARNED.add("ivf")
+        print(
+            "NOTE: --knn-topk ivf serves the APPROXIMATE cluster-probed "
+            "tier: true neighbors outside the probed lists are missed "
+            "(measured recall: docs/artifacts/knn_ivf_recall_cpu.json); "
+            "exact tiers: sort/argmax/hier/screened/native",
+            file=sys.stderr,
+        )
+    return impl
+
 # reference CLI subcommand → normalized model name (traffic_classifier.py:189;
 # both 'knearest' and 'kneighbors' accepted — the reference advertises the
 # former but dispatches on the latter, a defect we fix rather than replicate).
@@ -62,50 +132,115 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
     - ``TCSDN_SVC_KERNEL`` ∈ ``chunked`` (default, two-float exact
       difference form) | ``dot`` (dot-expansion RBF — one matmul, no
       (N, S, F) difference tensor; ~3.6× on CPU hosts).
-    - ``TCSDN_KNN_TOPK`` ∈ ``sort`` (default) | ``argmax`` | ``hier`` or
-      ``hier<group>`` (e.g. ``hier512``; group in [n_neighbors, 65536]) |
-      ``pallas`` (ops/pallas_knn fused distance+top-k kernel; TPU-only —
-      Mosaic does not compile on CPU hosts) | ``native`` (the C++
-      host-spine brute force for accelerator-less hosts; ``host_native``
-      — callers must NOT jit or shard_map it). Numerics note: ``native``
-      ranks by exact float64 squared distances while the default XLA
-      path ranks by float32 dot-expansion similarity, so labels can
-      differ wherever f32 rounding makes or breaks a near-tie — a
-      documented divergence (ADVICE r5), warned once at selection time;
-      unlike bench promotion there is no same-run parity gate at
-      serving (only the reference-corpus parity in
-      tests/test_native_knn.py).
+    - ``TCSDN_KNN_TOPK`` (the ``--knn-topk`` CLI flag wins over the env
+      var; both resolve through ``resolve_knn_topk``) ∈ ``sort``
+      (default) | ``argmax`` | ``hier`` or ``hier<group>`` (e.g.
+      ``hier512``; group in [n_neighbors, 65536]) | ``screened`` or
+      ``screened<group>`` (bound-screened group selection — the cheap
+      group-max pass picks the k survivor groups, exact ranking runs
+      over their columns only; bitwise lax.top_k tie order, see
+      models/knn._topk_screened_idx) | ``pallas`` (ops/pallas_knn fused
+      distance+top-k kernel; TPU-only — Mosaic does not compile on CPU
+      hosts) | ``native`` (the C++ host-spine cluster-pruned exact
+      search for accelerator-less hosts; ``host_native`` — callers must
+      NOT jit or shard_map it) | ``ivf`` or ``ivf<nprobe>`` (the
+      APPROXIMATE cluster-probed tier, ops/knn_ivf.py — explicit opt-in
+      only, measured recall artifact, never promoted by the bench).
+      Numerics note: ``native`` ranks by exact float64 squared
+      distances while the default XLA path ranks by float32
+      dot-expansion similarity, so labels can differ wherever f32
+      rounding makes or breaks a near-tie — a documented divergence
+      (ADVICE r5), warned once at resolve time; unlike bench promotion
+      there is no same-run parity gate at serving (only the
+      reference-corpus parity in tests/test_native_knn.py).
 
-    Every option is argmax-parity-gated against the same oracles by
-    tests and by the bench before promotion; selection never changes
-    semantics, only speed."""
+    Every EXACT option is argmax-parity-gated against the same oracles
+    by tests and by the bench before promotion; exact selection never
+    changes semantics, only speed. ``ivf`` is the one option that
+    trades semantics for speed, which is why it is opt-in."""
     import functools
     import os
 
     mod = MODEL_MODULES[name]
     if name == "knn":
-        impl = os.environ.get("TCSDN_KNN_TOPK", "sort")
+        impl = resolve_knn_topk()
         if impl == "pallas":
             from ..ops import pallas_knn
 
             return pallas_knn.predict_chunked, pallas_knn.compile_knn(params)
-        if impl == "native":
-            # host-spine C++ brute force (native/knn_eval.cpp) for
-            # accelerator-less hosts; host_native contract as the forest
-            # branch below — a plain host call, never jitted/shard_mapped
-            import sys
+        if impl.startswith("ivf"):
+            # the APPROXIMATE cluster-probed tier (ops/knn_ivf.py) —
+            # this branch is only reachable through the explicit
+            # --knn-topk ivf / TCSDN_KNN_TOPK=ivf opt-in (the warning
+            # fired at resolve time); the coarse quantizer fits HERE,
+            # at params-build time, on the already-device-resident
+            # KMeans kernel
+            from ..ops import knn_ivf
 
+            suffix = impl[3:]
+            nprobe = int(suffix) if suffix else knn_ivf.DEFAULT_NPROBE
+            if nprobe < 1:
+                raise ValueError(
+                    f"TCSDN_KNN_TOPK={impl!r}: nprobe must be >= 1"
+                )
+            ivf = knn_ivf.build(params, nprobe=nprobe)
+            from ..native import knn as native_knn
+
+            if native_knn.available():
+                # serve the NATIVE mirror of the same quantizer — on
+                # CPU hosts the XLA tier's per-row candidate gathers
+                # cost more than the sort network they avoid, while
+                # the native tier probes at 4-6x the full scan
+                # (knn_ivf_recall_cpu.json); host_native contract as
+                # the native branch below
+                import numpy as np
+
+                from ..utils.metrics import global_metrics as _gm
+
+                hk = native_knn.NativeKnn({
+                    "fit_X": np.asarray(params.fit_X),
+                    "y": np.asarray(params.fit_y),
+                    "n_neighbors": params.n_neighbors,
+                    "classes": np.arange(params.n_classes),
+                })
+                # the same partition build() just computed — O(S)
+                # list inversion, no second assignment pass (NativeKnn
+                # construction still pays its exact-tier Lloyd index;
+                # a rebuild is rare — boot and drift promotions — and
+                # ~tens of ms at reference scale)
+                hk.build_ivf(
+                    np.asarray(ivf.centers), knn_ivf.assignments_of(ivf)
+                )
+                nprobe_eff = ivf.nprobe
+                last = {"screened": 0, "abandoned": 0}
+
+                def native_ivf_predict(_params, X):
+                    out = hk.predict_ivf(
+                        np.asarray(X, np.float32), nprobe_eff
+                    )
+                    scr, ab, _q = hk.screen_stats()
+                    _gm.inc("knn_candidates_screened",
+                            scr - last["screened"])
+                    _gm.inc("knn_candidates_abandoned",
+                            ab - last["abandoned"])
+                    last["screened"], last["abandoned"] = scr, ab
+                    return jnp.asarray(out)
+
+                native_ivf_predict.host_native = True
+                return native_ivf_predict, None
+            # no C++ on this host: the XLA tier (the device-side
+            # implementation — the TPU artifact measures it)
+            return knn_ivf.predict_chunked, ivf
+        if impl == "native":
+            # host-spine C++ cluster-pruned exact search
+            # (native/knn_eval.cpp) for accelerator-less hosts;
+            # host_native contract as the forest branch below — a plain
+            # host call, never jitted/shard_mapped. (The f64-vs-f32
+            # divergence NOTE fired once at resolve time.)
             import numpy as np
 
             from ..native import knn as native_knn
-
-            print(
-                "NOTE: TCSDN_KNN_TOPK=native ranks by exact f64 "
-                "distances; labels can differ from the default f32 "
-                "device ranking on near-ties (no same-run parity gate "
-                "at serving time)",
-                file=sys.stderr,
-            )
+            from ..utils.metrics import global_metrics as _gm
 
             hk = native_knn.NativeKnn({
                 "fit_X": np.asarray(params.fit_X),  # the f32 hi corpus,
@@ -114,23 +249,38 @@ def _build_serving_path(name: str, params) -> tuple[Callable, Any]:
                 "n_neighbors": params.n_neighbors,
                 "classes": np.arange(params.n_classes),
             })
+            # screen accounting: the evaluator's cumulative totals diff
+            # into the serving counters each call (one caller per serve
+            # — the device-stage worker — so the stateful diff is safe)
+            last = {"screened": 0, "abandoned": 0}
 
             def native_knn_predict(_params, X):
-                return jnp.asarray(hk.predict(np.asarray(X, np.float32)))
+                out = hk.predict(np.asarray(X, np.float32))
+                scr, ab, _q = hk.screen_stats()
+                _gm.inc("knn_candidates_screened",
+                        scr - last["screened"])
+                _gm.inc("knn_candidates_abandoned",
+                        ab - last["abandoned"])
+                last["screened"], last["abandoned"] = scr, ab
+                return jnp.asarray(out)
 
             native_knn_predict.host_native = True
             return native_knn_predict, None
         if impl not in ("sort", "argmax"):
-            suffix = impl[4:] or "128"
-            # isdecimal (not isdigit: unicode superscripts pass isdigit
-            # then crash int()); group must admit a full top-k
-            if not (impl.startswith("hier") and suffix.isdecimal()):
-                raise ValueError(f"TCSDN_KNN_TOPK={impl!r} unknown")
-            group = int(suffix)
-            if group < params.n_neighbors or group > (1 << 16):
+            # hier[<group>] / screened[<group>]: the NAME was validated
+            # at resolve time; the corpus-dependent group bounds land
+            # here (hier's final merge needs group >= n_neighbors; the
+            # screened bound pass only needs a nonzero width)
+            prefix = "hier" if impl.startswith("hier") else "screened"
+            suffix = impl[len(prefix):]
+            group = int(suffix) if suffix else (
+                128 if prefix == "hier" else 32
+            )
+            lo = params.n_neighbors if prefix == "hier" else 1
+            if group < lo or group > (1 << 16):
                 raise ValueError(
                     f"TCSDN_KNN_TOPK={impl!r}: group must be in "
-                    f"[n_neighbors={params.n_neighbors}, 65536]"
+                    f"[{lo}, 65536]"
                 )
         return functools.partial(mod.predict_chunked, top_k_impl=impl), params
     if name == "svc":

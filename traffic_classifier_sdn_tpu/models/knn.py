@@ -94,6 +94,12 @@ def neighbor_votes(params: Params, X: jax.Array, X_lo=None,
         # every width is exact (same merge argument)
         group = int(top_k_impl[4:] or 128)
         nbr_idx = _topk_hier_idx(sim, params.n_neighbors, group=group)
+    elif top_k_impl.startswith("screened"):
+        # "screened" (group=32 — the measured CPU winner at batch 16k)
+        # or "screened<group>" — bound-screened group selection; every
+        # width is exact (proof on the fn)
+        group = int(top_k_impl[8:] or 32)
+        nbr_idx = _topk_screened_idx(sim, params.n_neighbors, group=group)
     elif top_k_impl == "sort":
         _, nbr_idx = lax.top_k(sim, params.n_neighbors)  # (N, k)
     else:
@@ -167,6 +173,54 @@ def _topk_hier_idx(sim: jax.Array, k: int, group: int = 128) -> jax.Array:
     gidx = (idx_g.astype(jnp.int32) + base).reshape(n, G * k)
     _, sel = lax.top_k(vals_g.reshape(n, G * k), k)  # (N, k) positions
     return jnp.take_along_axis(gidx, sel, axis=1)
+
+
+def _topk_screened_idx(sim: jax.Array, k: int, group: int = 32) -> jax.Array:
+    """(N, k) indices of the k largest columns — bound-screened group
+    selection: a cheap per-group MAX pass (the group's upper bound — in
+    distance terms, a triangle-style lower bound on every member's
+    distance) selects the k survivor groups per row, and the exact
+    ranking runs only over their k·group gathered columns. This is the
+    XLA mirror of the native evaluator's whole-chunk screening: the
+    bound pass costs one max-reduce over (N, S) plus a top-k over the
+    G = ⌈S/group⌉ group maxima instead of ``lax.top_k``'s sort network
+    over all S columns.
+
+    Exactness incl. tie order (bitwise-identical to ``lax.top_k`` over
+    the full row): (1) every true top-k element lives in one of the
+    top-k groups by (group max desc, group index asc) — if element e
+    (value v, group g) had k groups ranked above g, each contributes a
+    distinct element that outranks e: strictly larger max, or an equal
+    max in a lower-indexed group, whose element (groups are CONTIGUOUS
+    index ranges) has a globally lower index; k such elements
+    contradict e being in the top-k. ``lax.top_k`` over the maxima
+    produces exactly that (max desc, index asc) group ranking.
+    (2) The selected group ids are re-sorted ASCENDING before the
+    gather, so gathered position order equals global index order and
+    the final ``lax.top_k``'s lowest-position tie rule resolves to the
+    lowest global index — the full-row rule. Padding columns get -inf
+    and lose every comparison (each group holds ≥ 1 real column and
+    k selected groups hold ≥ k real columns; sim is finite — the
+    ``_topk_argmax_idx`` precondition). Rows with fewer than k groups
+    degrade to the plain sort network (still exact)."""
+    n, S = sim.shape
+    G = -(-S // group)
+    if G < k:  # too few groups to screen — the sort network is exact
+        _, nbr_idx = lax.top_k(sim, k)
+        return nbr_idx
+    pad = G * group - S
+    if pad:
+        sim = jnp.pad(sim, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+    gmax = jnp.max(sim.reshape(n, G, group), axis=2)  # (N, G) bounds
+    _, gsel = lax.top_k(gmax, k)  # (N, k) survivor groups
+    gsel = jnp.sort(gsel, axis=1)  # ascending → global-index tie order
+    cand_idx = (
+        gsel[:, :, None] * group
+        + jnp.arange(group, dtype=gsel.dtype)[None, None, :]
+    ).reshape(n, k * group)
+    cand_val = jnp.take_along_axis(sim, cand_idx, axis=1)
+    _, sel = lax.top_k(cand_val, k)
+    return jnp.take_along_axis(cand_idx, sel, axis=1).astype(jnp.int32)
 
 
 def scores(params: Params, X: jax.Array, X_lo=None) -> jax.Array:

@@ -1,17 +1,26 @@
-"""ctypes bindings for the native (C++) brute-force KNN evaluator.
+"""ctypes bindings for the native (C++) KNN evaluator.
 
 The accelerator-less host path for the KNeighbors checkpoint (the
 reference walks one KDTree per query on one CPU,
 ``/root/reference/traffic_classifier.py:234-236``): exact float64
-squared distances with the lax.top_k tie order, SIMD-blocked so the
-corpus streams from cache once per 8-query block (see
-native/knn_eval.cpp). The XLA/Pallas kernels in models/knn.py and
-ops/pallas_knn.py remain the device paths; ``bench.py`` races this
-entrant on the CPU fallback under the same same-run parity gate as
-every other raced kernel. Serving divergence: this path's exact-f64
-ranking can disagree with the default f32 dot-expansion ranking on
-near-ties — ``TCSDN_KNN_TOPK=native`` is a documented opt-in and
-models/__init__ logs a one-line warning when it is selected.
+squared distances with the lax.top_k tie order. The default
+``predict``/``votes`` run the PRUNED engine (cluster-chunked
+triangle-inequality screening + f32 SIMD screen + partial-distance
+early abandon, vote-for-vote identical to the full scan — see
+native/knn_eval.cpp);
+``predict_unpruned``/``votes_unpruned`` keep the original blocked full
+scan callable as the same-run A/B baseline
+(docs/artifacts/knn_prune_cpu.json) and parity oracle, and
+``build_ivf``/``predict_ivf``/``votes_ivf`` expose the approximate
+cluster-probed tier (coarse quantizer fit in Python by ops/knn_ivf.py;
+nprobe >= n_lists degenerates to the exact search bit-for-bit). The
+XLA/Pallas kernels in models/knn.py and ops/pallas_knn.py remain the
+device paths; ``bench.py`` races this entrant on the CPU fallback under
+the same same-run parity gate as every other raced kernel. Serving
+divergence: this path's exact-f64 ranking can disagree with the default
+f32 dot-expansion ranking on near-ties — ``TCSDN_KNN_TOPK=native`` is a
+documented opt-in and models.resolve_knn_topk logs a one-line warning
+when it is selected.
 
 Built lazily with g++ ``-march=native`` on first use (the distance
 loops need the host's widest SIMD; the .so never leaves the machine it
@@ -60,6 +69,30 @@ def _load():
         lib.tck_votes.argtypes = [
             ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
         ]
+        lib.tck_predict_unpruned.restype = None
+        lib.tck_predict_unpruned.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
+        ]
+        lib.tck_votes_unpruned.restype = None
+        lib.tck_votes_unpruned.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32, ct.c_void_p,
+        ]
+        lib.tck_ivf_build.restype = ct.c_int32
+        lib.tck_ivf_build.argtypes = [
+            ct.c_void_p, ct.c_uint32, ct.c_void_p, ct.c_void_p,
+        ]
+        lib.tck_predict_ivf.restype = None
+        lib.tck_predict_ivf.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32,
+            ct.c_uint32, ct.c_void_p,
+        ]
+        lib.tck_votes_ivf.restype = None
+        lib.tck_votes_ivf.argtypes = [
+            ct.c_void_p, ct.c_void_p, ct.c_uint64, ct.c_uint32,
+            ct.c_uint32, ct.c_void_p,
+        ]
+        lib.tck_screen_stats.restype = None
+        lib.tck_screen_stats.argtypes = [ct.c_void_p, ct.c_void_p]
         _lib = lib
         return _lib
 
@@ -95,6 +128,8 @@ class NativeKnn:
             raise ValueError(f"corpus has {S} rows < n_neighbors={k}")
         if k > 64:
             raise ValueError(f"n_neighbors={k} exceeds the 64-cand cap")
+        self.n_rows = S
+        self.n_lists = 0  # set by build_ivf
         self._lib = lib
         self._h = lib.tck_create(
             S, F, self.n_classes, k,
@@ -104,8 +139,7 @@ class NativeKnn:
         if not self._h:
             raise RuntimeError("tck_create rejected the corpus layout")
 
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        """(N, F) float32 features -> (N,) int32 class indices."""
+    def _check_X(self, X: np.ndarray) -> np.ndarray:
         if not self._h:
             raise RuntimeError("NativeKnn handle is closed")
         X = np.ascontiguousarray(X, np.float32)
@@ -113,6 +147,14 @@ class NativeKnn:
             raise ValueError(
                 f"X shape {X.shape} != (N, {self.n_features})"
             )
+        return X
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """(N, F) float32 features -> (N,) int32 class indices, through
+        the PRUNED exact engine (triangle/f32 screens + early abandon —
+        vote-for-vote identical to ``predict_unpruned``, pinned in
+        tests/test_native_knn.py)."""
+        X = self._check_X(X)
         out = np.empty(X.shape[0], np.int32)
         self._lib.tck_predict(
             self._h,
@@ -126,14 +168,8 @@ class NativeKnn:
         """(N, F) float32 features -> (N, C) int32 neighbor vote counts
         — the score surface for the open-set / degrade-rung paths
         (``argmax(votes) == predict``, first-max tie order, asserted in
-        tests/test_native_knn.py)."""
-        if not self._h:
-            raise RuntimeError("NativeKnn handle is closed")
-        X = np.ascontiguousarray(X, np.float32)
-        if X.ndim != 2 or X.shape[1] != self.n_features:
-            raise ValueError(
-                f"X shape {X.shape} != (N, {self.n_features})"
-            )
+        tests/test_native_knn.py). Pruned engine, same guarantee."""
+        X = self._check_X(X)
         out = np.empty((X.shape[0], self.n_classes), np.int32)
         self._lib.tck_votes(
             self._h,
@@ -142,6 +178,114 @@ class NativeKnn:
             out.ctypes.data_as(ct.c_void_p),
         )
         return out
+
+    def predict_unpruned(self, X: np.ndarray) -> np.ndarray:
+        """The original blocked full-scan predict — the same-run A/B
+        baseline (docs/artifacts/knn_prune_cpu.json) and parity
+        oracle for the pruned engine."""
+        X = self._check_X(X)
+        out = np.empty(X.shape[0], np.int32)
+        self._lib.tck_predict_unpruned(
+            self._h,
+            X.ctypes.data_as(ct.c_void_p),
+            X.shape[0], X.shape[1],
+            out.ctypes.data_as(ct.c_void_p),
+        )
+        return out
+
+    def votes_unpruned(self, X: np.ndarray) -> np.ndarray:
+        X = self._check_X(X)
+        out = np.empty((X.shape[0], self.n_classes), np.int32)
+        self._lib.tck_votes_unpruned(
+            self._h,
+            X.ctypes.data_as(ct.c_void_p),
+            X.shape[0], X.shape[1],
+            out.ctypes.data_as(ct.c_void_p),
+        )
+        return out
+
+    def build_ivf(self, centers: np.ndarray,
+                  assignments: np.ndarray) -> None:
+        """Install the IVF coarse index: ``centers`` (K, F) float,
+        ``assignments`` (S,) int in [0, K) — the quantizer fit by
+        ops/knn_ivf.py (train/kmeans). Build once, then serve: the
+        C++ side is not guarded against concurrent predicts during a
+        rebuild."""
+        if not self._h:
+            raise RuntimeError("NativeKnn handle is closed")
+        centers = np.ascontiguousarray(centers, np.float32)
+        assignments = np.ascontiguousarray(assignments, np.int32)
+        if centers.ndim != 2 or centers.shape[1] != self.n_features:
+            raise ValueError(
+                f"centers shape {centers.shape} != (K, {self.n_features})"
+            )
+        if assignments.shape != (self.n_rows,):
+            # the C++ side reads exactly S entries — a short or
+            # reshaped buffer would be an out-of-bounds read
+            raise ValueError(
+                f"assignments shape {assignments.shape} != "
+                f"({self.n_rows},)"
+            )
+        rc = self._lib.tck_ivf_build(
+            self._h, centers.shape[0],
+            centers.ctypes.data_as(ct.c_void_p),
+            assignments.ctypes.data_as(ct.c_void_p),
+        )
+        if rc:
+            raise ValueError(
+                f"tck_ivf_build rejected the index (rc={rc}: "
+                "bad K or out-of-range assignment)"
+            )
+        self.n_lists = int(centers.shape[0])
+
+    def _ivf_ready(self) -> None:
+        if not getattr(self, "n_lists", 0):
+            raise RuntimeError("no IVF index — call build_ivf first")
+
+    def predict_ivf(self, X: np.ndarray, nprobe: int) -> np.ndarray:
+        """Approximate predict over the ``nprobe`` nearest coarse lists
+        (clamped to K; ``nprobe >= n_lists`` equals ``predict``
+        bit-for-bit — the tests/test_knn_ivf.py anchor)."""
+        self._ivf_ready()
+        X = self._check_X(X)
+        if nprobe < 1:
+            raise ValueError(f"nprobe={nprobe} must be >= 1")
+        out = np.empty(X.shape[0], np.int32)
+        self._lib.tck_predict_ivf(
+            self._h,
+            X.ctypes.data_as(ct.c_void_p),
+            X.shape[0], X.shape[1], nprobe,
+            out.ctypes.data_as(ct.c_void_p),
+        )
+        return out
+
+    def votes_ivf(self, X: np.ndarray, nprobe: int) -> np.ndarray:
+        self._ivf_ready()
+        X = self._check_X(X)
+        if nprobe < 1:
+            raise ValueError(f"nprobe={nprobe} must be >= 1")
+        out = np.empty((X.shape[0], self.n_classes), np.int32)
+        self._lib.tck_votes_ivf(
+            self._h,
+            X.ctypes.data_as(ct.c_void_p),
+            X.shape[0], X.shape[1], nprobe,
+            out.ctypes.data_as(ct.c_void_p),
+        )
+        return out
+
+    def screen_stats(self) -> tuple[int, int, int]:
+        """Cumulative (screened, abandoned, queries) counters: norm-bound
+        skips, partial-distance early exits, and queries answered —
+        the serving layer diffs these into the
+        ``knn_candidates_screened`` / ``knn_candidates_abandoned``
+        metrics (docs/OBSERVABILITY.md)."""
+        if not self._h:
+            raise RuntimeError("NativeKnn handle is closed")
+        out = np.zeros(3, np.uint64)
+        self._lib.tck_screen_stats(
+            self._h, out.ctypes.data_as(ct.c_void_p)
+        )
+        return int(out[0]), int(out[1]), int(out[2])
 
     def close(self) -> None:
         if self._h:

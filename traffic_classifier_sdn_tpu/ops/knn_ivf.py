@@ -1,0 +1,262 @@
+"""IVF (inverted-file) approximate KNN — the cluster-probed serving tier.
+
+The classic IVF design (Jégou-style inverted lists) applied to the KNN
+serving hot path: the training corpus is partitioned by a coarse KMeans
+quantizer — fit by the SAME already-device-resident Lloyd kernel the
+kmeans family trains with (train/kmeans.py) — and a query runs the
+exact top-k only within its ``nprobe`` nearest centroid lists instead
+of all S corpus rows. This is an APPROXIMATE tier: a true neighbor
+whose list is not probed is missed, so it serves strictly behind the
+explicit ``--knn-topk ivf`` / ``TCSDN_KNN_TOPK=ivf`` opt-in with a
+measured recall artifact (docs/artifacts/knn_ivf_recall_cpu.json,
+tools/bench_knn.py) — never silently substituted for an exact path.
+
+Anchors:
+
+- ``nprobe >= n_lists`` is the EXACT search bit-for-bit: the probed
+  lists then cover the whole corpus (the lists partition it), the
+  candidate set is sorted into ascending corpus order before the final
+  ``lax.top_k``, and ties therefore resolve to the lowest corpus index
+  — the full-row ``lax.top_k`` rule (pinned in tests/test_knn_ivf.py).
+- The ranking values are the SAME f32 dot-expansion similarities the
+  exact XLA paths rank by (``models/knn._dot_expansion_sim``), so at
+  nprobe == n_lists the label stream is bitwise-identical to
+  ``top_k_impl='sort'``.
+- The native C++ evaluator mirrors the tier (``NativeKnn.build_ivf`` /
+  ``predict_ivf`` over the same quantizer) — and IS what the serving
+  opt-in resolves to on hosts where it builds: on CPU the XLA tier's
+  per-row candidate gathers cost more than the full sort network it
+  avoids (measured in knn_ivf_recall_cpu.json's xla_flows_per_sec
+  column), while the native tier probes at 4-6× the full scan. The XLA
+  path remains the device-side implementation (TPU evidence is armed
+  in tools/tpu_day.sh) and the recall harness's reference.
+
+The probe stage ranks centroids by the difference-form distance
+(``models/kmeans.scores`` semantics — the dot-expansion cancels
+catastrophically at this data's ~8e8 feature scale, see
+models/kmeans.py); probe selection only decides WHICH lists are
+searched, so its numerics affect recall, never exactness of the
+within-list ranking.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from ..models import knn
+
+# Shipped default probe count: the smallest nprobe that clears the
+# >= 0.99 recall@1 gate on the reference-scale recall sweep
+# (docs/artifacts/knn_ivf_recall_cpu.json regenerates the evidence:
+# recall@1 0.998 at nprobe=2 with the native tier probing at ~4.6x the
+# unpruned full scan; nprobe=4 reaches recall 1.0 at ~2.6x — pass
+# --knn-topk ivf4 to trade speed for the wider probe).
+DEFAULT_NPROBE = 2
+
+
+def default_n_clusters(n_rows: int) -> int:
+    """K ≈ √S — the standard IVF balance between probe cost (∝ K) and
+    list-scan cost (∝ S/K per probed list)."""
+    return max(1, int(round(float(n_rows) ** 0.5)))
+
+
+class IvfParams(struct.PyTreeNode):
+    """The serving bundle: exact corpus params + the coarse index.
+
+    ``list_idx`` rows are ascending corpus indices padded with S (the
+    one-past-the-end sentinel — its similarity column is -inf and its
+    label is dropped by the one-hot, so padding never votes)."""
+
+    base: knn.Params
+    centers: jax.Array     # (K, F) f32 coarse quantizer
+    list_idx: jax.Array    # (K, L) int32 member corpus indices, pad = S
+    nprobe: int = struct.field(pytree_node=False)
+
+    @property
+    def n_lists(self) -> int:
+        return int(self.centers.shape[0])
+
+
+def assignments(fit_X: np.ndarray, centers: np.ndarray) -> np.ndarray:
+    """(S,) int32 nearest-centroid ids — f64 difference form on host,
+    lowest-index ties (np.argmin), shared by the XLA list build and the
+    native mirror so both tiers hold the SAME partition. Row-chunked:
+    the (S, K, F) broadcast would be ~28 MB f64 at reference scale."""
+    fx = np.asarray(fit_X, np.float64)
+    ce = np.asarray(centers, np.float64)
+    out = np.empty(fx.shape[0], np.int32)
+    for lo in range(0, fx.shape[0], 1024):
+        d2 = ((fx[lo:lo + 1024, None, :] - ce[None, :, :]) ** 2).sum(-1)
+        out[lo:lo + 1024] = np.argmin(d2, axis=1)
+    return out
+
+
+def assignments_of(ivf: IvfParams) -> np.ndarray:
+    """(S,) int32 assignments recovered from the built lists — O(S)
+    inversion, no distance recompute (the serving resolution hands the
+    native mirror the SAME partition without paying the assignment
+    pass twice)."""
+    list_idx = np.asarray(ivf.list_idx)
+    S = int(ivf.base.fit_X.shape[0])
+    out = np.empty(S, np.int32)
+    for c in range(list_idx.shape[0]):
+        members = list_idx[c][list_idx[c] < S]
+        out[members] = c
+    return out
+
+
+def build(params: knn.Params, *, n_clusters: int | None = None,
+          nprobe: int = DEFAULT_NPROBE, seed: int = 0,
+          n_init: int = 4, n_iter: int = 25) -> IvfParams:
+    """Fit the coarse quantizer on the corpus (train/kmeans Lloyd kernel,
+    deterministic seed) and assemble the serving bundle. Runs at
+    params-build time — the serving path resolution (models/__init__)
+    calls this once per loaded model."""
+    from ..train import kmeans as tkmeans
+
+    fit_X = np.asarray(params.fit_X, np.float32)
+    S = fit_X.shape[0]
+    K = n_clusters if n_clusters is not None else default_n_clusters(S)
+    K = max(1, min(int(K), S))
+    kparams, _ = tkmeans.fit(
+        fit_X, k=K, n_init=n_init, n_iter=n_iter, seed=seed
+    )
+    centers = np.asarray(kparams.centers, np.float32)
+    assign = assignments(fit_X, centers)
+    lists: list[list[int]] = [[] for _ in range(K)]
+    for s, c in enumerate(assign):  # ascending s → ascending per list
+        lists[int(c)].append(s)
+    L = max(1, max(len(li) for li in lists))
+    list_idx = np.full((K, L), S, np.int32)  # pad = S sentinel
+    for c, li in enumerate(lists):
+        list_idx[c, : len(li)] = li
+    if nprobe < 1:
+        raise ValueError(f"nprobe={nprobe} must be >= 1")
+    return IvfParams(
+        base=params,
+        centers=jnp.asarray(centers),
+        list_idx=jnp.asarray(list_idx),
+        nprobe=int(min(nprobe, K)),
+    )
+
+
+def _probe_lists(ivf: IvfParams, X: jax.Array, nprobe: int) -> jax.Array:
+    """(N, nprobe) probed list ids — nearest centroids by the
+    difference-form distance, ties to the lowest centroid index
+    (``lax.top_k`` over the negated distances)."""
+    diff = X[:, None, :] - ivf.centers[None, :, :]
+    csim = -jnp.sum(diff * diff, axis=-1)  # (N, K)
+    _, psel = lax.top_k(csim, nprobe)
+    return psel
+
+
+def neighbor_votes_ivf(ivf: IvfParams, X: jax.Array,
+                       nprobe: int | None = None) -> jax.Array:
+    """(N, C) neighbor votes over the probed lists only.
+
+    The candidate set (union of the probed lists, padded entries =
+    corpus-size sentinel) is SORTED into ascending corpus order before
+    the final ``lax.top_k``, so equal similarities resolve to the
+    lowest corpus index — the full-row tie rule; at nprobe == n_lists
+    the candidate set is exactly 0..S-1 and the result is
+    bitwise-identical to the exact sort path. The sentinel's similarity
+    column is -inf (it loses every comparison) and its label row is
+    out-of-range for the one-hot (a zero row), so a probe set holding
+    fewer than k real candidates votes over the real ones only — the
+    same guarantee the native mirror makes."""
+    p = ivf.base
+    np_eff = ivf.nprobe if nprobe is None else int(nprobe)
+    np_eff = max(1, min(np_eff, ivf.n_lists))
+    n = X.shape[0]
+    S = p.fit_X.shape[0]
+    sim = knn._dot_expansion_sim(X, p.fit_X, p.half_sq_norms)  # (N, S)
+    psel = _probe_lists(ivf, X, np_eff)  # (N, nprobe)
+    cand = ivf.list_idx[psel]  # (N, nprobe, L)
+    cand = jnp.sort(cand.reshape(n, -1), axis=1)  # ascending; pad last
+    simp = jnp.concatenate(
+        [sim, jnp.full((n, 1), -jnp.inf, sim.dtype)], axis=1
+    )
+    vals = jnp.take_along_axis(simp, cand, axis=1)
+    _, sel = lax.top_k(vals, p.n_neighbors)
+    nbr = jnp.take_along_axis(cand, sel, axis=1)  # (N, k), may hold S
+    fit_y_ext = jnp.concatenate(
+        [p.fit_y, jnp.full((1,), -1, p.fit_y.dtype)]
+    )
+    return knn.count_votes(fit_y_ext, p.n_classes, nbr)
+
+
+def predict(ivf: IvfParams, X: jax.Array,
+            nprobe: int | None = None) -> jax.Array:
+    """(N,) labels through the IVF tier — the ``(params, X)`` serving
+    signature (``IvfParams`` is the params pytree)."""
+    return jnp.argmax(
+        neighbor_votes_ivf(ivf, X, nprobe), axis=-1
+    ).astype(jnp.int32)
+
+
+def predict_scores(
+    ivf: IvfParams, X: jax.Array, nprobe: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(labels, neighbor-vote scores) from ONE vote computation — the
+    open-set serving surface; ``argmax(scores) == predict`` by
+    construction (same votes, same first-max tie order)."""
+    votes = neighbor_votes_ivf(ivf, X, nprobe)
+    return jnp.argmax(votes, axis=-1).astype(jnp.int32), votes
+
+
+def predict_chunked(
+    ivf: IvfParams, X: jax.Array, X_lo=None, row_chunk: int = 16384,
+) -> jax.Array:
+    """``predict`` for serving-scale batches: rows stream through the
+    shared ``ops.chunking.chunked_predict`` dispatch (the (N, S)
+    similarity plus the (N, nprobe·L) candidate gather bound the
+    per-chunk footprint — 16k rows keeps both under the KNN row-chunk
+    budget). ``X_lo`` is accepted for serving-signature compatibility
+    and ignored: the IVF tier ranks by the f32 fast-path similarity by
+    definition."""
+    del X_lo  # the approximate tier has no two-float exact form
+    from .chunking import chunked_predict
+
+    return chunked_predict(
+        lambda xc, xlo=None: predict(ivf, xc), row_chunk, X,
+    )
+
+
+def exact_top1(params: knn.Params, X: jax.Array) -> jax.Array:
+    """(N,) the exact nearest-neighbor corpus index (sort-path ranking)
+    — the recall@1 reference."""
+    sim = knn._dot_expansion_sim(X, params.fit_X, params.half_sq_norms)
+    return jnp.argmax(sim, axis=1).astype(jnp.int32)
+
+
+def ivf_top1(ivf: IvfParams, X: jax.Array,
+             nprobe: int | None = None) -> jax.Array:
+    """(N,) the IVF tier's nearest-neighbor corpus index."""
+    p = ivf.base
+    np_eff = ivf.nprobe if nprobe is None else int(nprobe)
+    np_eff = max(1, min(np_eff, ivf.n_lists))
+    n = X.shape[0]
+    sim = knn._dot_expansion_sim(X, p.fit_X, p.half_sq_norms)
+    psel = _probe_lists(ivf, X, np_eff)
+    cand = jnp.sort(ivf.list_idx[psel].reshape(n, -1), axis=1)
+    simp = jnp.concatenate(
+        [sim, jnp.full((n, 1), -jnp.inf, sim.dtype)], axis=1
+    )
+    vals = jnp.take_along_axis(simp, cand, axis=1)
+    best = jnp.argmax(vals, axis=1)
+    return jnp.take_along_axis(cand, best[:, None], axis=1)[:, 0]
+
+
+def recall_at_1(ivf: IvfParams, X: jax.Array,
+                nprobe: int | None = None) -> float:
+    """Fraction of queries whose IVF top-1 neighbor IS the exact top-1
+    — the artifact's recall column (tools/bench_knn.py sweeps it over
+    nprobe; the unit anchor is recall == 1.0 at nprobe == n_lists)."""
+    a = np.asarray(ivf_top1(ivf, X, nprobe))
+    b = np.asarray(exact_top1(ivf.base, X))
+    return float((a == b).mean())

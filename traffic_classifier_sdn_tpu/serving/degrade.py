@@ -327,6 +327,20 @@ class DegradeLadder:
                 "watchdog_abandoned": self._watchdog.abandoned,
             }
 
+    def warm_fallback(self, X) -> bool:
+        """Prime the fallback rung's evaluator OFF the hot path
+        (serving/warmup.py): the first DEMOTED tick must not pay the
+        rung's lazy costs — eager-CPU jit compiles, native evaluator
+        page faults, the pruned-KNN score surface — on top of whatever
+        just broke the device. Returns True when a rung was primed."""
+        fb = self._fallback
+        if fb is None:
+            return False
+        fb.predict(X)
+        if fb.scores is not None:
+            fb.scores(X)
+        return True
+
     def close(self) -> None:
         self._watchdog.close()
 

@@ -157,6 +157,10 @@ def warmup_serving(engine, predict, params, *, table_rows: int,
     warmed.append("features_into")
 
     # -- predict -----------------------------------------------------------
+    # (the serving-path resolution already built whatever index the
+    # kernel needs — the pruned native KNN's cluster index at
+    # NativeKnn(), the IVF tier's coarse quantizer at knn_ivf.build —
+    # so warming the predict below also pins those structures' pages)
     if host_native:
         # nothing jitted to compile, but the call loads the C++ library
         # and faults its pages in — the native first-tick stall
@@ -166,6 +170,16 @@ def warmup_serving(engine, predict, params, *, table_rows: int,
         _warm_jitted(predict, params, X)
         labels = predict(params, X)
         warmed.append("predict")
+
+    # -- degrade fallback rung --------------------------------------------
+    # a ladder-wrapped predict exposes warm_fallback: prime the host
+    # rung (eager-CPU jit compiles, native-evaluator page faults, the
+    # votes/score surface) so the first DEMOTED tick pays none of it
+    warm_fb = getattr(predict, "warm_fallback", None)
+    if warm_fb is not None and warm_fb(
+        np.zeros((8, ft.NUM_FEATURES), np.float32)
+    ):
+        warmed.append("fallback_rung")
 
     # -- incremental dirty path (serving/incremental.py) -------------------
     # One program per dirty-bucket shape: compaction, dirty-row feature
