@@ -45,7 +45,7 @@ os.environ.setdefault(
 # widens the witness to every test module.
 LOCKTRACE_SUITES = {
     "test_chaos", "test_degrade", "test_drift", "test_latency",
-    "test_pipeline",
+    "test_pipeline", "test_scenarios",
 }
 
 

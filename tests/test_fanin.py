@@ -727,3 +727,103 @@ def test_train_multisource_native_and_python_identical(tmp_path):
     # cross-path identity: the two modes must write the same rows
     # (slot order included — same assignment sequence)
     assert outs["on"] == outs["off"]
+
+
+# -- flap escalation (the restart/quarantine livelock fix) -------------------
+
+def _scripted_tier(clock, max_flaps=2, flap_window_s=60.0,
+                   quarantine_s=5.0, recorder=None, metrics=None):
+    """Two synthetic sources, never started — deaths and restarts are
+    scripted directly (the no-threads supervision idiom above)."""
+    return fanin.FanInIngest(
+        [fanin.SourceSpec(kind="synthetic", sid=i, n_flows=2, seed=i,
+                          mac_base=i * 2, lockstep=True)
+         for i in range(2)],
+        quarantine_s=quarantine_s, clock=lambda: clock["t"],
+        max_flaps=max_flaps, flap_window_s=flap_window_s,
+        recorder=recorder, metrics=metrics,
+    )
+
+
+def _die(tier, sid):
+    w = tier._workers[sid]
+    with w._state_lock:
+        w._state = fanin.SOURCE_DEAD
+        w._clean = False
+    tier._supervise()
+
+
+def test_flap_escalation_refuses_restart_and_finally_evicts():
+    """A source flapping faster than quarantine_s used to cancel its
+    pending quarantine forever (restart_source after every death):
+    a namespace that never serves AND never evicts. After max_flaps
+    unclean deaths in the window the sid escalates — restarts are
+    refused and the quarantine finally runs to eviction."""
+    from traffic_classifier_sdn_tpu.obs.flight_recorder import (
+        FlightRecorder,
+    )
+
+    clock = {"t": 0.0}
+    rec, m = FlightRecorder(capacity=64), Metrics()
+    tier = _scripted_tier(clock, max_flaps=2, recorder=rec, metrics=m)
+    _die(tier, 1)  # flap 1 at t=0, quarantine deadline 5
+    assert tier.roster()[1]["flaps"] == 1
+    assert tier.restart_source(1) is True  # within the budget: cancels
+    assert "quarantine_expires_s" not in tier.roster()[1]
+    clock["t"] = 1.0
+    _die(tier, 1)  # flap 2 inside the window → ESCALATED, deadline 6
+    row = tier.roster()[1]
+    assert row["flaps"] == 2 and row["escalated"] is True
+    assert tier.restart_source(1) is False  # refused
+    assert "quarantine_expires_s" in tier.roster()[1]  # still pending
+    clock["t"] = 7.0
+    assert tier.take_evictions() == [1]  # the livelock is broken
+    kinds = [e["kind"] for e in rec.tail()]
+    assert "fanin.flap_escalated" in kinds
+    assert "fanin.restart_refused" in kinds
+    assert m.counters["source_flap_escalations"] == 1
+    assert m.counters["source_restarts_refused"] == 1
+    # the operator override clears the escalation and flap window
+    assert tier.restart_source(1, force=True) is True
+    assert tier.roster()[1]["escalated"] is False
+
+
+def test_flap_window_prunes_old_deaths():
+    """Deaths spaced wider than flap_window_s never accumulate to the
+    cap — escalation is about flap RATE, not lifetime restarts."""
+    clock = {"t": 0.0}
+    tier = _scripted_tier(clock, max_flaps=2, flap_window_s=10.0)
+    for t in (0.0, 20.0, 40.0):
+        clock["t"] = t
+        _die(tier, 1)
+        assert tier.roster()[1]["escalated"] is False
+        assert tier.restart_source(1) is True
+    assert tier.roster()[1]["flaps"] == 3  # lifetime counter still runs
+
+
+def test_flap_escalation_disabled_with_zero_cap():
+    """max_flaps=0 keeps the PR 14 behavior: every restart cancels the
+    pending quarantine, no matter the rate."""
+    clock = {"t": 0.0}
+    tier = _scripted_tier(clock, max_flaps=0)
+    for i in range(6):
+        clock["t"] = float(i)
+        _die(tier, 1)
+        assert tier.restart_source(1) is True
+    assert tier.roster()[1]["escalated"] is False
+
+
+def test_emitted_counter_survives_restart():
+    """The accounting identity emitted == accepted + (drops - purged)
+    spans the namespace's lifetime: a restart swaps in a fresh worker,
+    so the tier must fold the old incarnation's emitted count back
+    into the roster row."""
+    clock = {"t": 0.0}
+    tier = _scripted_tier(clock)
+    tier._workers[1]._emitted = 7  # scripted prior deliveries
+    _die(tier, 1)
+    assert tier.restart_source(1) is True
+    row = tier.roster()[1]
+    assert row["emitted"] == 7  # fresh worker starts at 0 + base 7
+    tier._workers[1]._emitted = 3
+    assert tier.roster()[1]["emitted"] == 10

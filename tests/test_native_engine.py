@@ -737,3 +737,32 @@ def test_extra_fields_rejected_and_counted_identically():
         assert eng.ingest_bytes(good, source=1) == 1
     _assert_state_equal(py, nat)
     assert py.num_flows() == nat.num_flows() == 1
+
+
+def test_counter_reset_storm_many_flows_matches_python():
+    """The reset-STORM shape: the WHOLE population's cumulative
+    counters reset in one tick (a switch reboot), not a single flow's
+    (the shape test_cumulative_counter_reset_matches_python pins).
+    Every flow takes the mod-2^32 wrap branch in the same step — the
+    two spines must stay byte-identical through it, and no feature may
+    carry a ~4.29e9 wrap artifact."""
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+
+    py, nat = _both(capacity=128)
+    gen = SyntheticFlows(40, seed=3)
+    for _ in range(3):
+        data = gen.tick_bytes()
+        py.ingest_bytes(data)
+        nat.ingest_bytes(data)
+        _assert_state_equal(py, nat)
+    # the storm: fresh generator, same flow keys, counters restarted
+    # from zero — every cumulative value goes backward simultaneously
+    reset = SyntheticFlows(40, seed=3, start_time=gen.t)
+    for _ in range(3):
+        data = reset.tick_bytes()
+        py.ingest_bytes(data)
+        nat.ingest_bytes(data)
+        _assert_state_equal(py, nat)
+    assert py.num_flows() == nat.num_flows() == 40
+    f12 = np.asarray(ft.features12(nat.table))
+    assert float(np.abs(f12).max()) < 1e9  # no 2^32 wrap artifacts

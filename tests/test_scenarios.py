@@ -1,0 +1,165 @@
+"""Adversarial scenario campaign (scenarios/): every library scenario
+runs as a tier-1 regression in its ``t1`` profile — scaled-down
+populations and phase counts, virtual-clock timing (no sleeps),
+deterministic seeds — through the REAL serve composition (fan-in tier
+× native-when-built ingest × incremental serving, degrade/open-set
+ladders where armed). A scenario that passes here is the same timeline
+tools/bench_scenarios.py scores at the ``cpu`` profile for the
+committed docs/artifacts/scenario_matrix_cpu.json artifact.
+"""
+
+import json
+
+import pytest
+
+from traffic_classifier_sdn_tpu.ingest.fanin import SourceSpec
+from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+from traffic_classifier_sdn_tpu.scenarios import (
+    SCENARIOS,
+    build,
+    run_campaign,
+    run_scenario,
+)
+from traffic_classifier_sdn_tpu.scenarios.timeline import (
+    Gate,
+    GateResult,
+    Phase,
+    Scenario,
+    gate_accounting,
+    gate_cadence,
+)
+
+
+# -- the matrix itself -------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_gates_pass(name):
+    """Each scenario's full gate set holds at the t1 profile — zero
+    silent drops, cadence, required transitions, ground truth."""
+    card = run_scenario(build(name, "t1"))
+    failed = [g for g in card["gates"] if not g["passed"]]
+    assert card["passed"], (
+        f"{name} failed gates: {json.dumps(failed, indent=1)}"
+    )
+    assert card["ticks_run"] > 0
+    # the scorecard is artifact-shaped: json-serializable as-is
+    json.dumps(card)
+
+
+def test_every_scenario_checks_accounting():
+    """The zero-silent-drops gate is not optional: every scenario in
+    the library carries accounting_exact."""
+    for name, builder in SCENARIOS.items():
+        sc = builder("t1")
+        ids = {g.id for g in sc.gates}
+        assert "accounting_exact" in ids, name
+
+
+def test_cpu_profile_builds():
+    """The committed-artifact profile constructs for every scenario
+    (generator state, phase math) without running it."""
+    for name in SCENARIOS:
+        sc = build(name, "cpu")
+        assert sc.total_ticks > 0
+        assert sc.phases
+
+
+def test_unknown_scenario_and_profile_rejected():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        build("no_such_scenario")
+    with pytest.raises(ValueError, match="profile"):
+        build("flash_crowd", "gpu")
+
+
+# -- timeline mechanics ------------------------------------------------------
+
+def test_phase_at_walks_the_timeline():
+    sc = build("flash_crowd", "t1")
+    idx0, p0 = sc.phase_at(0)
+    assert idx0 == 0 and p0.name == "baseline"
+    last_idx, last = sc.phase_at(sc.total_ticks - 1)
+    assert last_idx == len(sc.phases) - 1 and last.name == "surge"
+
+
+def test_crashing_gate_is_a_failed_gate():
+    """A gate that raises must fail closed, not kill the campaign."""
+
+    def boom(_ctx):
+        raise RuntimeError("gate bug")
+
+    res = Gate("boom", boom).evaluate(object())
+    assert res.passed is False
+    assert "gate bug" in res.detail
+
+
+def _tiny_scenario(gates) -> Scenario:
+    gen = SyntheticFlows(2, seed=9)
+    return Scenario(
+        id="tiny",
+        title="post-mortem fixture",
+        phases=(Phase("only", 2),),
+        sources=(
+            SourceSpec(kind="feed", sid=0, lockstep=True,
+                       feed=lambda _i: gen.tick_bytes()),
+        ),
+        capacity=64,
+        gates=gates,
+    )
+
+
+def test_gate_failure_dumps_post_mortem_bundle(tmp_path):
+    """Satellite 2: a failing gate leaves the atomic bundle — flight
+    JSONL + metrics snapshot + a manifest named by scenario id with
+    the timeline position and the failed gates."""
+    impossible = Gate(
+        "impossible",
+        lambda ctx: GateResult("impossible", False, detail="by design"),
+    )
+    card = run_scenario(
+        _tiny_scenario((impossible, gate_accounting())),
+        obs_dir=str(tmp_path),
+    )
+    assert card["passed"] is False
+    pm = card["post_mortem"]
+    manifest_path = tmp_path / "scenario-tiny-postmortem.json"
+    assert pm["manifest"] == str(manifest_path)
+    manifest = json.loads(manifest_path.read_text())
+    assert manifest["scenario"] == "tiny"
+    assert manifest["timeline_position"]["phase"] == "only"
+    assert [g["id"] for g in manifest["failed_gates"]] == ["impossible"]
+    # both obs-plane dumps landed and parse
+    flight = (tmp_path / pm["flight"].split("/")[-1])
+    assert flight.exists()
+    lines = flight.read_text().splitlines()
+    assert json.loads(lines[0])["kind"] == "meta"
+    metrics = tmp_path / pm["metrics"].split("/")[-1]
+    assert json.loads(metrics.read_text())["kind"] == "metrics"
+    # the breach event is recorded before the dump, so the bundle
+    # itself carries the verdict that triggered it
+    breaches = [
+        json.loads(line) for line in lines[1:]
+        if json.loads(line).get("kind") == "scenario.gate_breach"
+    ]
+    assert len(breaches) == 1
+    assert breaches[0]["gate"] == "impossible"
+
+
+def test_passing_run_writes_no_bundle(tmp_path):
+    card = run_scenario(
+        _tiny_scenario((gate_cadence(10.0), gate_accounting())),
+        obs_dir=str(tmp_path),
+    )
+    assert card["passed"] is True
+    assert "post_mortem" not in card
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_campaign_matrix_shape():
+    """run_campaign folds scorecards into the artifact shape the
+    bench tool commits: conjunction pass flag + flat failure list."""
+    out = run_campaign(
+        [_tiny_scenario((gate_accounting(),))], platform="cpu",
+    )
+    assert out["platform"] == "cpu"
+    assert out["passed"] is True and out["gate_failures"] == []
+    assert [c["scenario"] for c in out["scenarios"]] == ["tiny"]

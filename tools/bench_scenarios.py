@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Adversarial scenario campaign runner (F13): drive the scenario
+matrix through the REAL serve composition and emit the per-scenario
+SLO scorecard artifact.
+
+Each scenario (traffic_classifier_sdn_tpu/scenarios/library.py) is a
+declarative phase timeline — flash crowd, source flap storm,
+cumulative-counter reset storm, novel-class wave + boundary-hugging
+evasion, mass-eviction churn spike, queue-saturation flood, device
+wedge — run through the fan-in tier × native ingest × incremental
+serving stack with the relevant ladders live, and scored against its
+gates: cadence p50, EXACT per-source drop accounting (zero silent
+drops), e2e p99 via the latency-provenance waterfall, required state
+transitions observed in the flight recorder, and open-world ground
+truth where the scenario injects novelty.
+
+Writes docs/artifacts/scenario_matrix_cpu.json (tools/tpu_day.sh arms
+the scenario_matrix_tpu.json variant) and EXITS NONZERO on any gate
+failure — the matrix is a gate, not a report. A failing scenario also
+leaves an atomic post-mortem bundle (flight-recorder JSONL + metrics
+snapshot + timeline-position manifest) under --obs-dir, named by
+scenario id.
+
+Usage: bench_scenarios.py [--profile cpu] [--scenario id ...]
+       [--native auto|on|off] [--out PATH] [--obs-dir DIR]
+(CPU-safe: forces the host platform unless --platform default.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="run the adversarial scenario matrix"
+    )
+    ap.add_argument("--profile", choices=("t1", "cpu"), default="cpu",
+                    help="scenario scale: t1 (tier-1 test shape) or "
+                         "cpu (the committed-artifact shape)")
+    ap.add_argument("--scenario", action="append", default=None,
+                    metavar="ID",
+                    help="run only this scenario (repeatable; "
+                         "default: the whole matrix)")
+    ap.add_argument("--native", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="C++ ingest spine: auto uses it when built")
+    ap.add_argument("--platform", choices=("cpu", "tpu", "default"),
+                    default="cpu",
+                    help="cpu pins JAX_PLATFORMS=cpu; default "
+                         "inherits the environment (chip runs)")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default docs/artifacts/"
+                         "scenario_matrix_<platform>.json)")
+    ap.add_argument("--obs-dir", default="scenario-postmortem",
+                    help="gate-failure post-mortem bundle directory")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenario ids and exit")
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    from traffic_classifier_sdn_tpu.scenarios import (
+        SCENARIOS,
+        build,
+        run_scenario,
+    )
+
+    if args.list:
+        for name, builder in SCENARIOS.items():
+            sc = builder("t1")
+            print(f"{name}: {sc.title}")
+        return
+
+    names = args.scenario or list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        sys.exit(f"unknown scenarios: {unknown} "
+                 f"(known: {sorted(SCENARIOS)})")
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    cards = []
+    for name in names:
+        print(f"running {name} [{args.profile}] ...",
+              file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        card = run_scenario(
+            build(name, args.profile),
+            native=args.native, obs_dir=args.obs_dir,
+        )
+        card["wall_s"] = round(time.perf_counter() - t0, 3)
+        cards.append(card)
+        verdict = "PASS" if card["passed"] else "FAIL"
+        print(f"  {verdict} in {card['wall_s']}s "
+              f"(dominant stage: "
+              f"{card['latency'].get('dominant_stage')})",
+              file=sys.stderr, flush=True)
+
+    out = {
+        "bench": "scenario_matrix",
+        "platform": platform,
+        "profile": args.profile,
+        "scenarios": cards,
+        "passed": all(c["passed"] for c in cards),
+        "gate_failures": [
+            {"scenario": c["scenario"], "gate": g["id"],
+             "value": g["value"], "bound": g["bound"],
+             "detail": g["detail"]}
+            for c in cards
+            for g in c["gates"] if not g["passed"]
+        ],
+    }
+    line = json.dumps(out)
+    print(line)
+    path = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "artifacts", f"scenario_matrix_{platform}.json",
+    )
+    with open(path, "w") as f:
+        f.write(json.dumps(out, indent=1) + "\n")
+    print(f"wrote {path}", file=sys.stderr)
+    if not out["passed"]:
+        fails = ", ".join(
+            f"{f['scenario']}:{f['gate']}" for f in out["gate_failures"]
+        )
+        sys.exit(f"scenario gates FAILED: {fails} "
+                 f"(post-mortems under {args.obs_dir}/)")
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,21 @@ for seed in "${SEEDS[@]}"; do
   done
 done
 
+# scenario campaign group: the composed adversarial timelines
+# (tests/test_scenarios.py — flash crowd, flap storm, reset storm,
+# novel wave, mass eviction, queue flood, device wedge) under the same
+# locktrace witness. Each scenario drives the REAL fan-in pumps ×
+# serve loop × ladder threads, so its schedules double as lock-order
+# evidence; one sweep suffices — the timelines are deterministic on
+# the virtual clock, only thread interleavings vary.
+echo "=== chaos site=scenario (campaign timelines)"
+if ! TCSDN_LOCKTRACE=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_scenarios.py -q \
+    -p no:cacheprovider; then
+  echo "!!! UNRECOVERED: site=scenario" >&2
+  fail=1
+fi
+
 if [ "$fail" -ne 0 ]; then
   echo "chaos matrix: FAILURES (see above)" >&2
   exit 1

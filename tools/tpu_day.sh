@@ -102,6 +102,17 @@ if [ -f tools/bench_openset.py ]; then
   fi
 fi
 
+# adversarial scenario matrix on chip: the campaign timelines scored
+# against their SLO gates with the device in the loop — the TPU twin
+# of scenario_matrix_cpu.json. bench_scenarios.py writes the artifact
+# itself (platform-keyed filename) and exits nonzero on gate failure,
+# so the platform guard rides the artifact name, not a grep.
+if [ -f tools/bench_scenarios.py ]; then
+  run_step 1200 /tmp/tpu_day_scenarios.log python tools/bench_scenarios.py \
+    --platform default --profile cpu \
+    --obs-dir /tmp/tpu_day_scenario_postmortem
+fi
+
 # KNN kernel evidence on chip: the pruned-exact A/B + the IVF recall
 # sweep (tools/bench_knn.py; short kernels — the sweep reuses one warm
 # process). Writes *_cpu.json paths by default; land the chip twins

@@ -133,6 +133,25 @@ def _build_parser() -> argparse.ArgumentParser:
         "deterministic multi-source runs (tests, identity checks)",
     )
     p.add_argument(
+        "--scenario", default=None, metavar="ID",
+        help="replay one adversarial scenario from the campaign "
+        "library (scenarios/library.py) through the real serve "
+        "composition and print its SLO scorecard instead of serving "
+        "live traffic — the post-incident replay hook (e.g. "
+        "--scenario source_flap_storm; 'list' prints the matrix)",
+    )
+    p.add_argument(
+        "--scenario-profile", choices=("t1", "cpu"), default="cpu",
+        help="scenario scale for --scenario replay (default cpu, "
+        "the committed-artifact shape)",
+    )
+    p.add_argument(
+        "--scenario-obs-dir", default="scenario-postmortem",
+        metavar="DIR",
+        help="--scenario gate failures dump their post-mortem bundle "
+        "(flight JSONL + metrics snapshot + timeline manifest) here",
+    )
+    p.add_argument(
         "--monitor-cmd",
         default=None,
         help="override the spawned monitor command (--source ryu or controller; for controller this replaces the built-in OpenFlow controller and --of-port is ignored)",
@@ -2088,7 +2107,9 @@ def main(argv=None) -> None:
     if args.checkpoint_dir is None:
         args.checkpoint_dir = _default_ckpt_dir()
 
-    if args.subcommand == "train":
+    if args.scenario is not None:
+        _run_scenario_replay(args, parser)
+    elif args.subcommand == "train":
         _run_train(args)
     elif args.subcommand == "retrain":
         _run_retrain(args)
@@ -2096,6 +2117,39 @@ def main(argv=None) -> None:
         _run_analyze(args)
     else:
         _run_classify(args)
+
+
+def _run_scenario_replay(args, parser) -> None:
+    """The --scenario replay hook: run one campaign scenario through
+    the real serve composition (scenarios/runner.py) and print its
+    scorecard — same gates, same post-mortem contract as
+    tools/bench_scenarios.py, but addressable from the serving CLI
+    for post-incident replay. Exits nonzero on gate failure."""
+    import json
+
+    from .scenarios import SCENARIOS, build, run_scenario
+
+    if args.scenario == "list":
+        for name, builder in SCENARIOS.items():
+            print(f"{name}: {builder('t1').title}")
+        return
+    if args.scenario not in SCENARIOS:
+        parser.error(
+            f"--scenario: unknown scenario {args.scenario!r} "
+            f"(known: {', '.join(sorted(SCENARIOS))}; "
+            f"'list' prints them)"
+        )
+    card = run_scenario(
+        build(args.scenario, args.scenario_profile),
+        obs_dir=args.scenario_obs_dir,
+    )
+    print(json.dumps(card, indent=1, default=repr))
+    if not card["passed"]:
+        failed = ", ".join(
+            g["id"] for g in card["gates"] if not g["passed"]
+        )
+        sys.exit(f"scenario {args.scenario} FAILED gates: {failed} "
+                 f"(post-mortem under {args.scenario_obs_dir}/)")
 
 
 if __name__ == "__main__":

@@ -63,14 +63,17 @@ class SourceSpec:
     ``kind`` selects the pump: ``cmd`` spawns a monitor command under a
     SupervisedCollector (restart ladder and all), ``capture`` replays a
     recorded monitor capture tick-by-tick, ``synthetic`` generates a
-    flow population (ingest/replay.SyntheticFlows). ``sid`` is the
+    flow population (ingest/replay.SyntheticFlows), ``feed`` pulls each
+    poll tick's wire bytes from a caller-supplied script callable
+    (``feed(tick_index) -> bytes | None`` — the scenario library's
+    timeline seam; raw tiers only). ``sid`` is the
     namespace id folded into every record's flow key — 0 is the legacy
     namespace (records pass through unstamped, byte-compatible with the
     single-collector path). Pull-paced kinds emit every ``interval``
     seconds, or on consumer credits when ``lockstep`` (deterministic
     multi-source runs: one emission per serve tick)."""
 
-    kind: str  # "cmd" | "capture" | "synthetic"
+    kind: str  # "cmd" | "capture" | "synthetic" | "feed"
     sid: int
     name: str = ""
     cmd: str = ""
@@ -82,6 +85,9 @@ class SourceSpec:
     max_restarts: int = 5
     interval: float = 1.0
     lockstep: bool = False
+    # "feed" kind only: per-tick wire-bytes script, compared by identity
+    # (scenario timelines — see traffic_classifier_sdn_tpu/scenarios/)
+    feed: object = None
 
     @property
     def label(self) -> str:
@@ -160,6 +166,11 @@ class FanInQueue:
         self._queued = 0  # records currently queued
         self._drops: dict[int, int] = {}  # sid → records dropped
         self._accepted: dict[int, int] = {}  # sid → records accepted
+        # sid → accepted records later purged at eviction: a purge
+        # re-classifies accepted→dropped, so the per-source accounting
+        # identity the scenario gates check is
+        #   emitted == accepted + (drops − purged)
+        self._purged: dict[int, int] = {}
         # raw-mode framing poison: sources whose BYTE stream lost a
         # chunk (bound drop or eviction purge). Raw chunks can end
         # mid-line, and the consumer's per-source tail carry would
@@ -310,6 +321,7 @@ class FanInQueue:
             if purged:
                 self._queued -= purged
                 self._drops[sid] = self._drops.get(sid, 0) + purged
+                self._purged[sid] = self._purged.get(sid, 0) + purged
                 if purged_bytes:
                     # a restarted incarnation's first chunk must not
                     # splice onto the evicted stream's dangling tail
@@ -335,6 +347,15 @@ class FanInQueue:
     def accepted(self) -> dict[int, int]:
         with self._lock:
             return dict(self._accepted)
+
+    def purged(self) -> dict[int, int]:
+        """sid → records that were ACCEPTED and later purged at
+        eviction (a subset of ``drops()``): subtract these from the
+        drop tally to recover put-time drops, closing the per-source
+        accounting identity ``emitted == accepted + (drops − purged)``
+        the scenario SLO gates assert."""
+        with self._lock:
+            return dict(self._purged)
 
 
 class RawTick(list):
@@ -382,6 +403,7 @@ class SourceWorker:
         self._clean = False
         self._killed = False
         self._records = 0
+        self._emitted = 0  # records handed to the queue (accepted OR dropped)
         self._ticks = 0
         self._restarts = 0
         self._last_put_at: float | None = None
@@ -449,6 +471,7 @@ class SourceWorker:
             state = self._state
             clean = self._clean
             records = self._records
+            emitted = self._emitted
             ticks = self._ticks
             restarts = self._restarts
             last = self._last_put_at
@@ -459,6 +482,7 @@ class SourceWorker:
             "state": state,
             "clean": clean,
             "records": records,
+            "emitted": emitted,
             "ticks": ticks,
             "restarts": restarts,
             "lag_s": (
@@ -497,6 +521,8 @@ class SourceWorker:
             return self._pump_capture()
         if self.spec.kind == "synthetic":
             return self._pump_synthetic()
+        if self.spec.kind == "feed":
+            return self._pump_feed()
         raise ValueError(f"unknown source kind {self.spec.kind!r}")
 
     def _deliver(self, records: list) -> None:
@@ -524,6 +550,7 @@ class SourceWorker:
         ok = self._queue.put(sid, records)
         with self._state_lock:
             self._ticks += 1
+            self._emitted += len(records)
             if ok:
                 self._records += len(records)
                 self._last_put_at = self._clock()
@@ -540,6 +567,7 @@ class SourceWorker:
         ok = self._queue.put_bytes(sid, data, n_records, emit)
         with self._state_lock:
             self._ticks += 1
+            self._emitted += n_records
             if ok:
                 self._records += n_records
                 self._last_put_at = self._clock()
@@ -605,6 +633,38 @@ class SourceWorker:
                 self._deliver_raw(data, data.count(b"\n"))
             else:
                 self._deliver(syn.tick())
+            i += 1
+        return True
+
+    def _pump_feed(self) -> bool:
+        """Scripted wire-bytes source (scenario timelines): each poll
+        tick hands the queue whatever ``spec.feed(tick_index)`` renders.
+        ``None`` ends the stream (a clean death); ``b""`` is a silent
+        tick — the pump delivers the one-newline noise line so a
+        lockstep consumer still sees this source's batch for the tick
+        (the parsers drop non-telemetry lines for free, and the queue
+        counts the line as one emitted record, keeping the accounting
+        identity exact). Raw tiers only: the script renders wire bytes,
+        there is no record-object path to fall back to."""
+        if not self._raw:
+            raise ValueError(
+                "feed sources render wire bytes — the fan-in tier must "
+                "run raw (native ingest)"
+            )
+        gen = self.spec.feed
+        if gen is None:
+            raise ValueError("feed source needs spec.feed callable")
+        i = 0
+        while self.spec.max_ticks <= 0 or i < self.spec.max_ticks:
+            if not self._pace(first=i == 0):
+                return True
+            fault_point("ingest.source_dead")
+            data = gen(i)
+            if data is None:
+                return True  # script exhausted — clean end of stream
+            if not data:
+                data = b"\n"  # silent tick: one free-to-parse noise line
+            self._deliver_raw(data, max(1, data.count(b"\n")))
             i += 1
         return True
 
@@ -676,7 +736,8 @@ class FanInIngest:
     def __init__(self, specs, queue_records: int = 1 << 16,
                  quarantine_s: float = 5.0, metrics=None, recorder=None,
                  clock=time.monotonic, stamp: bool = False,
-                 prov_clock=time.perf_counter, raw: bool = False):
+                 prov_clock=time.perf_counter, raw: bool = False,
+                 max_flaps: int = 5, flap_window_s: float = 60.0):
         specs = list(specs)
         sids = [s.sid for s in specs]
         if len(set(sids)) != len(sids):
@@ -717,6 +778,24 @@ class FanInIngest:
         self._quarantine: dict[int, float] = {}  # sid → evict deadline
         self._dead_seen: set[int] = set()
         self._started = False
+        # Flap escalation: a source flapping faster than quarantine_s
+        # used to repeatedly cancel its pending quarantine via
+        # restart_source — dying, restarting, dying again forever,
+        # holding a namespace that never serves AND never evicts. After
+        # ``max_flaps`` unclean deaths inside ``flap_window_s`` the sid
+        # ESCALATES: further restarts are refused (unless forced), the
+        # pending quarantine runs to completion, and the namespace
+        # finally evicts. max_flaps=0 disables escalation.
+        self.max_flaps = int(max_flaps)
+        self.flap_window_s = float(flap_window_s)
+        self._flap_times: dict[int, deque] = {}  # sid → unclean-death ts
+        self._flaps: dict[int, int] = {}  # sid → lifetime unclean deaths
+        self._escalated: set[int] = set()
+        # records emitted by PRIOR incarnations of each sid: a restart
+        # swaps in a fresh worker (emitted=0), but the accounting
+        # identity emitted == accepted + (drops − purged) spans the
+        # namespace's whole lifetime, so the roster folds this back in
+        self._emitted_base: dict[int, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -743,17 +822,39 @@ class FanInIngest:
             w = self._workers[sid]
         w.kill()
 
-    def restart_source(self, sid: int) -> None:
+    def restart_source(self, sid: int, *, force: bool = False) -> bool:
         """Re-register a dead source into its OLD namespace: a fresh
         worker under the same source id produces the same flow keys, so
         its flows resume in their existing slots (cumulative counters →
         one large first delta, the supervisor-restart story). A pending
         quarantine is cancelled — the namespace is live again, evicting
-        it would throw away state the restart just reclaimed."""
+        it would throw away state the restart just reclaimed.
+
+        A flap-ESCALATED sid is refused (returns False, recorded as
+        ``fanin.restart_refused``): cancelling its quarantine yet again
+        is exactly the livelock escalation exists to break. ``force``
+        is the operator override — it clears the escalation and the
+        flap window, then restarts normally."""
+        with self._roster_lock:
+            escalated = sid in self._escalated
+            if escalated and force:
+                self._escalated.discard(sid)
+                self._flap_times.pop(sid, None)
+                escalated = False
+        if escalated:
+            if self._recorder is not None:
+                self._recorder.record(
+                    "fanin.restart_refused", source=sid,
+                    cause="flap_escalated",
+                )
+            if self._metrics is not None:
+                self._metrics.inc("source_restarts_refused")
+            return False
         with self._roster_lock:
             old = self._workers[sid]
         old.stop()
         old.join(timeout=5.0)
+        emitted = old.snapshot()["emitted"]
         fresh = SourceWorker(
             old.spec, self.queue, metrics=self._metrics,
             recorder=self._recorder, clock=self._clock,
@@ -764,6 +865,9 @@ class FanInIngest:
             self._quarantine.pop(sid, None)
             self._dead_seen.discard(sid)
             self._workers[sid] = fresh
+            self._emitted_base[sid] = (
+                self._emitted_base.get(sid, 0) + emitted
+            )
             started = self._started
         if self.raw:
             # a restart can land BEFORE the quarantine evicts (it
@@ -779,6 +883,7 @@ class FanInIngest:
             self._metrics.inc("source_restarts")
         if started:
             fresh.start()
+        return True
 
     # -- supervision -------------------------------------------------------
     def _supervise(self) -> None:
@@ -791,11 +896,26 @@ class FanInIngest:
             if not w.dead_unclean:
                 continue
             sid = w.spec.sid
+            escalate = False
+            flaps = 0
             with self._roster_lock:
                 fresh = sid not in self._dead_seen
                 if fresh:
                     self._dead_seen.add(sid)
                     self._quarantine[sid] = now + self.quarantine_s
+                    # flap bookkeeping: every fresh unclean death is one
+                    # flap; escalate once the windowed count hits the cap
+                    self._flaps[sid] = self._flaps.get(sid, 0) + 1
+                    flaps = self._flaps[sid]
+                    if self.max_flaps > 0:
+                        window = self._flap_times.setdefault(sid, deque())
+                        window.append(now)
+                        while window and window[0] < now - self.flap_window_s:
+                            window.popleft()
+                        if (len(window) >= self.max_flaps
+                                and sid not in self._escalated):
+                            self._escalated.add(sid)
+                            escalate = True
             if fresh:
                 if self._metrics is not None:
                     self._metrics.inc("source_deaths")
@@ -804,6 +924,15 @@ class FanInIngest:
                         "fanin.source_dead", source=sid,
                         name=w.spec.label,
                         quarantine_s=self.quarantine_s,
+                    )
+            if escalate:
+                if self._metrics is not None:
+                    self._metrics.inc("source_flap_escalations")
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "fanin.flap_escalated", source=sid,
+                        flaps=flaps, window_s=self.flap_window_s,
+                        max_flaps=self.max_flaps,
                     )
 
     def take_evictions(self) -> list[int]:
@@ -940,10 +1069,16 @@ class FanInIngest:
                 self._workers.values(), key=lambda w: w.spec.sid
             )
             quarantine = dict(self._quarantine)
+            flaps = dict(self._flaps)
+            escalated = set(self._escalated)
+            emitted_base = dict(self._emitted_base)
         out = []
         for w in workers:
             snap = w.snapshot()
             snap["drops"] = drops.get(w.spec.sid, 0)
+            snap["emitted"] += emitted_base.get(w.spec.sid, 0)
+            snap["flaps"] = flaps.get(w.spec.sid, 0)
+            snap["escalated"] = w.spec.sid in escalated
             q = quarantine.get(w.spec.sid)
             if q is not None:
                 snap["quarantine_expires_s"] = round(max(0.0, q - now), 3)
@@ -966,6 +1101,7 @@ class FanInIngest:
             sid = r["id"]
             m.set(f"source_{sid}_state", _STATE_CODE[r["state"]])
             m.set(f"source_{sid}_drops", r["drops"])
+            m.set(f"source_{sid}_flaps", r["flaps"])
             total_drops += r["drops"]
             if r["lag_s"] is not None:
                 m.set(f"source_{sid}_lag_s", r["lag_s"])

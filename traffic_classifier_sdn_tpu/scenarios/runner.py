@@ -1,0 +1,427 @@
+"""The scenario campaign runner: drive a declarative timeline through
+the REAL serve composition and score it against its SLO gates.
+
+This is deliberately NOT a simulation harness: each scenario runs the
+same objects the ``serve`` command composes — a raw-mode
+:class:`~traffic_classifier_sdn_tpu.ingest.fanin.FanInIngest` tier
+(native-ingest byte pumps, lockstep-paced), a
+:class:`~traffic_classifier_sdn_tpu.ingest.batcher.FlowStateEngine`
+(C++ spine when built, Python fallback otherwise),
+the degrade ladder / open-set gate / incremental label cache exactly
+as ``cli.py`` stacks them, the latency-provenance waterfall
+(obs/latency.py), and the flight recorder + metrics planes the gates
+read. The tick drive order mirrors the CLI serial loop byte for byte:
+``mark_tick → ingest_bytes per (sid, batch) → mark_parse → step →
+mark_scatter → evict dead namespaces → idle evict → labels → seal →
+mark_device → render_sample → render_visible``.
+
+Determinism: the fan-in tier (and the degrade ladder, when armed) run
+on a VIRTUAL clock the runner advances ``clock_step_s`` per tick —
+quarantine deadlines, flap windows and probe schedules are measured in
+ticks, so the tier-1 scenario tests sleep for nothing. Real wall time
+still drives the cadence and e2e gates (those SLOs are real-time
+phenomena by definition).
+
+Gate failures record a ``scenario.gate_breach`` ring event per failed
+gate and, when ``obs_dir`` is set, write an atomic post-mortem bundle
+named by scenario id: the flight-recorder JSONL dump + a metrics
+snapshot (the PR 3 / PR 11 dump paths, obs/flight_recorder.py) + a
+manifest carrying the timeline position the run ended at.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..ingest.batcher import FlowStateEngine
+from ..ingest.fanin import FanInIngest
+from ..obs.flight_recorder import FlightRecorder, dump_metrics_snapshot
+from ..obs.latency import LatencyProvenance
+from ..utils import faults
+from ..utils.atomicio import atomic_write_bytes
+from ..utils.metrics import Metrics
+from .timeline import Scenario
+
+# the flight-recorder kinds the scorecard's transition trace keeps —
+# the state machines the gates watch, not the whole ring
+_TRACE_KINDS = (
+    "scenario.phase",
+    "fanin.source_dead",
+    "fanin.source_restart",
+    "fanin.flap_escalated",
+    "fanin.restart_refused",
+    "fanin.drop",
+    "degrade.transition",
+    "drift.transition",
+    "openset.reject",
+    "latency.slo_breach",
+    "fault.fire",
+)
+
+
+@dataclass
+class RunContext:
+    """Everything a gate or a scheduled action can reach: the live
+    serve objects plus the run's collected observations (``obs``)."""
+
+    scenario: Scenario
+    tier: FanInIngest
+    engine: FlowStateEngine
+    metrics: Metrics
+    recorder: FlightRecorder
+    lat: LatencyProvenance
+    inc: object = None
+    openset: object = None
+    degrade: object = None
+    n_classes: int = 4
+    tick: int = 0
+    phase: int = 0
+    vclock: dict = field(default_factory=lambda: {"t": 0.0})
+    obs: dict = field(default_factory=dict)
+
+    # -- scheduled-action ops (the library's timeline verbs) ---------------
+    def kill(self, sid: int) -> None:
+        """Unclean-kill one source and register the death NOW (at this
+        tick's virtual time): kill, join the pump, run one supervision
+        pass — the flap clock starts at a deterministic tick instead
+        of whenever the serve thread next polls."""
+        self.tier.kill_source(sid)
+        with self.tier._roster_lock:
+            w = self.tier._workers[sid]
+        w.join(timeout=5.0)
+        self.tier._supervise()
+
+    def restart(self, sid: int, force: bool = False) -> bool:
+        ok = self.tier.restart_source(sid, force=force)
+        if not ok:
+            self.obs["restarts_refused"] = (
+                self.obs.get("restarts_refused", 0) + 1
+            )
+        return ok
+
+
+def _build_model(n_classes: int):
+    """The serve composition's model: a synthetic GNB (the cheapest
+    full-table family — scenario gates exercise the serve machinery,
+    not model accuracy; the open-set tier is feature-space and does
+    not consult the model at all)."""
+    from ..models import gnb, jit_serving_fn
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy(
+        {
+            "theta": rng.gamma(2.0, 100.0, (n_classes, 12)),
+            "var": rng.gamma(2.0, 50.0, (n_classes, 12)) + 1.0,
+            "class_prior": np.full(n_classes, 1.0 / n_classes, dtype=np.float64),
+        }
+    )
+    return jit_serving_fn(gnb.predict), params
+
+
+def _compose_serve(sc: Scenario, m: Metrics, recorder: FlightRecorder,
+                   engine: FlowStateEngine, vclock) -> tuple:
+    """Stack the serving ladders exactly as cli.py does: degrade
+    innermost (wrapping the device predict), open-set outermost, the
+    incremental label cache around the whole composition."""
+    predict, params = _build_model(sc.n_classes)
+    degrade = None
+    if sc.degrade is not None:
+        from ..models import resolve_fallback
+        from ..serving.degrade import DegradeLadder
+
+        degrade = DegradeLadder(
+            predict, resolve_fallback("gnb", params),
+            deadline=float(sc.degrade.get("deadline", 2.0)),
+            probe_every=float(sc.degrade.get("probe_every", 2.0)),
+            probe_successes=int(sc.degrade.get("probe_successes", 2)),
+            metrics=m, recorder=recorder,
+            clock=(lambda: vclock["t"]),
+            rng=random.Random(sc.fault_seed),
+        )
+        predict = degrade
+    openset = None
+    if sc.openset is not None:
+        from ..serving.openset import OpenSetGate
+
+        openset = OpenSetGate(
+            predict, n_classes=sc.n_classes,
+            margin=float(sc.openset.get("margin", 3.0)),
+            calibration_rows=int(
+                sc.openset.get("calibration_rows", 256)
+            ),
+            metrics=m, recorder=recorder,
+        )
+        predict = openset
+    from ..serving.incremental import IncrementalLabels
+
+    inc = IncrementalLabels(
+        engine, predict, params, degrade=degrade,
+        metrics=m, recorder=recorder,
+    )
+    return inc, openset, degrade
+
+
+def run_scenario(sc: Scenario, *, native: str = "auto",
+                 obs_dir: str | None = None) -> dict:
+    """Run one scenario timeline through the real serve loop; returns
+    its scorecard dict (``passed``, per-gate results, latency status,
+    transition trace). See the module docstring for the drive order
+    and the post-mortem contract."""
+    import jax
+
+    from ..native import engine as native_engine
+
+    use_native = (
+        native == "on"
+        or (native == "auto" and native_engine.available())
+    )
+    m = Metrics()
+    recorder = FlightRecorder(capacity=8192)
+    vclock = {"t": 0.0}
+    clock = time.monotonic if sc.real_clock else (lambda: vclock["t"])
+    tier = FanInIngest(
+        sc.sources, queue_records=sc.queue_records,
+        quarantine_s=sc.quarantine_s, metrics=m, recorder=recorder,
+        clock=clock, stamp=True, raw=True,
+        max_flaps=sc.max_flaps, flap_window_s=sc.flap_window_s,
+    )
+    engine = FlowStateEngine(
+        sc.capacity, native=use_native, track_dirty=True,
+    )
+    lat = LatencyProvenance(m, recorder, slo_s=sc.e2e_slo_s)
+    inc, openset, degrade = _compose_serve(
+        sc, m, recorder, engine, vclock,
+    )
+    ctx = RunContext(
+        scenario=sc, tier=tier, engine=engine, metrics=m,
+        recorder=recorder, lat=lat, inc=inc, openset=openset,
+        degrade=degrade, n_classes=sc.n_classes, vclock=vclock,
+    )
+    ctx.obs["tick_wall_s"] = []
+    ctx.obs["evicted_slots"] = 0
+    ctx.obs["evicted_sids"] = set()
+    plan = faults.FaultPlan(
+        [faults.FaultRule(**r) for r in sc.fault_rules],
+        seed=sc.fault_seed,
+    )
+    labels = None
+    # Warm the jit cache OUTSIDE the timeline: the composed predict
+    # compiles for (capacity, 12) on first use, and the incremental
+    # dirty-update path compiles separately on its first non-full
+    # sweep — without this, tick 0's cadence/e2e samples would measure
+    # XLA, not the scenario. Runs before faults install, so it
+    # consumes no fault-rule `after` budget. The traffic half drives a
+    # throwaway namespace (sid 63) through ingest → step → labels
+    # twice (full path, then dirty path) and evicts it; it is SKIPPED
+    # when the scenario arms the open-set tier, whose calibration
+    # would otherwise consume the throwaway rows (openset scenarios
+    # do not gate e2e, so the one-off compile there is harmless).
+    jax.block_until_ready(inc.labels())
+    if sc.openset is None:
+        from ..ingest.replay import SyntheticFlows
+
+        warm_gen = SyntheticFlows(4, seed=99, mac_base=1 << 40)
+        for _ in range(2):
+            engine.mark_tick()
+            engine.ingest_bytes(warm_gen.tick_bytes(), 63)
+            engine.step()
+            jax.block_until_ready(inc.labels())
+        engine.evict_source(63)
+        inc.invalidate("scenario-warmup")
+        jax.block_until_ready(inc.labels())
+    tier.start()
+    gen = tier.ticks(tick_timeout=sc.tick_timeout, poll_s=0.005)
+    try:
+        with faults.installed(plan), recorder.observing_faults():
+            for tick in range(sc.total_ticks):
+                ctx.tick = tick
+                phase_idx, phase = sc.phase_at(tick)
+                if phase_idx != ctx.phase or tick == 0:
+                    ctx.phase = phase_idx
+                    m.set("scenario_phase", phase_idx)
+                    recorder.record(
+                        "scenario.phase", scenario=sc.id, tick=tick,
+                        phase=phase.name, index=phase_idx,
+                    )
+                for action in sc.actions.get(tick, ()):
+                    action(ctx)
+                t0 = time.perf_counter()
+                batch = next(gen, None)
+                if batch is None:
+                    break  # every source ended and the queue drained
+                lat.begin_tick(tier.pop_provenance())
+                engine.mark_tick()
+                n_rec = sum(
+                    engine.ingest_bytes(data, sid)
+                    for sid, data in batch
+                )
+                m.inc("records", n_rec)
+                lat.mark_parse()
+                engine.step()
+                lat.mark_scatter()
+                for sid in tier.take_evictions():
+                    ctx.obs["evicted_sids"].add(sid)
+                    n = engine.evict_source(sid)
+                    ctx.obs["evicted_slots"] += n
+                    m.inc("evicted", n)
+                    lat.drop_source(sid)
+                    if inc is not None and n:
+                        inc.invalidate(f"evict-source-{sid}")
+                if sc.idle_evict_s is not None and engine.last_time:
+                    n = engine.evict_idle(
+                        engine.last_time, sc.idle_evict_s,
+                    )
+                    ctx.obs["evicted_slots"] += n
+                    m.inc("evicted", n)
+                    if inc is not None and n:
+                        inc.invalidate("idle-evict")
+                seal = lat.seal()
+                labels = inc.labels()
+                jax.block_until_ready(labels)
+                lat.mark_device(seal)
+                engine.render_sample(labels, sc.table_rows)
+                lat.render_visible(seal)
+                ctx.obs["tick_wall_s"].append(
+                    time.perf_counter() - t0
+                )
+                vclock["t"] += sc.clock_step_s
+    finally:
+        gen.close()
+        tier.stop()
+        if degrade is not None:
+            degrade.close()
+    # final-state observations the ground-truth gates read: per-MAC
+    # labels from the last tick's full label vector (capacities here
+    # are scenario-sized — the full fetch the 2²⁰ serve avoids is
+    # fine). One slot per conversation: both endpoints carry its label.
+    mac_labels: dict = {}
+    if labels is not None:
+        lab = np.asarray(labels)
+        for slot, (src, dst) in engine.slot_metadata().items():
+            if slot < lab.shape[0]:
+                mac_labels[src] = int(lab[slot])
+                mac_labels[dst] = int(lab[slot])
+    ctx.obs["mac_labels"] = mac_labels
+    results = [g.evaluate(ctx) for g in sc.gates]
+    passed = all(r.passed for r in results)
+    card = {
+        "scenario": sc.id,
+        "title": sc.title,
+        "passed": passed,
+        "ticks_run": len(ctx.obs["tick_wall_s"]),
+        "phases": [
+            {"name": p.name, "ticks": p.ticks} for p in sc.phases
+        ],
+        "gates": [r.as_dict() for r in results],
+        "latency": lat.status(),
+        "flows": engine.num_flows(),
+        "records": int(m.counters.get("records", 0)),
+        "parse_errors": engine.parse_errors(),
+        "evicted_slots": int(ctx.obs["evicted_slots"]),
+        "transitions": _transition_trace(recorder),
+        "engine": "native" if use_native else "python",
+    }
+    if not passed:
+        for r in results:
+            if not r.passed:
+                recorder.record(
+                    "scenario.gate_breach", scenario=sc.id,
+                    gate=r.id, value=r.value, bound=r.bound,
+                    detail=r.detail,
+                )
+        if obs_dir:
+            card["post_mortem"] = _dump_post_mortem(
+                sc, ctx, m, recorder, results, obs_dir,
+            )
+    return card
+
+
+def _transition_trace(recorder: FlightRecorder) -> list[dict]:
+    """The scorecard's compact state-machine trace: only the watched
+    kinds, only the fields that tell the story."""
+    out = []
+    for e in recorder.tail(4096):
+        if e.get("kind") not in _TRACE_KINDS:
+            continue
+        row = {
+            k: v for k, v in e.items()
+            if k not in ("ts",)
+        }
+        out.append(row)
+    return out
+
+
+def _dump_post_mortem(sc: Scenario, ctx: RunContext, m: Metrics,
+                      recorder: FlightRecorder, results,
+                      obs_dir: str) -> dict:
+    """The satellite-2 contract: a gate failure leaves an atomic
+    bundle named by scenario id — flight-recorder JSONL + metrics
+    snapshot (the PR 3/PR 11 dump paths) + a manifest carrying the
+    timeline position. Forensics must never become a second failure:
+    each piece is attempted independently and the manifest records
+    what landed."""
+    reason = f"scenario-{sc.id}"
+    bundle: dict = {"scenario": sc.id}
+    try:
+        bundle["flight"] = recorder.dump(obs_dir, reason)
+    except OSError as e:
+        bundle["flight_error"] = str(e)
+    try:
+        bundle["metrics"] = dump_metrics_snapshot(m, obs_dir, reason)
+    except OSError as e:
+        bundle["metrics_error"] = str(e)
+    phase_idx, phase = sc.phase_at(max(0, ctx.tick))
+    manifest = {
+        "scenario": sc.id,
+        "title": sc.title,
+        "timeline_position": {
+            "tick": ctx.tick,
+            "total_ticks": sc.total_ticks,
+            "phase": phase.name,
+            "phase_index": phase_idx,
+        },
+        "failed_gates": [
+            r.as_dict() for r in results if not r.passed
+        ],
+        "flight": bundle.get("flight"),
+        "metrics": bundle.get("metrics"),
+    }
+    path = os.path.join(obs_dir, f"scenario-{sc.id}-postmortem.json")
+    try:
+        os.makedirs(obs_dir, exist_ok=True)
+        atomic_write_bytes(
+            path, json.dumps(manifest, indent=2).encode(),
+        )
+        bundle["manifest"] = path
+    except OSError as e:
+        bundle["manifest_error"] = str(e)
+    return bundle
+
+
+def run_campaign(scenarios, *, native: str = "auto",
+                 obs_dir: str | None = None,
+                 platform: str = "cpu") -> dict:
+    """Run a scenario list and fold the scorecards into the campaign
+    matrix (the ``scenario_matrix_<platform>.json`` artifact shape).
+    ``passed`` is the conjunction — the matrix is a gate, not a
+    report (tools/bench_scenarios.py exits nonzero on it)."""
+    cards = [
+        run_scenario(sc, native=native, obs_dir=obs_dir)
+        for sc in scenarios
+    ]
+    return {
+        "platform": platform,
+        "scenarios": cards,
+        "passed": all(c["passed"] for c in cards),
+        "gate_failures": [
+            {"scenario": c["scenario"], "gate": g["id"]}
+            for c in cards
+            for g in c["gates"] if not g["passed"]
+        ],
+    }
