@@ -81,6 +81,55 @@ def _locktrace_witness(request):
     )
 
 
+# The syncguard runtime witness (utils/syncguard.py) rides the suites
+# whose tests drive the serve hot paths — pipeline, incremental,
+# degrade, drift, openset — cross-checking every observed host↔device
+# sync against the static budget artifact by call site (the dynamic
+# half of analysis_static/graftsync.py). TCSDN_SYNCGUARD=1
+# (tools/chaos_matrix.sh sets it) widens it to every test module.
+SYNCGUARD_SUITES = {
+    "test_pipeline", "test_incremental", "test_degrade", "test_drift",
+    "test_openset",
+}
+
+
+@pytest.fixture(autouse=True)
+def _syncguard_witness(request):
+    name = request.module.__name__.rsplit(".", 1)[-1]
+    if name == "test_syncguard":
+        # the witness's own suite installs/uninstalls per test; a
+        # fixture-held install would make those installs collide
+        yield None
+        return
+    if (
+        name not in SYNCGUARD_SUITES
+        and os.environ.get("TCSDN_SYNCGUARD") != "1"
+    ):
+        yield None
+        return
+    from traffic_classifier_sdn_tpu.utils import syncguard
+
+    if syncguard._installed is not None:  # a test drives its own witness
+        yield None
+        return
+    budget = syncguard.load_budget()
+    with syncguard.guarding(budget=budget) as witness:
+        yield witness
+    report_path = os.environ.get("TCSDN_SYNCGUARD_REPORT")
+    if report_path:
+        # land the observed-sync evidence BEFORE the assert so a
+        # violating run still writes its postmortem counts
+        syncguard.append_report(witness, report_path)
+    violations = witness.violations
+    assert not violations, (
+        "hot-path syncs outside the static budget observed at "
+        "runtime:\n" + "\n".join(
+            f"  {v['kind']} at {v['site']} (thread {v['thread']})"
+            for v in violations
+        )
+    )
+
+
 @pytest.fixture(scope="session")
 def reference_models_dir():
     path = os.path.join(REFERENCE_ROOT, "models")

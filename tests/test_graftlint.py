@@ -1311,6 +1311,288 @@ def test_atomic_io_exempts_atomicio_module(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# graftsync: implicit-sync / transfer-discipline / donation-hazard /
+# sync-under-lock (the device-boundary pass)
+# ---------------------------------------------------------------------------
+
+IMPLICIT_SYNC_INTERPROCEDURAL = """
+    import numpy as np
+    import jax
+
+
+    def _render(x):
+        return np.asarray(x)
+
+
+    def serve_tick(x: jax.Array):
+        return _render(x)
+"""
+
+IMPLICIT_SYNC_COLD = """
+    import numpy as np
+    import jax
+
+
+    def _render(x):
+        return np.asarray(x)
+
+
+    def warmup(x: jax.Array):
+        return _render(x)
+"""
+
+DONATION_HAZARD_ALIAS = """
+    import jax
+
+
+    def _step(b):
+        return b + 1
+
+
+    step_jit = jax.jit(_step, donate_argnums=(0,))
+
+
+    def serve_tick(x: jax.Array):
+        buf = x
+        out = step_jit(buf)
+        return out + buf
+"""
+
+DONATION_HAZARD_REBOUND = """
+    import jax
+
+
+    def _step(b):
+        return b + 1
+
+
+    step_jit = jax.jit(_step, donate_argnums=(0,))
+
+
+    def serve_tick(x: jax.Array):
+        buf = x
+        buf = step_jit(buf)
+        return buf + 1
+"""
+
+SYNC_UNDER_LOCK_COMPOSED = """
+    import threading
+
+    import numpy as np
+    import jax
+
+
+    class Table:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def serve_tick(self, x: jax.Array):
+            with self._lock:
+                return self._drain(x)
+
+        def _drain(self, x):
+            return int(np.asarray(x).sum())
+"""
+
+SYNC_OUTSIDE_LOCK = """
+    import threading
+
+    import numpy as np
+    import jax
+
+
+    class Table:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def serve_tick(self, x: jax.Array):
+            host = np.asarray(x)  # graftlint: disable=implicit-sync -- render-sync: test seam
+            with self._lock:
+                return int(host.sum())
+"""
+
+TRANSFER_DISCIPLINE_MIXED = """
+    import numpy as np
+    import jax.numpy as jnp
+
+
+    def serve_tick(vals):
+        return jnp.asarray(np.float64(vals))
+
+
+    def warmup(vals):
+        return jnp.asarray(np.float64(vals))
+"""
+
+
+def _lint_file(tmp_path, source, filename="snippet.py"):
+    path = tmp_path / filename
+    path.write_text(textwrap.dedent(source), encoding="utf-8")
+    return lint_paths([str(path)])
+
+
+def test_implicit_sync_fires_through_helper(tmp_path):
+    # the sync lives in a helper the hot root reaches via a call edge:
+    # the finding lands on the helper's np.asarray, with the hot chain
+    # (serve_tick -> _render) in the message
+    findings = _lint_file(tmp_path, IMPLICIT_SYNC_INTERPROCEDURAL)
+    assert [f.rule for f in findings] == ["implicit-sync"]
+    assert "np.asarray" in findings[0].message
+    assert "serve_tick" in findings[0].message
+    assert "_render" in findings[0].message
+
+
+def test_implicit_sync_cold_path_is_free(tmp_path):
+    # same sync, but only reachable from a cold function: no finding
+    assert _lint_file(tmp_path, IMPLICIT_SYNC_COLD) == []
+
+
+def test_implicit_sync_suppression_must_name_discipline(tmp_path):
+    suppressed = IMPLICIT_SYNC_INTERPROCEDURAL.replace(
+        "return np.asarray(x)",
+        "return np.asarray(x)  # graftlint: disable=implicit-sync "
+        "-- render-sync: test seam",
+    )
+    assert _lint_file(tmp_path, suppressed) == []
+    # a reasoned suppression that names NO deferral discipline is a
+    # bad-suppression finding — and bad-suppression cannot itself be
+    # suppressed, so the allowlist can't be quietly watered down
+    undisciplined = IMPLICIT_SYNC_INTERPROCEDURAL.replace(
+        "return np.asarray(x)",
+        "return np.asarray(x)  # graftlint: disable=implicit-sync "
+        "-- reviewer said it's fine",
+    )
+    findings = _lint_file(tmp_path, undisciplined)
+    assert [f.rule for f in findings] == [BAD_SUPPRESSION]
+    assert "discipline" in findings[0].message
+
+
+def test_donation_hazard_fires_through_alias(tmp_path):
+    # x aliased to buf, buf donated, then buf referenced again
+    findings = _lint_file(tmp_path, DONATION_HAZARD_ALIAS)
+    assert [f.rule for f in findings] == ["donation-hazard"]
+    assert "'buf'" in findings[0].message
+    assert "step_jit" in findings[0].message
+
+
+def test_donation_hazard_rebind_idiom_is_clean(tmp_path):
+    # buf = donated_fn(buf) rebinds the name to the result: clean
+    assert _lint_file(tmp_path, DONATION_HAZARD_REBOUND) == []
+
+
+def test_sync_under_lock_composes_with_graftlock(tmp_path):
+    # the lock is a real graftlock lock class (constructed in
+    # __init__); the sync is one call edge away — the rule composes
+    # graftlock's held-lock summaries with the sync summaries and
+    # renders the full chain
+    findings = _lint_file(tmp_path, SYNC_UNDER_LOCK_COMPOSED)
+    by_rule = {f.rule for f in findings}
+    assert "sync-under-lock" in by_rule
+    sul = next(f for f in findings if f.rule == "sync-under-lock")
+    assert "Table._lock" in sul.message
+    assert "_drain" in sul.message
+
+
+def test_sync_outside_lock_is_clean(tmp_path):
+    # snapshot-outside-the-lock idiom: no sync-under-lock finding
+    # (the sync itself carries its reasoned allowlist entry)
+    assert _lint_file(tmp_path, SYNC_OUTSIDE_LOCK) == []
+
+
+def test_transfer_discipline_hot_only(tmp_path):
+    # identical upload in a hot root and a cold function: exactly one
+    # finding, on the hot one
+    findings = _lint_file(tmp_path, TRANSFER_DISCIPLINE_MIXED)
+    assert [f.rule for f in findings] == ["transfer-discipline"]
+    assert "serve_tick" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# ctypes-abi: cross-language prototype checking
+# ---------------------------------------------------------------------------
+
+CROSS_LANG_CPP = """
+    #include <cstdint>
+
+    static int helper(int x) { return x; }
+
+    extern "C" {
+
+    void tc_fill(int32_t* dst, uint64_t n, float scale) {
+        (void)dst; (void)n; (void)scale;
+    }
+
+    uint64_t tc_count(void* handle) {
+        (void)handle;
+        return 0;
+    }
+
+    }
+"""
+
+CROSS_LANG_PY_MISMATCH = """
+    import ctypes as ct
+
+    lib = ct.CDLL("libnative.so")
+    lib.tc_fill.argtypes = [ct.POINTER(ct.c_int32), ct.c_uint64]
+    lib.tc_fill.restype = None
+    lib.tc_count.argtypes = [ct.c_void_p]
+    lib.tc_count.restype = ct.c_uint32
+
+    def go():
+        lib.tc_fill(None, 0)
+        return lib.tc_count(None)
+"""
+
+CROSS_LANG_PY_CLEAN = """
+    import ctypes as ct
+
+    lib = ct.CDLL("libnative.so")
+    lib.tc_fill.argtypes = [ct.POINTER(ct.c_int32), ct.c_uint64,
+                            ct.c_float]
+    lib.tc_fill.restype = None
+    lib.tc_count.argtypes = [ct.c_void_p]
+    lib.tc_count.restype = ct.c_uint64
+
+    def go():
+        lib.tc_fill(None, 0, 1.0)
+        return lib.tc_count(None)
+"""
+
+
+def _write_cross_lang(tmp_path, py_source):
+    (tmp_path / "native.cpp").write_text(
+        textwrap.dedent(CROSS_LANG_CPP), encoding="utf-8"
+    )
+    return run_rule(tmp_path, CtypesAbiRule, py_source,
+                    filename="engine.py")
+
+
+def test_ctypes_cross_language_mismatch(tmp_path):
+    findings = _write_cross_lang(tmp_path, CROSS_LANG_PY_MISMATCH)
+    msgs = "\n".join(f.message for f in findings)
+    # arity drift (2 declared vs 3 defined) AND a restype width
+    # mismatch (uint64_t returned, c_uint32 declared) both fire
+    assert any("tc_fill" in f.message for f in findings), msgs
+    assert any("tc_count" in f.message for f in findings), msgs
+    assert len(findings) == 2, msgs
+
+
+def test_ctypes_cross_language_clean(tmp_path):
+    assert _write_cross_lang(tmp_path, CROSS_LANG_PY_CLEAN) == []
+
+
+def test_ctypes_cross_language_absent_cpp_still_checks_python_side(
+    tmp_path,
+):
+    # no sibling .cpp: the rule still enforces prototypes exist, but
+    # makes no cross-language claims
+    findings = run_rule(tmp_path, CtypesAbiRule, CROSS_LANG_PY_MISMATCH,
+                        filename="engine.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # suppression round-trip
 # ---------------------------------------------------------------------------
 
@@ -1479,5 +1761,7 @@ def test_every_rule_has_fixture_coverage():
         "jit-purity", "retrace-hazard", "ctypes-abi", "lock-discipline",
         "fault-site-registry", "atomic-io",
         "lock-order", "blocking-under-lock", "thread-lifecycle",
+        "implicit-sync", "transfer-discipline", "donation-hazard",
+        "sync-under-lock",
     }
     assert {cls.id for cls in ALL_RULES} == covered

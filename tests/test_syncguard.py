@@ -1,0 +1,385 @@
+"""The syncguard runtime witness (utils/syncguard.py).
+
+Covers: the counting shims (kind attribution, host values ignored,
+device_get's batched fetch counted ONCE with no reentrant inflation),
+immediate-caller site attribution, the live allowlist check against a
+static budget (violation dedup + flight-recorder event), install/
+uninstall hygiene, the CLI env hooks, the committed budget artifact's
+currency — and the static/dynamic agreement contract: ONE fixture is
+flagged by the static ``implicit-sync`` rule AND trips the runtime
+witness at the same site, and adding the reasoned suppression makes
+BOTH pass (the suppression becomes the budget's allowlist entry the
+witness honors).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import textwrap
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from traffic_classifier_sdn_tpu.analysis_static import lint_paths
+from traffic_classifier_sdn_tpu.analysis_static.framework import (
+    collect_modules,
+)
+from traffic_classifier_sdn_tpu.analysis_static.graftsync import (
+    build_sync_report,
+)
+from traffic_classifier_sdn_tpu.obs import FlightRecorder
+from traffic_classifier_sdn_tpu.utils import syncguard
+
+PACKAGE_DIR = os.path.dirname(
+    os.path.dirname(os.path.abspath(lint_paths.__code__.co_filename))
+)
+REPO_ROOT = os.path.dirname(PACKAGE_DIR)
+
+_ME = os.path.abspath(__file__)
+
+
+def _self_scope(filename: str) -> bool:
+    return os.path.abspath(filename) == _ME
+
+
+def _kind_totals(witness) -> dict[str, int]:
+    totals: dict[str, int] = {}
+    for per in witness.counts().values():
+        for kind, n in per.items():
+            totals[kind] = totals.get(kind, 0) + n
+    return totals
+
+
+# ---------------------------------------------------------------------------
+# the counting shims
+# ---------------------------------------------------------------------------
+
+
+def test_shims_count_by_kind():
+    dev = jnp.arange(4.0)
+    with syncguard.guarding(scope=_self_scope) as w:
+        np.asarray(dev)              # device→host sync
+        np.asarray([1, 2, 3])        # host value: silent
+        jnp.asarray([1.0, 2.0])      # host→device upload
+        jnp.asarray(dev)             # already on device: silent
+        jax.device_put([1.0, 2.0])   # explicit upload
+        jax.device_get([0.5, 1.5])   # host leaves only: silent
+    assert _kind_totals(w) == {
+        "np.asarray": 1, "upload": 1, "device_put": 1,
+    }
+
+
+def test_device_get_batched_fetch_counts_once():
+    # ONE device_get of a whole pytree is the batching idiom the serve
+    # readers use (five serial np.asarray round trips collapsed into
+    # one fetch) — the witness must see exactly one sync, and the
+    # shim's reentrancy guard must keep device_get's own internal
+    # conversions from inflating the np.asarray count
+    tree = (jnp.arange(3.0), jnp.ones(2), {"lab": jnp.zeros(4)})
+    with syncguard.guarding(scope=_self_scope) as w:
+        host = jax.device_get(tree)
+    assert _kind_totals(w) == {"device_get": 1}
+    assert isinstance(host[0], np.ndarray)
+
+
+def test_site_attribution_is_immediate_caller():
+    dev = jnp.arange(2.0)
+    with syncguard.guarding(scope=_self_scope) as w:
+        np.asarray(dev)
+        line = _prev_lineno()
+    (site,) = w.counts().keys()
+    path, _, observed = site.rpartition(":")
+    assert path.endswith("test_syncguard.py")
+    assert int(observed) == line
+
+
+def _prev_lineno() -> int:
+    import sys
+
+    return sys._getframe(1).f_lineno - 1
+
+
+def test_out_of_scope_frames_are_not_counted():
+    dev = jnp.arange(2.0)
+    with syncguard.guarding(scope=lambda fn: False) as w:
+        np.asarray(dev)
+    assert w.counts() == {}
+
+
+def test_uninstall_restores_and_deactivates():
+    real = np.asarray
+    dev = jnp.arange(2.0)
+    with syncguard.guarding(scope=_self_scope) as w:
+        assert np.asarray is not real
+        shim = np.asarray
+    assert np.asarray is real
+    # a bound reference to the shim survives uninstall but the witness
+    # is inactive: calling it must neither count nor misbehave
+    out = shim(dev)
+    assert isinstance(out, np.ndarray)
+    assert w.counts() == {}
+
+
+# ---------------------------------------------------------------------------
+# the live allowlist check
+# ---------------------------------------------------------------------------
+
+
+def _span_budget(allowed=()):  # whole file hot, optional allowlist
+    return {
+        "hot_spans": {os.path.basename(_ME): [[1, 100000]]},
+        "allowed_syncs": [{"site": s} for s in allowed],
+    }
+
+
+def test_violation_dedup_and_flight_recorder_event():
+    rec = FlightRecorder(capacity=64)
+    dev = jnp.arange(3.0)
+    with syncguard.guarding(
+        budget=_span_budget(), recorder=rec, scope=_self_scope
+    ) as w:
+        for _ in range(3):
+            np.asarray(dev)  # same site every iteration
+    violations = w.violations
+    assert len(violations) == 1  # deduped by site
+    v = violations[0]
+    assert v["kind"] == "np.asarray"
+    assert "test_syncguard.py:" in v["site"]
+    assert v["thread"]
+    assert rec.count("syncguard.violation") == 1
+    # all three calls still counted — dedup applies to flagging only
+    assert _kind_totals(w) == {"np.asarray": 3}
+
+
+def test_allowed_site_is_not_a_violation():
+    dev = jnp.arange(3.0)
+    with syncguard.guarding(
+        budget=_span_budget(), scope=_self_scope
+    ) as probe:
+        np.asarray(dev)
+    (site,) = probe.counts().keys()
+    line = site.rpartition(":")[2]
+    budget = _span_budget(
+        allowed=[os.path.basename(_ME) + ":" + line]
+    )
+    # the post-hoc check (check_against) and the live check share the
+    # matching logic: with the observed site on the allowlist, the
+    # same counts produce zero unknowns...
+    assert probe.check_against(budget) == {
+        "unknown_syncs": [], "checked": True,
+    }
+    # ...and with an empty allowlist the site comes back as unknown
+    assert probe.check_against(_span_budget())["unknown_syncs"] == [
+        {"site": site, "kinds": {"np.asarray": 1}},
+    ]
+
+
+def test_check_against_none_is_inert():
+    w = syncguard.SyncWitness()
+    assert w.check_against(None) == {
+        "unknown_syncs": [], "checked": False,
+    }
+
+
+def test_finish_reports_once(capsys):
+    rec = FlightRecorder(capacity=64)
+    dev = jnp.arange(3.0)
+    with syncguard.guarding(
+        budget=_span_budget(), recorder=rec, scope=_self_scope
+    ) as w:
+        np.asarray(dev)
+    report = syncguard.finish(w, recorder=rec)
+    assert report is not None and len(report["violations"]) == 1
+    assert "SYNCGUARD VIOLATION" in capsys.readouterr().err
+    # the violation was live-recorded on the SAME recorder: finish
+    # must not double-record it
+    assert rec.count("syncguard.violation") == 1
+    # ... but a late-attached recorder gets the replay
+    late = FlightRecorder(capacity=64)
+    syncguard.finish(w, recorder=late)
+    assert late.count("syncguard.violation") == 1
+
+
+# ---------------------------------------------------------------------------
+# env hooks
+# ---------------------------------------------------------------------------
+
+
+def test_load_budget_env_override(tmp_path, monkeypatch):
+    budget = {"hot_spans": {}, "allowed_syncs": []}
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps(budget), encoding="utf-8")
+    monkeypatch.setenv("TCSDN_SYNC_BUDGET", str(p))
+    assert syncguard.load_budget() == budget
+    monkeypatch.setenv("TCSDN_SYNC_BUDGET", str(tmp_path / "no.json"))
+    assert syncguard.load_budget() is None
+
+
+def test_maybe_guard_from_env(monkeypatch):
+    monkeypatch.delenv("TCSDN_SYNCGUARD", raising=False)
+    assert syncguard.maybe_guard_from_env() is None
+    monkeypatch.setenv("TCSDN_SYNCGUARD", "1")
+    w = syncguard.maybe_guard_from_env()
+    try:
+        assert w is not None and syncguard._installed is w
+        # idempotent: a second arm while installed is a no-op
+        assert syncguard.maybe_guard_from_env() is None
+    finally:
+        syncguard.uninstall()
+    assert syncguard._installed is None
+
+
+def test_append_report_accumulates(tmp_path):
+    out = str(tmp_path / "observed.json")
+    dev = jnp.arange(2.0)
+    with syncguard.guarding(scope=_self_scope) as w1:
+        np.asarray(dev)
+    syncguard.append_report(w1, out)
+    with syncguard.guarding(scope=_self_scope) as w2:
+        jax.device_get(dev)
+    merged = syncguard.append_report(w2, out)
+    totals: dict[str, int] = {}
+    for per in merged["counts"].values():
+        for kind, n in per.items():
+            totals[kind] = totals.get(kind, 0) + n
+    assert totals == {"np.asarray": 1, "device_get": 1}
+    assert merged["platform"] == jax.default_backend()
+    assert merged["violations"] == []
+    with open(out, encoding="utf-8") as f:
+        assert json.load(f) == merged
+
+
+# ---------------------------------------------------------------------------
+# the static/dynamic agreement contract (the acceptance pin)
+# ---------------------------------------------------------------------------
+
+SYNC_FIXTURE = """\
+import numpy as np
+import jax
+
+
+def serve_tick(x: jax.Array):
+    return np.asarray(x)
+"""
+
+SUPPRESSED_FIXTURE = SYNC_FIXTURE.replace(
+    "return np.asarray(x)",
+    "return np.asarray(x)  # graftlint: disable=implicit-sync "
+    "-- render-sync: test seam",
+)
+
+
+def _load_fixture(path):
+    spec = importlib.util.spec_from_file_location("sync_fx", str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fixture_budget(tmp_path, path):
+    modules, errs = collect_modules([str(path)],
+                                    relative_to=str(tmp_path))
+    assert errs == []
+    return build_sync_report(modules)
+
+
+def test_same_fixture_flagged_statically_and_tripped_at_runtime(
+    tmp_path,
+):
+    """The whole point of the pairing: the fixture the static rule
+    flags is the SAME one the runtime witness trips on, at the same
+    site — and the reasoned suppression silences both, because it
+    becomes the budget's allowlist entry."""
+    path = tmp_path / "fixture.py"
+    path.write_text(textwrap.dedent(SYNC_FIXTURE), encoding="utf-8")
+
+    findings = lint_paths([str(path)])
+    assert [f.rule for f in findings] == ["implicit-sync"]
+    static_line = findings[0].line
+
+    budget = _fixture_budget(tmp_path, path)
+    assert "fixture.py" in budget["hot_spans"]
+    assert budget["allowed_syncs"] == []
+
+    mod = _load_fixture(path)
+    scope = lambda fn: fn.startswith(str(tmp_path))  # noqa: E731
+    with syncguard.guarding(budget=budget, scope=scope) as w:
+        mod.serve_tick(jnp.arange(4.0))
+    violations = w.violations
+    assert len(violations) == 1
+    observed_line = int(violations[0]["site"].rpartition(":")[2])
+    assert observed_line == static_line  # byte-for-byte agreement
+
+
+def test_suppression_becomes_allowlist_and_silences_witness(tmp_path):
+    path = tmp_path / "fixture.py"
+    path.write_text(textwrap.dedent(SUPPRESSED_FIXTURE),
+                    encoding="utf-8")
+
+    assert lint_paths([str(path)]) == []  # static half: clean
+
+    budget = _fixture_budget(tmp_path, path)
+    allowed = budget["allowed_syncs"]
+    assert len(allowed) == 1
+    assert allowed[0]["discipline"] == "render-sync"
+    assert allowed[0]["rule"] == "implicit-sync"
+
+    mod = _load_fixture(path)
+    scope = lambda fn: fn.startswith(str(tmp_path))  # noqa: E731
+    with syncguard.guarding(budget=budget, scope=scope) as w:
+        mod.serve_tick(jnp.arange(4.0))
+    assert w.violations == []  # dynamic half: the seam is budgeted
+    # the sync still HAPPENED and was counted — budgeted, not blind
+    assert _kind_totals(w) == {"np.asarray": 1}
+
+
+# ---------------------------------------------------------------------------
+# the committed artifact
+# ---------------------------------------------------------------------------
+
+
+def test_sync_budget_artifact_is_current():
+    """docs/artifacts/hot_path_sync_budget.json must match a fresh
+    build from the package source — every hot-path suppression lands
+    in this ledger, and review can only diff the sync economy if it
+    never goes stale. Regenerate from the repo root with:
+
+        python -m traffic_classifier_sdn_tpu.analysis_static \\
+            traffic_classifier_sdn_tpu --sync-budget \\
+            docs/artifacts/hot_path_sync_budget.json
+    """
+    artifact_path = syncguard.DEFAULT_BUDGET_PATH
+    assert os.path.exists(artifact_path), (
+        f"missing artifact {artifact_path} — generate it (see "
+        "docstring)"
+    )
+    with open(artifact_path, encoding="utf-8") as f:
+        committed = json.load(f)
+    modules, errs = collect_modules([PACKAGE_DIR],
+                                    relative_to=REPO_ROOT)
+    assert errs == []
+    fresh = build_sync_report(modules)
+    assert committed == fresh, (
+        "docs/artifacts/hot_path_sync_budget.json is stale — "
+        "regenerate it (see this test's docstring)"
+    )
+
+
+def test_sync_budget_artifact_shape():
+    with open(syncguard.DEFAULT_BUDGET_PATH, encoding="utf-8") as f:
+        budget = json.load(f)
+    # every allowlist entry names its discipline, reason, and a
+    # site inside a hot span — an entry outside every hot span would
+    # be dead weight the witness can never match
+    probe = syncguard.SyncWitness(budget=budget)
+    for entry in budget["allowed_syncs"]:
+        assert entry["discipline"] in budget["disciplines"]
+        assert entry["reason"]
+        path, line = probe._split(entry["site"])
+        assert probe._in_hot_span(path, line), entry["site"]
+    assert set(budget["serve_paths"]) == {
+        "serial", "pipelined", "incremental", "degraded",
+    }

@@ -31,6 +31,14 @@
 # schedule this sweep drives — each crash/recovery interleaving doubles
 # as ordering evidence cross-checked against the static lock-order
 # graph (docs/artifacts/lock_order_graph.json).
+#
+# Sync-budget probing: every group also runs with TCSDN_SYNCGUARD=1,
+# arming the syncguard runtime witness (utils/syncguard.py) in every
+# test module (the tier-1 fixture only arms the five serve suites):
+# each chaos schedule's host↔device conversions are counted by site
+# and checked live against the static hot-path sync budget
+# (docs/artifacts/hot_path_sync_budget.json) — a recovery path that
+# sneaks an unbudgeted sync into a hot span fails the sweep.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -64,7 +72,8 @@ for seed in "${SEEDS[@]}"; do
     site="${entry%%:*}"
     kexpr="${entry#*:}"
     echo "=== chaos seed=${seed} site=${site}"
-    if ! TCSDN_CHAOS_SEED="$seed" TCSDN_LOCKTRACE=1 JAX_PLATFORMS=cpu \
+    if ! TCSDN_CHAOS_SEED="$seed" TCSDN_LOCKTRACE=1 TCSDN_SYNCGUARD=1 \
+        JAX_PLATFORMS=cpu \
         python -m pytest tests/test_chaos.py -q -m chaos -k "$kexpr" \
         -p no:cacheprovider; then
       echo "!!! UNRECOVERED: seed=${seed} site=${site}" >&2
@@ -81,7 +90,7 @@ done
 # evidence; one sweep suffices — the timelines are deterministic on
 # the virtual clock, only thread interleavings vary.
 echo "=== chaos site=scenario (campaign timelines)"
-if ! TCSDN_LOCKTRACE=1 JAX_PLATFORMS=cpu \
+if ! TCSDN_LOCKTRACE=1 TCSDN_SYNCGUARD=1 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_scenarios.py -q \
     -p no:cacheprovider; then
   echo "!!! UNRECOVERED: site=scenario" >&2

@@ -5,8 +5,11 @@
 #              ctypes-abi, lock-discipline, fault-site-registry,
 #              atomic-io, plus the graftlock whole-program concurrency
 #              pass: lock-order, blocking-under-lock,
-#              thread-lifecycle) — always runs, zero findings
-#              required. Also enforced in tier-1 via `pytest -m lint`
+#              thread-lifecycle, plus the graftsync device-boundary
+#              pass: implicit-sync, transfer-discipline,
+#              donation-hazard, sync-under-lock — 13 rules) — always
+#              runs, zero findings required. Also enforced in tier-1
+#              via `pytest -m lint`
 #              (tests/test_graftlint.py::test_package_is_clean);
 #              `--list-rules` prints the full set.
 #   ruff       generic baseline, config pinned in [tool.ruff]

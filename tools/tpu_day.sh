@@ -131,6 +131,27 @@ if [ -f tools/bench_knn.py ]; then
   fi
 fi
 
+# syncguard on chip: the five serve suites with the runtime transfer
+# witness armed (utils/syncguard.py) and jax.transfer_guard=log for
+# corroboration — on TPU a host↔device crossing is a REAL wire
+# transfer, so this is the strongest form of the hot-path sync-budget
+# check. Each test's observed per-site counts accumulate into the
+# report; a pass means every hot-span sync matched the static budget
+# (docs/artifacts/hot_path_sync_budget.json) on real hardware, and the
+# observed economy lands as the artifact's chip twin.
+rm -f /tmp/tpu_day_syncguard.json
+run_step 1200 /tmp/tpu_day_sync.log env TCSDN_SYNCGUARD=1 \
+  TCSDN_SYNCGUARD_TG=log \
+  TCSDN_SYNCGUARD_REPORT=/tmp/tpu_day_syncguard.json \
+  python -m pytest tests/test_pipeline.py tests/test_incremental.py \
+    tests/test_degrade.py tests/test_drift.py tests/test_openset.py \
+    -q -m "not slow" -p no:cacheprovider
+if [ "$STEP_OK" = 1 ] && [ -f /tmp/tpu_day_syncguard.json ]; then
+  cp /tmp/tpu_day_syncguard.json \
+    docs/artifacts/hot_path_sync_budget_tpu.json
+  echo "tpu_day: observed sync budget landed"
+fi
+
 # chip-day allowance: one warm process gets time for every race stage —
 # including the 4-way+ KNN top-k chip race (sort/argmax/hier*/screened*
 # now race inside bench.py stage 4b; the parity-gated winner promotes)

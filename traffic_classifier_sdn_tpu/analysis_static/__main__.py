@@ -47,6 +47,12 @@ def main(argv: list[str] | None = None) -> int:
              "artifact and the runtime witness's cross-check input",
     )
     parser.add_argument(
+        "--sync-budget", metavar="PATH",
+        help="write the hot-path expected-sync ledger (JSON) to PATH "
+             "— the docs/artifacts/hot_path_sync_budget.json artifact "
+             "and the syncguard runtime witness's cross-check input",
+    )
+    parser.add_argument(
         "--select",
         help="comma-separated rule ids to run (default: all)",
     )
@@ -101,21 +107,19 @@ def main(argv: list[str] | None = None) -> int:
     if args.sarif:
         with open(args.sarif, "w", encoding="utf-8") as f:
             f.write(render_sarif(findings, rules))
-    if args.lock_graph:
+    if args.lock_graph or args.sync_budget:
         import json as _json
-
-        from .graftlock import build_graph_report
 
         # display paths pinned to the parent of the TOPMOST enclosing
         # package (walking up through __init__.py), NOT the cwd and
         # not the scanned subtree: a subpackage scan
         # (`--lock-graph g.json pkg/serving`) must still emit
-        # `pkg/serving/...` site keys, because the runtime witness
-        # normalizes its construction frames against the package root
-        # — anything else makes every observed site "unmapped". A
-        # fresh parse, not the lint run's modules: the pin changes
-        # every display path, and finding paths must stay cwd-relative
-        # for editor links.
+        # `pkg/serving/...` site keys, because the runtime witnesses
+        # (locktrace, syncguard) normalize their observed frames
+        # against the package root — anything else makes every
+        # observed site "unmapped". A fresh parse, not the lint run's
+        # modules: the pin changes every display path, and finding
+        # paths must stay cwd-relative for editor links.
         anchor = os.path.commonpath(
             [os.path.abspath(p) for p in paths]
         )
@@ -126,15 +130,25 @@ def main(argv: list[str] | None = None) -> int:
         modules, parse_errs = collect_modules(paths,
                                               relative_to=anchor)
         for fnd in parse_errs:
-            # a lock constructed in an unparseable file would silently
-            # vanish from the graph — say so (the lint findings above
-            # already fail the run on the same parse error)
-            print(f"lock-graph: skipping unparseable {fnd.path}: "
+            # a lock or sync site in an unparseable file would
+            # silently vanish from the artifact — say so (the lint
+            # findings above already fail the run on the parse error)
+            print(f"artifact export: skipping unparseable {fnd.path}: "
                   f"{fnd.message}", file=sys.stderr)
-        with open(args.lock_graph, "w", encoding="utf-8") as f:
-            _json.dump(build_graph_report(modules), f, indent=2,
-                       sort_keys=True)
-            f.write("\n")
+        if args.lock_graph:
+            from .graftlock import build_graph_report
+
+            with open(args.lock_graph, "w", encoding="utf-8") as f:
+                _json.dump(build_graph_report(modules), f, indent=2,
+                           sort_keys=True)
+                f.write("\n")
+        if args.sync_budget:
+            from .graftsync import build_sync_report
+
+            with open(args.sync_budget, "w", encoding="utf-8") as f:
+                _json.dump(build_sync_report(modules), f, indent=2,
+                           sort_keys=True)
+                f.write("\n")
     print(render_report(findings, as_json=args.json))
     return 1 if findings else 0
 

@@ -1,0 +1,1313 @@
+"""graftsync: whole-program host↔device boundary analysis.
+
+graftlock (PR 7) proved the concurrency invariants the runtime cannot;
+this module does the same for the OTHER silent performance killer: a
+hidden device→host sync or a per-tick host→device upload on the serve
+hot path. PRs 4/8/11/12 each hand-hardened individual sync seams (the
+lazy rejection-count drain, the deferred calibration fold, the
+per-epoch stat upload) by reviewer vigilance alone — graftsync makes
+the analyzer find the next one before the chip window pays for it.
+
+The pass reuses graftlock's interprocedural infrastructure (call
+graph, import resolution, attribute typing, held-lock summaries) and
+adds a device-taint layer on top:
+
+hot-path classification
+    Functions reachable (through graftlock's call edges) from the
+    serve tick's per-tick surfaces — ``dispatch_read``, the pipeline
+    host/device stages, the ``*Read.rows`` render boundary, the
+    incremental/degrade/drift/openset predict wrappers — are HOT; the
+    rest (warmup, CLI setup, checkpoint restore, bench scaffolding) is
+    cold and free to sync. A function named ``serve_tick`` is a hot
+    root by convention, which is how out-of-tree fixtures opt in.
+
+``implicit-sync``
+    ``np.asarray``/``.item()``/``int()``/``float()``/``bool()``/
+    ``len()``/truthiness/iteration on a device-array-typed value
+    reachable on a hot path. Every allowed instance carries a reasoned
+    suppression NAMING ITS DEFERRAL DISCIPLINE (see ``DISCIPLINES``) —
+    the PR 8 ``_drain_pending_count`` sites are the canonical
+    examples. A suppression whose reason names no discipline is a
+    ``bad-suppression`` finding, which cannot itself be suppressed.
+
+``transfer-discipline``
+    ``jax.device_put`` / an implicit host-array upload
+    (``jnp.asarray``/``jnp.array`` of a host value, or an np-dtype
+    scalar fed to a jitted call) inside a per-tick path, unless routed
+    through a warmup-primed or epoch-cached seam — exactly the
+    per-tick stat re-upload bug PR 12 review caught by hand. Fresh
+    wire data crossing to the device is the workload, not a finding:
+    only provably host-side re-uploads (np scalar constructors, host
+    conversions feeding jits) are flagged.
+
+``donation-hazard``
+    A buffer passed at a donated argument position
+    (``donate_argnums``) is dead; referencing it afterwards returns
+    garbage (or errors) on platforms that honor donation. The donated
+    alias set flows through assignments and call edges — a helper that
+    forwards its parameter into a donated position donates its
+    caller's buffer too. Rebinding the name revives it (the
+    ``buf = donated_fn(buf)`` idiom).
+
+``sync-under-lock``
+    Any sync/transfer while holding a project lock, composing
+    graftlock's held-lock summaries with the new sync summaries. A
+    device sync can take arbitrarily long on a busy accelerator; a
+    thread that syncs under a lock wedges every thread that ever
+    takes that lock — the same failure mode as blocking-under-lock,
+    at the device boundary.
+
+``build_sync_report`` exports the per-tick expected-sync ledger
+(``docs/artifacts/hot_path_sync_budget.json``): every allowlisted sync
+site with its discipline and reason, the hot-function spans, and the
+per-serve-path (serial/pipelined/incremental/degraded) ledgers. The
+runtime witness (``utils/syncguard.py``) cross-checks every observed
+sync against this budget by construction site — an unknown sync is a
+resolver hole, exactly like locktrace's unknown-edge check.
+
+Resolution is syntactic-plus-conventions, like graftlock: a value is
+device-typed if it flows from a ``jax.jit``-wrapped callable (module
+names bound to ``jax.jit(...)`` or ending ``_jit``), a ``jnp.*`` call,
+``jax.device_put``, a ``jax.Array`` annotation, an attribute a scanned
+method assigns a device value to, or a call to a scanned function that
+returns one (a monotone fixed point). The witness exists precisely to
+catch the syncs this static pass misses.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from collections.abc import Iterator, Sequence
+from dataclasses import dataclass, field
+
+from . import graftlock
+from .framework import BAD_SUPPRESSION, Finding, ModuleInfo, Rule
+from .graftlock import _chain_text, _mod_proxy, _short
+
+IMPLICIT_SYNC = "implicit-sync"
+TRANSFER_DISCIPLINE = "transfer-discipline"
+DONATION_HAZARD = "donation-hazard"
+SYNC_UNDER_LOCK = "sync-under-lock"
+
+# The deferral-discipline vocabulary: a suppression of implicit-sync /
+# transfer-discipline must name exactly how the sync is kept off the
+# per-tick critical path (docs/STATIC_ANALYSIS.md documents each).
+DISCIPLINES = (
+    "deferred-drain",    # drained lazily off the dispatch edge (PR 8)
+    "epoch-cached",      # uploaded once per label epoch, cached on device
+    "warmup-primed",     # primed once at warmup, never re-paid per tick
+    "render-sync",       # the render boundary: labels must reach the host
+    "watchdog-guarded",  # the degrade ladder's deadline-bounded host fetch
+    "cold-path",         # hot-reachable in the graph, cold by construction
+    "tick-plan",         # an O(1) planning scalar the host must read to
+                         # size this tick's dispatch (e.g. the dirty count)
+    "host-native",       # the value is already host-resident (host-native
+                         # predict variant) — the conversion is a no-op
+)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# hot roots
+# ---------------------------------------------------------------------------
+
+# (path suffix | None, class matcher | None, function name). A class
+# matcher starting with "*" is a suffix match ("*Read" hits RankedRead,
+# IncFullRead, ...). These are the per-tick surfaces of the four serve
+# compositions; everything transitively callable from them is hot.
+_SERVE_PATH_ROOTS: dict[str, tuple[tuple, ...]] = {
+    "serial": (
+        ("cli.py", None, "_print_table"),
+        ("serving/openset.py", "OpenSetGate", "__call__"),
+        ("serving/drift.py", "DriftGate", "__call__"),
+    ),
+    "pipelined": (
+        ("serving/pipeline.py", None, "dispatch_read"),
+        ("serving/pipeline.py", "ServePipeline", "submit"),
+        ("serving/pipeline.py", "ServePipeline", "_run"),
+        ("serving/pipeline.py", "FeatureStage", "features"),
+        ("serving/pipeline.py", "*Read", "rows"),
+        ("cli.py", None, "_dispatch_render"),
+        ("cli.py", None, "_print_ranked"),
+    ),
+    "incremental": (
+        ("serving/incremental.py", "IncrementalLabels", "labels"),
+        ("serving/incremental.py", "IncrementalLabels", "dispatch"),
+        ("serving/incremental.py", "IncrementalLabels", "finish"),
+        ("serving/incremental.py", "*Read", "rows"),
+    ),
+    "degraded": (
+        ("serving/degrade.py", "DegradeLadder", "__call__"),
+    ),
+}
+
+# np-dtype scalar constructors: building one is host-side and free, but
+# feeding it to a jitted call uploads a fresh scalar every tick.
+_NP_SCALAR_CTORS = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "bool_",
+}
+
+_SYNC_BUILTINS = {"int", "float", "bool", "len"}
+
+# Attribute reads on a device value that stay host-side: array/pytree
+# metadata, not data (shape tuples, dtypes, the capacity/n_flows
+# host-int properties).
+_HOST_META_ATTRS = {
+    "shape", "dtype", "ndim", "size", "weak_type", "sharding",
+    "capacity", "n_flows", "at",
+}
+
+# jax.* callables that return CALLABLES (or host values), not device
+# arrays — everything else under jax.* is assumed to stay device-side
+_JAX_TRANSFORMS = {
+    "jax.jit", "jax.pjit", "jax.vmap", "jax.pmap", "jax.grad",
+    "jax.value_and_grad", "jax.checkpoint", "jax.custom_vjp",
+    "jax.custom_jvp", "jax.named_call", "jax.eval_shape",
+}
+
+# parameter-name → class-name conventions, the graftlock
+# _ATTR_TYPE_HINTS idiom at function boundaries: the serve plumbing
+# passes these untyped, and losing the chain at the first hop would
+# blind the pass to `engine.table.fwd.active`-style device reads
+_PARAM_CLASS_HINTS = {
+    "engine": "FlowStateEngine",
+    "eng": "FlowStateEngine",
+    "table": "FlowTable",
+}
+
+
+def _root_match(s, spec: tuple) -> bool:
+    path_suffix, cls, name = spec
+    if s.name != name:
+        return False
+    if path_suffix is not None and not s.mod.display_path.replace(
+        os.sep, "/"
+    ).endswith(path_suffix):
+        return False
+    if cls is None:
+        return s.cls is None
+    if s.cls is None:
+        return False
+    if cls.startswith("*"):
+        return s.cls.endswith(cls[1:])
+    return s.cls == cls
+
+
+def _is_hot_root(s) -> bool:
+    if s.name == "serve_tick":  # the fixture/out-of-tree convention
+        return True
+    return any(
+        _root_match(s, spec)
+        for specs in _SERVE_PATH_ROOTS.values()
+        for spec in specs
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-function sync scan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _SyncEvent:
+    rule: str          # IMPLICIT_SYNC | TRANSFER_DISCIPLINE
+    kind: str          # "np.asarray", ".item()", "device_put", ...
+    line: int
+    what: str          # human-readable value description
+    held: tuple = ()   # ((lock, line), ...) at the event
+
+
+@dataclass
+class _Donation:
+    line: int
+    name: str          # the donated binding ("buf" / "self._cache")
+    callee: str        # the donated callable's name
+    use_line: int      # the post-donation reference
+
+
+@dataclass
+class _FnSync:
+    events: list[_SyncEvent] = field(default_factory=list)
+    donations: list[_Donation] = field(default_factory=list)
+    returns_device: bool = False
+    device_attr_writes: set[str] = field(default_factory=set)
+    donates_params: set[int] = field(default_factory=set)
+
+
+class _SyncAnalysis:
+    """The device-boundary layer over graftlock's interprocedural base:
+    per-function sync/transfer/donation summaries, the hot-path set,
+    and sync closures for the under-lock composition."""
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        self.lock = graftlock.analyze(modules)
+        self.project = self.lock.project
+        # module path → jit-bound module-global names
+        self.jit_names: dict[str, set[str]] = {}
+        # module path → name → frozenset(donated positions)
+        self.donated: dict[str, dict[str, frozenset]] = {}
+        for m in self.project.modules:
+            self._index_module(m)
+        self.fn_sync: dict[int, _FnSync] = {}
+        # (module path, class) → device-typed attribute names
+        self.device_attrs: dict[tuple[str, str], set[str]] = {}
+        # struct.PyTreeNode subclasses: instances ARE device values
+        # (fields are device arrays or nested device pytrees)
+        self.pytree_classes: set[str] = set()
+        for m in self.project.modules:
+            assert m.tree is not None
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ClassDef) and any(
+                    _terminal(b) == "PyTreeNode" for b in node.bases
+                ):
+                    self.pytree_classes.add(node.name)
+        # class-level jax.Array / pytree-typed field annotations seed
+        # the device-attr sets the method-scan fixed point then grows
+        for m in self.project.modules:
+            assert m.tree is not None
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for st in node.body:
+                    if not (isinstance(st, ast.AnnAssign)
+                            and isinstance(st.target, ast.Name)):
+                        continue
+                    ann = st.annotation
+                    if (
+                        _dotted(ann) in ("jax.Array", "jnp.ndarray")
+                        or _terminal(ann) == "Array"
+                        or _terminal(ann) in self.pytree_classes
+                    ):
+                        self.device_attrs.setdefault(
+                            (m.display_path, node.name), set()
+                        ).add(st.target.id)
+        # summary key → device-tainted parameter indices (flowed from
+        # call sites — including constructor calls, which is how a
+        # device output handed to a Read object's __init__ taints the
+        # attribute its rows() later converts)
+        self.param_taint: dict[int, set[int]] = {}
+        self._pt_dirty = False
+        self._fixed_point()
+        # summary key → chain of qualnames from a hot root
+        self.hot: dict[int, tuple[str, ...]] = {}
+        self._compute_hot()
+        # summary key → {(path, line, kind): chain}
+        self.sync_closure: dict[int, dict] = {}
+        self._compute_sync_closures()
+
+    # -- module-level indexes -----------------------------------------------
+    def _index_module(self, m: ModuleInfo) -> None:
+        jits: set[str] = set()
+        donated: dict[str, frozenset] = {}
+        assert m.tree is not None
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            if _terminal(call.func) not in ("jit", "pjit", "shard_map"):
+                continue
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            jits.update(names)
+            for k in call.keywords:
+                if k.arg != "donate_argnums":
+                    continue
+                pos = self._const_positions(k.value)
+                if pos:
+                    for n in names:
+                        donated[n] = pos
+        self.jit_names[m.display_path] = jits
+        self.donated[m.display_path] = donated
+
+    @staticmethod
+    def _const_positions(node: ast.AST) -> frozenset:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return frozenset([node.value])
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = set()
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(
+                    e.value, int
+                ):
+                    out.add(e.value)
+            return frozenset(out)
+        return frozenset()
+
+    def _is_jit_name(self, m: ModuleInfo, name: str) -> bool:
+        if name.endswith("_jit"):
+            return True
+        if name in self.jit_names.get(m.display_path, ()):
+            return True
+        imp = self.project.imports.get(m.display_path, {}).get(name)
+        if imp is not None and imp[0] == "symbol":
+            return imp[2] in self.jit_names.get(imp[1], ())
+        return False
+
+    def _donated_positions(self, m: ModuleInfo, name: str) -> frozenset:
+        d = self.donated.get(m.display_path, {}).get(name)
+        if d:
+            return d
+        imp = self.project.imports.get(m.display_path, {}).get(name)
+        if imp is not None and imp[0] == "symbol":
+            return self.donated.get(imp[1], {}).get(imp[2], frozenset())
+        return frozenset()
+
+    # -- fixed point over return-taint / attr-taint / donation params -------
+    def _fixed_point(self) -> None:
+        for _ in range(8):  # monotone; tiny bound in practice
+            changed = False
+            self._pt_dirty = False
+            for key, s in self.lock.summaries.items():
+                fs = self._scan_function(s)
+                prev = self.fn_sync.get(key)
+                if (
+                    prev is None
+                    or fs.returns_device != prev.returns_device
+                    or fs.donates_params != prev.donates_params
+                    or fs.device_attr_writes != prev.device_attr_writes
+                ):
+                    changed = True
+                self.fn_sync[key] = fs
+                if s.cls is not None and fs.device_attr_writes:
+                    slot = self.device_attrs.setdefault(
+                        (s.mod.display_path, s.cls), set()
+                    )
+                    if not fs.device_attr_writes <= slot:
+                        slot |= fs.device_attr_writes
+                        changed = True
+            if not changed and not self._pt_dirty:
+                break
+
+    # -- the per-function walk ----------------------------------------------
+    def _scan_function(self, s) -> _FnSync:
+        m, cls, fn = s.mod, s.cls, s.node
+        fs = _FnSync()
+        # line → callee summary keys (from graftlock's resolved calls)
+        line_calls: dict[int, list[int]] = {}
+        for callee, line, _held in s.calls:
+            line_calls.setdefault(line, []).append(callee)
+
+        tainted: set[str] = set()
+        host_np: set[str] = set()
+        dead: dict[str, tuple[int, str]] = {}  # name → (line, callee)
+        param_types: dict[str, tuple[str, str]] = {}
+        params = (fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs)
+        for i, a in enumerate(params):
+            ann = a.annotation
+            if ann is None:
+                hint = _PARAM_CLASS_HINTS.get(a.arg)
+                hits = (self.project.classes_by_name.get(hint)
+                        if hint else None)
+                if hits:
+                    param_types[a.arg] = hits[0]
+                continue
+            d = _dotted(ann)
+            if (
+                d in ("jax.Array", "jnp.ndarray")
+                or _terminal(ann) == "Array"
+                or _terminal(ann) in self.pytree_classes
+            ):
+                tainted.add(a.arg)
+                continue
+            ref = self.project._annotation_class(m, ann)
+            if ref is not None:
+                param_types[a.arg] = ref
+        for i in self.param_taint.get(id(fn), ()):
+            if i < len(params):
+                tainted.add(params[i].arg)
+        # serve-root predict wrappers take the dispatched feature
+        # matrix as an untyped ``X`` (device-resident on the device
+        # serve paths; the host-native variant's conversions are then
+        # no-ops — the safe overapproximation): seed it, or the taint
+        # dies at the wrapper boundary no caller resolves into
+        if _is_hot_root(s) and any(p.arg == "X" for p in params):
+            tainted.add("X")
+        self_offset = 1 if (cls is not None and params
+                            and params[0].arg == "self") else 0
+
+        def attr_device(node: ast.Attribute) -> bool:
+            base = node.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and cls is not None:
+                    return node.attr in self.device_attrs.get(
+                        (m.display_path, cls), ()
+                    )
+                ref = param_types.get(base.id)
+                if ref is not None:
+                    return node.attr in self.device_attrs.get(ref, ())
+            return False
+
+        def binding(node: ast.AST) -> str | None:
+            """A donation-trackable binding: a bare local, or self.X."""
+            if isinstance(node, ast.Name):
+                return node.id
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                return f"self.{node.attr}"
+            return None
+
+        def taint_of(node: ast.AST, held: tuple) -> str | None:
+            """'dev' | 'host_np' | None; emits events as a side effect
+            (each expression is evaluated exactly once, in source
+            order, so donation checks see the pre-statement state)."""
+            if isinstance(node, ast.Name):
+                b = node.id
+                if isinstance(node.ctx, ast.Load) and b in dead:
+                    dline, callee = dead.pop(b)  # report once
+                    fs.donations.append(
+                        _Donation(dline, b, callee, node.lineno)
+                    )
+                if b in tainted:
+                    return "dev"
+                if b in host_np:
+                    return "host_np"
+                return None
+            if isinstance(node, ast.Attribute):
+                bnd = binding(node)
+                if (
+                    bnd is not None
+                    and isinstance(node.ctx, ast.Load)
+                    and bnd in dead
+                ):
+                    dline, callee = dead.pop(bnd)
+                    fs.donations.append(
+                        _Donation(dline, bnd, callee, node.lineno)
+                    )
+                base_t = taint_of(node.value, held)
+                if attr_device(node):
+                    return "dev"
+                # a field of a device pytree is device-resident;
+                # metadata reads (shape/dtype/capacity) stay host
+                if base_t == "dev" and node.attr not in (
+                    _HOST_META_ATTRS
+                ):
+                    return "dev"
+                return None
+            if isinstance(node, ast.Call):
+                return call_taint(node, held)
+            if isinstance(node, ast.Subscript):
+                t = taint_of(node.value, held)
+                taint_of(node.slice, held)
+                return t
+            if isinstance(node, (ast.BinOp,)):
+                lt = taint_of(node.left, held)
+                rt = taint_of(node.right, held)
+                return "dev" if "dev" in (lt, rt) else None
+            if isinstance(node, ast.UnaryOp):
+                return taint_of(node.operand, held)
+            if isinstance(node, ast.Compare):
+                ts = [taint_of(node.left, held)] + [
+                    taint_of(c, held) for c in node.comparators
+                ]
+                # identity tests never inspect the value — `x is None`
+                # on a device array is sync-free
+                if all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in node.ops):
+                    return None
+                return "dev" if "dev" in ts else None
+            if isinstance(node, ast.IfExp):
+                taint_of(node.test, held)
+                bt = taint_of(node.body, held)
+                ot = taint_of(node.orelse, held)
+                return "dev" if "dev" in (bt, ot) else None
+            if isinstance(node, (ast.Tuple, ast.List)):
+                ts = [taint_of(e, held) for e in node.elts]
+                return "dev" if "dev" in ts else None
+            if isinstance(node, ast.BoolOp):
+                ts = [taint_of(v, held) for v in node.values]
+                return "dev" if "dev" in ts else None
+            if isinstance(node, ast.Starred):
+                return taint_of(node.value, held)
+            if isinstance(node, (ast.JoinedStr, ast.FormattedValue)):
+                for child in ast.iter_child_nodes(node):
+                    taint_of(child, held)
+                return None
+            if isinstance(node, (ast.ListComp, ast.SetComp,
+                                 ast.GeneratorExp, ast.DictComp)):
+                # generators bind before the element expression runs,
+                # so a device iterable taints its comprehension target
+                def bind(t: ast.AST) -> None:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for e in t.elts:
+                            bind(e)
+                for comp in node.generators:
+                    if taint_of(comp.iter, held) == "dev":
+                        sync(node.lineno, "iteration",
+                             "comprehension over a device array",
+                             held)
+                        bind(comp.target)
+                    for cond in comp.ifs:
+                        taint_of(cond, held)
+                if isinstance(node, ast.DictComp):
+                    taint_of(node.key, held)
+                    taint_of(node.value, held)
+                else:
+                    taint_of(node.elt, held)
+                return None
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    taint_of(child, held)
+            return None
+
+        def sync(line: int, kind: str, what: str, held: tuple) -> None:
+            fs.events.append(
+                _SyncEvent(IMPLICIT_SYNC, kind, line, what, held)
+            )
+
+        def transfer(line: int, kind: str, what: str,
+                     held: tuple) -> None:
+            fs.events.append(
+                _SyncEvent(TRANSFER_DISCIPLINE, kind, line, what, held)
+            )
+
+        def apply_donation(call: ast.Call, positions: frozenset,
+                           callee_name: str, is_method: bool) -> None:
+            for pos in positions:
+                idx = pos - (1 if is_method else 0)
+                if idx < 0 or idx >= len(call.args):
+                    continue
+                bnd = binding(call.args[idx])
+                if bnd is not None:
+                    dead[bnd] = (call.lineno, callee_name)
+
+        def call_taint(call: ast.Call, held: tuple) -> str | None:
+            func = call.func
+            d = _dotted(func) or ""
+            name = func.id if isinstance(func, ast.Name) else None
+            arg_taints = [taint_of(a, held) for a in call.args]
+            for k in call.keywords:
+                taint_of(k.value, held)
+            a0 = arg_taints[0] if arg_taints else None
+
+            # ---- device→host sync sinks
+            if d in ("np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array"):
+                if a0 == "dev":
+                    sync(call.lineno, d.split(".")[0] + "."
+                         + d.split(".")[-1],
+                         f"{d}() on a device array", held)
+                return "host_np" if a0 == "dev" else None
+            if name in _SYNC_BUILTINS:
+                if a0 == "dev":
+                    sync(call.lineno, f"{name}()",
+                         f"{name}() on a device value", held)
+                return None
+            if isinstance(func, ast.Attribute) and func.attr in (
+                "item", "tolist"
+            ):
+                base_t = taint_of(func.value, held)
+                if base_t == "dev":
+                    sync(call.lineno, f".{func.attr}()",
+                         f".{func.attr}() on a device value", held)
+                return None
+
+            # ---- explicit device→host fetch
+            if d in ("jax.device_get", "device_get"):
+                if a0 == "dev":
+                    sync(call.lineno, "device_get",
+                         "jax.device_get() fetches to host", held)
+                return None
+            # transforms return callables/host shapes, not arrays
+            if d in _JAX_TRANSFORMS:
+                return None
+
+            # ---- host→device transfer sinks
+            if d in ("jax.device_put", "device_put"):
+                transfer(call.lineno, "device_put",
+                         "explicit jax.device_put", held)
+                return "dev"
+            head = d.split(".")[0] if d else ""
+            if head == "jnp" or d.startswith("jax.numpy."):
+                tail = d.rsplit(".", 1)[-1]
+                if tail in ("asarray", "array") and call.args and (
+                    a0 != "dev"
+                ):
+                    transfer(call.lineno, "jnp." + tail,
+                             f"jnp.{tail}() uploads a host array",
+                             held)
+                return "dev"
+            if d.startswith("jax.") or head == "jax":
+                return "dev"  # jax.* ops stay device-side
+
+            # ---- np scalar ctors (host-side; upload checked at jits)
+            if head in ("np", "numpy") and d.rsplit(".", 1)[-1] in (
+                _NP_SCALAR_CTORS
+            ):
+                return "host_np"
+
+            # ---- jitted callables
+            if name is not None and self._is_jit_name(m, name):
+                positions = self._donated_positions(m, name)
+                if positions:
+                    apply_donation(call, positions, name, False)
+                for i, t in enumerate(arg_taints):
+                    if t == "host_np":
+                        transfer(
+                            call.lineno, "scalar-upload",
+                            f"np scalar fed to jitted '{name}' "
+                            f"(argument {i}) uploads per call", held,
+                        )
+                return "dev"
+            if isinstance(func, ast.Attribute) and (
+                func.attr.endswith("_jit")
+            ):
+                taint_of(func.value, held)
+                return "dev"
+
+            # ---- the model-predict convention: predict wrappers are
+            # jit-compiled score surfaces returning device labels (the
+            # host-native variants overapproximate to device, which is
+            # the safe direction — their np.asarray is then a no-op)
+            if (name in ("predict", "_predict")) or (
+                isinstance(func, ast.Attribute)
+                and func.attr.endswith("predict")
+            ):
+                if isinstance(func, ast.Attribute):
+                    taint_of(func.value, held)
+                return "dev"
+
+            # ---- project calls: return taint, donation forwarding,
+            # and parameter-taint propagation (constructor calls
+            # resolve to __init__, so a device argument taints the
+            # attribute the ctor stores it in)
+            dev_result = False
+            called = name or _terminal(func)
+            for callee in line_calls.get(call.lineno, ()):
+                cs = self.fn_sync.get(callee)
+                csum = self.lock.summaries.get(callee)
+                if cs is None or csum is None:
+                    continue
+                if csum.name != called and csum.name != "__init__":
+                    continue
+                offset = 1 if csum.cls is not None else 0
+                for i, t in enumerate(arg_taints):
+                    if t != "dev":
+                        continue
+                    slot = self.param_taint.setdefault(callee, set())
+                    if isinstance(call.args[i], ast.Starred):
+                        # *args of a device-tainted container: the
+                        # positional mapping is unknowable — taint
+                        # every callee parameter (how
+                        # _calibrate_tick(*pending) carries the
+                        # previous tick's device pair)
+                        want = set(range(offset,
+                                         len(csum.node.args.args)))
+                    else:
+                        want = {i + offset}
+                    if not want <= slot:
+                        slot |= want
+                        self._pt_dirty = True
+                # keyword arguments flow by name (how
+                # _Pending(idx=idx, X=Xd) carries device handles into
+                # the read object the device stage later converts)
+                callee_params = (csum.node.args.posonlyargs
+                                 + csum.node.args.args
+                                 + csum.node.args.kwonlyargs)
+                for kw in call.keywords:
+                    if kw.arg is None:
+                        continue
+                    if taint_of(kw.value, held) != "dev":
+                        continue
+                    for pi, p in enumerate(callee_params):
+                        if p.arg == kw.arg:
+                            slot = self.param_taint.setdefault(
+                                callee, set()
+                            )
+                            if pi not in slot:
+                                slot.add(pi)
+                                self._pt_dirty = True
+                            break
+                if csum.name != "__init__" and cs.returns_device:
+                    dev_result = True
+                if cs.donates_params:
+                    apply_donation(
+                        call, frozenset(cs.donates_params),
+                        csum.name, csum.cls is not None,
+                    )
+            if dev_result:
+                return "dev"
+            if called in self.pytree_classes:
+                return "dev"  # constructing a device pytree
+
+            # method call on a device value keeps it device-side
+            if isinstance(func, ast.Attribute):
+                if taint_of(func.value, held) == "dev":
+                    return "dev"
+            return None
+
+        def assign_target(t: ast.AST, value_taint: str | None) -> None:
+            if isinstance(t, ast.Name):
+                dead.pop(t.id, None)  # rebinding revives the name
+                tainted.discard(t.id)
+                host_np.discard(t.id)
+                if value_taint == "dev":
+                    tainted.add(t.id)
+                elif value_taint == "host_np":
+                    host_np.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                bnd = binding(t)
+                if bnd is not None:
+                    dead.pop(bnd, None)
+                    if value_taint == "dev" and bnd.startswith("self."):
+                        fs.device_attr_writes.add(t.attr)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    assign_target(e, value_taint)
+            elif isinstance(t, ast.Starred):
+                assign_target(t.value, value_taint)
+
+        def truthiness(test: ast.AST, held: tuple) -> None:
+            t = taint_of(test, held)
+            if t == "dev":
+                sync(test.lineno, "truthiness",
+                     "truth test on a device value", held)
+
+        def visit_stmt(node: ast.AST, held: tuple) -> None:
+            if isinstance(node, ast.ClassDef):
+                return  # nested classes get their own summaries
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def is a closure over the enclosing frame
+                # and runs on the enclosing hot path (watchdog bodies,
+                # worker thunks) — charge its syncs here, with the
+                # enclosing taint env resolving its free variables,
+                # the same inline treatment lambdas already get
+                for child in node.body:
+                    visit_stmt(child, held)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in node.items:
+                    taint_of(item.context_expr, new_held)
+                    key = self.lock._lock_key(item.context_expr, m, cls)
+                    if key is not None:
+                        new_held = new_held + (
+                            (key, item.context_expr.lineno),
+                        )
+                    if item.optional_vars is not None:
+                        assign_target(item.optional_vars, None)
+                for child in node.body:
+                    visit_stmt(child, new_held)
+                return
+            if isinstance(node, ast.Assign):
+                vt = taint_of(node.value, held)
+                for t in node.targets:
+                    assign_target(t, vt)
+                return
+            if isinstance(node, ast.AnnAssign):
+                vt = taint_of(node.value, held) if node.value else None
+                assign_target(node.target, vt)
+                return
+            if isinstance(node, ast.AugAssign):
+                vt = taint_of(node.value, held)
+                tt = taint_of(node.target, held)
+                assign_target(
+                    node.target, "dev" if "dev" in (vt, tt) else vt
+                )
+                return
+            if isinstance(node, ast.Return):
+                if node.value is not None:
+                    if taint_of(node.value, held) == "dev":
+                        fs.returns_device = True
+                return
+            if isinstance(node, (ast.If, ast.While)):
+                truthiness(node.test, held)
+                for child in node.body:
+                    visit_stmt(child, held)
+                for child in node.orelse:
+                    visit_stmt(child, held)
+                return
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                it = taint_of(node.iter, held)
+                if it == "dev":
+                    sync(node.iter.lineno, "iteration",
+                         "for-loop over a device array", held)
+                assign_target(node.target,
+                              "dev" if it == "dev" else None)
+                for child in node.body:
+                    visit_stmt(child, held)
+                for child in node.orelse:
+                    visit_stmt(child, held)
+                return
+            if isinstance(node, ast.Try):
+                for seq in (node.body, node.orelse, node.finalbody):
+                    for child in seq:
+                        visit_stmt(child, held)
+                for h in node.handlers:
+                    for child in h.body:
+                        visit_stmt(child, held)
+                return
+            if isinstance(node, ast.Expr):
+                taint_of(node.value, held)
+                return
+            if isinstance(node, (ast.Assert,)):
+                taint_of(node.test, held)
+                return
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    visit_stmt(child, held)
+                elif isinstance(child, ast.expr):
+                    taint_of(child, held)
+
+        # donation via *parameters*: a param forwarded into a donated
+        # position makes this function donate it on the caller's behalf
+        param_names = {a.arg: i for i, a in enumerate(params)}
+        for child in fn.body:
+            visit_stmt(child, ())
+        # a parameter that ended up in the dead set (donated and never
+        # revived) marks this function as donating it on the caller's
+        # behalf — the caller's argument is dead too
+        for bnd in dead:
+            idx = param_names.get(bnd)
+            if idx is not None:
+                fs.donates_params.add(idx)
+        return fs
+
+    # -- hot-path reachability ----------------------------------------------
+    def _compute_hot(self) -> None:
+        frontier: list[int] = []
+        for key, s in self.lock.summaries.items():
+            if _is_hot_root(s):
+                self.hot[key] = (self._qual(s),)
+                frontier.append(key)
+        while frontier:
+            nxt: list[int] = []
+            for key in frontier:
+                s = self.lock.summaries[key]
+                chain = self.hot[key]
+                for callee, _line, _held in s.calls:
+                    if callee in self.hot:
+                        continue
+                    c = self.lock.summaries.get(callee)
+                    if c is None:
+                        continue
+                    self.hot[callee] = chain + (self._qual(c),)
+                    nxt.append(callee)
+            frontier = nxt
+
+    def reachable_from(self, specs: Sequence[tuple]) -> set[int]:
+        seen: set[int] = set()
+        frontier = [
+            key for key, s in self.lock.summaries.items()
+            if any(_root_match(s, spec) for spec in specs)
+        ]
+        seen.update(frontier)
+        while frontier:
+            nxt = []
+            for key in frontier:
+                for callee, _line, _held in (
+                    self.lock.summaries[key].calls
+                ):
+                    if callee not in seen and (
+                        callee in self.lock.summaries
+                    ):
+                        seen.add(callee)
+                        nxt.append(callee)
+            frontier = nxt
+        return seen
+
+    @staticmethod
+    def _qual(s) -> str:
+        return (f"{s.mod.display_path}::"
+                + (f"{s.cls}." if s.cls else "") + s.name)
+
+    # -- sync closures (for sync-under-lock) --------------------------------
+    def _compute_sync_closures(self) -> None:
+        for key, s in self.lock.summaries.items():
+            own: dict = {}
+            fs = self.fn_sync.get(key)
+            if fs is not None:
+                for ev in fs.events:
+                    own.setdefault(
+                        (s.mod.display_path, ev.line, ev.kind),
+                        [(s.mod.display_path, ev.line,
+                          f"syncs via {ev.kind}")],
+                    )
+            self.sync_closure[key] = own
+        changed = True
+        while changed:
+            changed = False
+            for key, s in self.lock.summaries.items():
+                mine = self.sync_closure[key]
+                for callee, line, _held in s.calls:
+                    sub = self.sync_closure.get(callee)
+                    if not sub:
+                        continue
+                    c = self.lock.summaries[callee]
+                    hop = (s.mod.display_path, line,
+                           f"calls {c.cls + '.' if c.cls else ''}"
+                           f"{c.name}")
+                    for skey, chain in sub.items():
+                        if skey not in mine:
+                            mine[skey] = [hop, *chain]
+                            changed = True
+
+
+_SYNC_CACHE: list[tuple[tuple[int, ...], _SyncAnalysis]] = []
+
+
+def sync_analyze(modules: Sequence[ModuleInfo]) -> _SyncAnalysis:
+    key = tuple(id(m) for m in modules)
+    for k, a in _SYNC_CACHE:
+        if k == key:
+            return a
+    a = _SyncAnalysis(modules)
+    _SYNC_CACHE.append((key, a))
+    del _SYNC_CACHE[:-4]
+    return a
+
+
+def _hot_chain_text(chain: Sequence[str]) -> str:
+    return " -> ".join(chain)
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+
+def _discipline_of(reason: str | None) -> str | None:
+    if not reason:
+        return None
+    for d in DISCIPLINES:
+        if d in reason:
+            return d
+    return None
+
+
+class ImplicitSyncRule(Rule):
+    id = IMPLICIT_SYNC
+    description = (
+        "no device→host sync (np.asarray/.item()/int()/float()/bool()/"
+        "len()/truthiness/iteration on a device value) on a serve "
+        "hot path; allowed seams carry a suppression naming their "
+        "deferral discipline"
+    )
+
+    def check_project(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Finding]:
+        a = sync_analyze(modules)
+        seen: set[tuple] = set()
+        for key, chain in a.hot.items():
+            s = a.lock.summaries[key]
+            fs = a.fn_sync.get(key)
+            if fs is None:
+                continue
+            for ev in fs.events:
+                if ev.rule != IMPLICIT_SYNC:
+                    continue
+                fkey = (s.mod.display_path, ev.line, ev.kind)
+                if fkey in seen:
+                    continue
+                seen.add(fkey)
+                yield self.finding(
+                    _mod_proxy(modules, s.mod.display_path), ev.line,
+                    f"{ev.what} on the serve hot path (hot via "
+                    f"{_hot_chain_text(chain)}) blocks the tick on "
+                    "the device — defer it off the dispatch edge, or "
+                    "allowlist it with a reasoned suppression naming "
+                    f"its discipline ({', '.join(DISCIPLINES)})",
+                )
+        # allowlist policy: a suppression of the sync rules whose
+        # reason names no deferral discipline is a bad suppression —
+        # the allowlist must say HOW the sync stays off the tick, not
+        # just that someone wanted it quiet. Emitted as
+        # bad-suppression, which cannot itself be suppressed.
+        for mod in modules:
+            for s in mod.suppressions.values():
+                if not {IMPLICIT_SYNC, TRANSFER_DISCIPLINE} & set(
+                    s.ids
+                ):
+                    continue
+                if _discipline_of(s.reason) is None:
+                    yield Finding(
+                        BAD_SUPPRESSION, mod.display_path, s.line,
+                        "sync allowlist entry must name its deferral "
+                        "discipline in the reason — one of: "
+                        + ", ".join(DISCIPLINES),
+                    )
+
+
+class TransferDisciplineRule(Rule):
+    id = TRANSFER_DISCIPLINE
+    description = (
+        "no per-tick host→device upload (device_put, jnp.asarray of a "
+        "host value, np scalar fed to a jit) on a serve hot path "
+        "unless routed through a warmup-primed or epoch-cached seam"
+    )
+
+    def check_project(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Finding]:
+        a = sync_analyze(modules)
+        seen: set[tuple] = set()
+        for key, chain in a.hot.items():
+            s = a.lock.summaries[key]
+            fs = a.fn_sync.get(key)
+            if fs is None:
+                continue
+            for ev in fs.events:
+                if ev.rule != TRANSFER_DISCIPLINE:
+                    continue
+                fkey = (s.mod.display_path, ev.line, ev.kind)
+                if fkey in seen:
+                    continue
+                seen.add(fkey)
+                yield self.finding(
+                    _mod_proxy(modules, s.mod.display_path), ev.line,
+                    f"{ev.what} on the serve hot path (hot via "
+                    f"{_hot_chain_text(chain)}): a per-tick upload "
+                    "re-pays the transfer every tick — cache it on "
+                    "device (epoch-cached), prime it at warmup, or "
+                    "allowlist it with a reasoned suppression naming "
+                    "its discipline",
+                )
+
+
+class DonationHazardRule(Rule):
+    id = DONATION_HAZARD
+    description = (
+        "a buffer passed at a donated argument position "
+        "(donate_argnums) is dead — referencing it afterwards reads "
+        "freed device memory; rebind the name from the call's result"
+    )
+
+    def check_project(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Finding]:
+        a = sync_analyze(modules)
+        seen: set[tuple] = set()
+        for key, s in a.lock.summaries.items():
+            fs = a.fn_sync.get(key)
+            if fs is None:
+                continue
+            for don in fs.donations:
+                fkey = (s.mod.display_path, don.use_line, don.name)
+                if fkey in seen:
+                    continue
+                seen.add(fkey)
+                yield self.finding(
+                    _mod_proxy(modules, s.mod.display_path),
+                    don.use_line,
+                    f"'{don.name}' was donated to '{don.callee}' at "
+                    f"line {don.line} (donate_argnums) and referenced "
+                    "again here — donated buffers are dead after the "
+                    "call; use the call's result (the "
+                    "`buf = donated_fn(buf)` idiom) or pass a copy",
+                )
+
+
+class SyncUnderLockRule(Rule):
+    id = SYNC_UNDER_LOCK
+    description = (
+        "no device sync/transfer while holding a project lock "
+        "(directly or transitively): a sync can take arbitrarily long "
+        "on a busy device, wedging every thread that takes that lock"
+    )
+
+    def check_project(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Finding]:
+        a = sync_analyze(modules)
+        seen: set[tuple] = set()
+
+        def emit(path: str, line: int, lock: str, kind: str,
+                 chain: list) -> Iterator[Finding]:
+            fkey = (path, line, lock, kind)
+            if fkey in seen:
+                return
+            seen.add(fkey)
+            yield self.finding(
+                _mod_proxy(modules, path), line,
+                f"device sync/transfer ({kind}) while holding "
+                f"{_short(lock)}: {_chain_text(chain)} — a busy "
+                "device stalls every thread that takes this lock; "
+                "move the sync outside the lock or snapshot under "
+                "the lock and sync outside",
+            )
+
+        for key, s in a.lock.summaries.items():
+            fs = a.fn_sync.get(key)
+            if fs is not None:
+                for ev in fs.events:
+                    for lock, lline in ev.held:
+                        yield from emit(
+                            s.mod.display_path, ev.line, lock, ev.kind,
+                            [(s.mod.display_path, lline,
+                              f"acquires {_short(lock)}"),
+                             (s.mod.display_path, ev.line,
+                              f"syncs via {ev.kind}")],
+                        )
+            for callee, line, held in s.calls:
+                if not held:
+                    continue
+                sub = a.sync_closure.get(callee)
+                if not sub:
+                    continue
+                c = a.lock.summaries[callee]
+                hop = (s.mod.display_path, line,
+                       f"calls {c.cls + '.' if c.cls else ''}{c.name}")
+                for (spath, sline, kind), chain in sub.items():
+                    for lock, lline in held:
+                        yield from emit(
+                            spath, sline, lock, kind,
+                            [(s.mod.display_path, lline,
+                              f"acquires {_short(lock)}"),
+                             hop, *chain],
+                        )
+
+
+GRAFTSYNC_RULES = (
+    ImplicitSyncRule,
+    TransferDisciplineRule,
+    DonationHazardRule,
+    SyncUnderLockRule,
+)
+
+
+# ---------------------------------------------------------------------------
+# the sync-budget export (the artifact + the runtime witness's input)
+# ---------------------------------------------------------------------------
+
+
+BUDGET_SCHEMA_VERSION = 1
+
+
+def _suppression_for(mod: ModuleInfo, line: int) -> tuple | None:
+    """The (discipline, reason) of a sync-rule suppression covering
+    ``line`` (same enclosing-statement widening the framework uses),
+    or None."""
+    end = mod._stmt_end.get(line, line)
+    for ln in range(line, end + 1):
+        s = mod.suppressions.get(ln)
+        if s is None:
+            continue
+        if not {IMPLICIT_SYNC, TRANSFER_DISCIPLINE} & set(s.ids):
+            continue
+        d = _discipline_of(s.reason)
+        if d is not None:
+            return d, s.reason
+    return None
+
+
+def build_sync_report(modules: Sequence[ModuleInfo]) -> dict:
+    """The per-tick expected-sync ledger as a JSON-ready dict:
+    hot-function spans, every allowlisted sync site with its
+    discipline/reason, and the per-serve-path ledgers. Committed as
+    ``docs/artifacts/hot_path_sync_budget.json`` (generated from the
+    repo root) and kept current by a tier-1 test the way
+    ``lock_order_graph.json`` is; ``utils/syncguard.py`` cross-checks
+    observed runtime syncs against it by construction site."""
+    a = sync_analyze(modules)
+    by_path = {m.display_path: m for m in modules}
+
+    hot_functions: dict[str, dict] = {}
+    spans: dict[str, list[list[int]]] = {}
+    for key, chain in sorted(
+        a.hot.items(), key=lambda kv: kv[1]
+    ):
+        s = a.lock.summaries[key]
+        qual = a._qual(s)
+        node = s.node
+        hot_functions[qual] = {
+            "path": s.mod.display_path.replace(os.sep, "/"),
+            "lines": [node.lineno, node.end_lineno or node.lineno],
+            "hot_via": list(chain),
+        }
+        spans.setdefault(
+            s.mod.display_path.replace(os.sep, "/"), []
+        ).append([node.lineno, node.end_lineno or node.lineno])
+    for p in spans:
+        spans[p].sort()
+
+    allowed: list[dict] = []
+    site_index: dict[int, list[str]] = {}  # summary key → its sites
+    for key in a.hot:
+        s = a.lock.summaries[key]
+        fs = a.fn_sync.get(key)
+        mod = by_path.get(s.mod.display_path)
+        if fs is None or mod is None:
+            continue
+        for ev in fs.events:
+            sup = _suppression_for(mod, ev.line)
+            if sup is None:
+                continue
+            site = (f"{s.mod.display_path.replace(os.sep, '/')}"
+                    f":{ev.line}")
+            entry = {
+                "site": site,
+                "rule": ev.rule,
+                "kind": ev.kind,
+                "discipline": sup[0],
+                "reason": sup[1],
+                "function": a._qual(s),
+                "count_per_tick": 1,
+            }
+            if not any(e["site"] == site and e["kind"] == ev.kind
+                       for e in allowed):
+                allowed.append(entry)
+            site_index.setdefault(key, []).append(site)
+    allowed.sort(key=lambda e: (e["site"], e["kind"]))
+
+    serve_paths: dict[str, list[dict]] = {}
+    for path_name, specs in _SERVE_PATH_ROOTS.items():
+        reach = a.reachable_from(specs)
+        ledger: list[dict] = []
+        for key in sorted(reach & set(site_index)):
+            for site in site_index[key]:
+                for e in allowed:
+                    if e["site"] == site and not any(
+                        le["site"] == site and le["kind"] == e["kind"]
+                        for le in ledger
+                    ):
+                        ledger.append({
+                            "site": site,
+                            "kind": e["kind"],
+                            "count_per_tick": e["count_per_tick"],
+                            "reason": e["reason"],
+                        })
+        serve_paths[path_name] = sorted(
+            ledger, key=lambda e: (e["site"], e["kind"])
+        )
+
+    return {
+        "schema_version": BUDGET_SCHEMA_VERSION,
+        "hot_roots": sorted(
+            a._qual(a.lock.summaries[key])
+            for key, chain in a.hot.items() if len(chain) == 1
+        ),
+        "hot_functions": hot_functions,
+        "hot_spans": spans,
+        "allowed_syncs": allowed,
+        "serve_paths": serve_paths,
+        "disciplines": list(DISCIPLINES),
+    }

@@ -31,6 +31,17 @@ framework's layers relies on but Python cannot enforce at runtime:
                       is held wedges every thread that ever takes that lock
 ``thread-lifecycle``  a non-daemon thread with no reachable join outlives
                       the serve; an unretired per-cycle worker is a leak
+``implicit-sync``     a device→host sync (np.asarray/.item()/int()/
+                      truthiness/iteration on a device value) on a serve
+                      hot path blocks the tick; whole-program, see
+                      ``graftsync.py``
+``transfer-discipline``  a per-tick host→device upload re-pays the
+                      transfer every tick unless warmup-primed or
+                      epoch-cached
+``donation-hazard``   a buffer passed at a donated argument position is
+                      dead afterwards; referencing it reads freed memory
+``sync-under-lock``   a device sync while holding a project lock wedges
+                      every thread that takes that lock
 ====================  =====================================================
 
 Rules are deliberately module-local and syntactic (no type inference, no
@@ -43,6 +54,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from collections.abc import Iterator, Sequence
 
 from .framework import Finding, ModuleInfo, Rule, _iter_py_files
@@ -330,11 +342,136 @@ class RetraceHazardRule(Rule):
 # ---------------------------------------------------------------------------
 
 
+# ---- cross-language ABI comparison (ctypes ↔ extern "C") -----------------
+#
+# Width/kind categories. ctypes argument passing is by value, so what
+# matters is pointer-vs-integer-vs-float and the width; const-ness and
+# signedness drift are calling-convention-safe and deliberately NOT
+# flagged (flagging them would teach people to suppress the rule).
+
+_C_TYPE_CATEGORY = {
+    "void": "void",
+    "bool": "i8", "char": "i8", "int8_t": "i8", "uint8_t": "i8",
+    "int16_t": "i16", "uint16_t": "i16", "short": "i16",
+    "int": "i32", "unsigned": "i32", "int32_t": "i32",
+    "uint32_t": "i32",
+    "int64_t": "i64", "uint64_t": "i64", "size_t": "i64",
+    "ssize_t": "i64", "intptr_t": "i64", "uintptr_t": "i64",
+    "float": "f32",
+    "double": "f64",
+}
+
+_PY_CTYPE_CATEGORY = {
+    "c_void_p": "ptr", "c_char_p": "ptr", "c_wchar_p": "ptr",
+    "c_bool": "i8", "c_int8": "i8", "c_uint8": "i8", "c_byte": "i8",
+    "c_ubyte": "i8", "c_char": "i8",
+    "c_int16": "i16", "c_uint16": "i16", "c_short": "i16",
+    "c_ushort": "i16",
+    "c_int": "i32", "c_uint": "i32", "c_int32": "i32",
+    "c_uint32": "i32",
+    "c_int64": "i64", "c_uint64": "i64", "c_size_t": "i64",
+    "c_ssize_t": "i64", "c_longlong": "i64", "c_ulonglong": "i64",
+    "c_float": "f32", "c_double": "f64",
+    # c_long is LP64/LLP64-dependent: never compared
+}
+
+_CFN_RE = re.compile(
+    r"(?m)^\s*((?:const\s+)?[A-Za-z_]\w*(?:\s*\*)*)"  # return type
+    r"\s+([A-Za-z_]\w*)\s*\(([^)]*)\)\s*\{"           # name(params) {
+)
+
+
+def _c_category(decl: str) -> str | None:
+    d = decl.strip()
+    if not d or d == "...":
+        return None
+    if "*" in d:
+        return "ptr"
+    toks = [t for t in d.replace("const", " ").split() if t]
+    if not toks:
+        return None
+    # drop the parameter name when present ("uint32_t capacity")
+    ty = toks[0] if len(toks) == 1 else " ".join(toks[:-1])
+    return _C_TYPE_CATEGORY.get(ty)
+
+
+def _parse_extern_c(text: str) -> dict[str, tuple]:
+    """symbol → (return category, (arg categories...)) for every
+    function defined inside an ``extern "C" { ... }`` region. An
+    unknown type maps to None in its position (skipped in comparison);
+    a symbol defined twice with different shapes is dropped."""
+    out: dict[str, tuple] = {}
+    dropped: set[str] = set()
+    pos = 0
+    while True:
+        m = re.search(r'extern\s+"C"\s*\{', text[pos:])
+        if m is None:
+            break
+        start = pos + m.end()
+        depth = 1
+        i = start
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        region = text[start:i]
+        pos = i
+        for fm in _CFN_RE.finditer(region):
+            ret, name, params = fm.groups()
+            ret_cat = "ptr" if "*" in ret else _C_TYPE_CATEGORY.get(
+                ret.replace("const", " ").strip()
+            )
+            p = params.strip()
+            if p in ("", "void"):
+                args: tuple = ()
+            else:
+                args = tuple(_c_category(a) for a in p.split(","))
+            sig = (ret_cat, args)
+            if name in out and out[name] != sig:
+                dropped.add(name)
+            out[name] = sig
+    for name in dropped:
+        out.pop(name, None)
+    return out
+
+
+_EXTERN_C_CACHE: dict[str, dict[str, tuple]] = {}
+
+
+def _native_symbols(py_path: str) -> dict[str, tuple]:
+    """The union extern-"C" symbol table of every sibling ``*.cpp``
+    of ``py_path`` (symbols are uniquely prefixed per lib, so the
+    union is unambiguous)."""
+    d = os.path.dirname(os.path.realpath(py_path))
+    cached = _EXTERN_C_CACHE.get(d)
+    if cached is not None:
+        return cached
+    table: dict[str, tuple] = {}
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        names = []
+    for n in names:
+        if not n.endswith(".cpp"):
+            continue
+        try:
+            with open(os.path.join(d, n), encoding="utf-8") as f:
+                table.update(_parse_extern_c(f.read()))
+        except OSError:
+            continue
+    _EXTERN_C_CACHE[d] = table
+    return table
+
+
 class CtypesAbiRule(Rule):
     id = "ctypes-abi"
     description = (
         "every symbol called on a LazyLib/CDLL handle needs argtypes AND "
-        "restype declared (defaults truncate 64-bit values silently)"
+        "restype declared (defaults truncate 64-bit values silently), "
+        "and the declaration must match the extern \"C\" definition in "
+        "the sibling .cpp (arity and per-position width/kind)"
     )
 
     _SKIP = {"load"}
@@ -366,6 +503,9 @@ class CtypesAbiRule(Rule):
             return (handle, sym) if per_handle else sym
 
         declared: dict[object, set[str]] = {}
+        # symbol → {"argtypes"/"restype": (value expr, line)} for the
+        # cross-language comparison (C symbols are globally unique)
+        protos: dict[str, dict[str, tuple[ast.AST, int]]] = {}
         called: dict[tuple[object, str], int] = {}
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Assign):
@@ -381,6 +521,9 @@ class CtypesAbiRule(Rule):
                             declared.setdefault(
                                 key(handle, sym), set()
                             ).add(t.attr)
+                            protos.setdefault(sym, {})[t.attr] = (
+                                node.value, t.lineno
+                            )
             elif isinstance(node, ast.Call) and isinstance(
                 node.func, ast.Attribute
             ):
@@ -404,6 +547,101 @@ class CtypesAbiRule(Rule):
                     "assumes C int everywhere, silently truncating "
                     "64-bit pointers/values on LP64",
                 )
+        yield from self._check_cross_language(mod, protos)
+
+    # ---- cross-language: argtypes/restype vs the extern "C" source
+    @staticmethod
+    def _py_category(expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Constant) and expr.value is None:
+            return "void"
+        if isinstance(expr, ast.Call):
+            t = _terminal(expr.func)
+            if t in ("POINTER", "ndpointer"):
+                return "ptr"
+            return None
+        t = _terminal(expr)
+        if t is None:
+            return None
+        return _PY_CTYPE_CATEGORY.get(t)
+
+    @classmethod
+    def _eval_argtypes(cls, expr: ast.AST) -> list | None:
+        """Statically evaluate an argtypes expression to a category
+        list, handling ``[A] + [B] * 8``-style computed lists. None if
+        the shape cannot be evaluated (never guessed)."""
+        if isinstance(expr, (ast.List, ast.Tuple)):
+            return [cls._py_category(e) for e in expr.elts]
+        if isinstance(expr, ast.BinOp):
+            if isinstance(expr.op, ast.Add):
+                left = cls._eval_argtypes(expr.left)
+                right = cls._eval_argtypes(expr.right)
+                if left is None or right is None:
+                    return None
+                return left + right
+            if isinstance(expr.op, ast.Mult):
+                seq, count = expr.left, expr.right
+                if isinstance(seq, ast.Constant):
+                    seq, count = count, seq
+                elems = cls._eval_argtypes(seq)
+                if (
+                    elems is not None
+                    and isinstance(count, ast.Constant)
+                    and isinstance(count.value, int)
+                ):
+                    return elems * count.value
+        return None
+
+    def _check_cross_language(
+        self, mod: ModuleInfo,
+        protos: dict[str, dict[str, tuple[ast.AST, int]]],
+    ) -> Iterator[Finding]:
+        native = _native_symbols(mod.path)
+        if not native:
+            return
+        for sym in sorted(protos):
+            sig = native.get(sym)
+            if sig is None:
+                continue  # not one of ours (dlopen'd elsewhere)
+            c_ret, c_args = sig
+            decls = protos[sym]
+            if "argtypes" in decls:
+                expr, line = decls["argtypes"]
+                py_args = self._eval_argtypes(expr)
+                if py_args is not None:
+                    if len(py_args) != len(c_args):
+                        yield self.finding(
+                            mod, line,
+                            f"CDLL symbol '{sym}': argtypes declares "
+                            f"{len(py_args)} argument(s) but the "
+                            f"extern \"C\" definition takes "
+                            f"{len(c_args)} — arity drift corrupts "
+                            "the stack/registers silently",
+                        )
+                    else:
+                        for i, (p, c) in enumerate(
+                            zip(py_args, c_args)
+                        ):
+                            if p is None or c is None or p == c:
+                                continue
+                            yield self.finding(
+                                mod, line,
+                                f"CDLL symbol '{sym}': argtypes[{i}] "
+                                f"is {p} but the extern \"C\" "
+                                f"definition takes {c} — width/kind "
+                                "mismatch truncates or misreads the "
+                                "value",
+                            )
+            if "restype" in decls:
+                expr, line = decls["restype"]
+                p = self._py_category(expr)
+                if p is not None and c_ret is not None and p != c_ret:
+                    yield self.finding(
+                        mod, line,
+                        f"CDLL symbol '{sym}': restype is {p} but "
+                        f"the extern \"C\" definition returns "
+                        f"{c_ret} — the returned value is truncated "
+                        "or reinterpreted",
+                    )
 
     def _handle_names(self, tree: ast.Module) -> set[str]:
         """Names holding a CDLL handle: the conventional lib/_lib plus
@@ -913,6 +1151,12 @@ from .graftlock import (  # noqa: E402 — graftlock imports framework only
     LockOrderRule,
     ThreadLifecycleRule,
 )
+from .graftsync import (  # noqa: E402 — graftsync imports graftlock only
+    DonationHazardRule,
+    ImplicitSyncRule,
+    SyncUnderLockRule,
+    TransferDisciplineRule,
+)
 
 ALL_RULES = (
     JitPurityRule,
@@ -925,4 +1169,9 @@ ALL_RULES = (
     LockOrderRule,
     BlockingUnderLockRule,
     ThreadLifecycleRule,
+    # graftsync: the whole-program device-boundary pass (graftsync.py)
+    ImplicitSyncRule,
+    TransferDisciplineRule,
+    DonationHazardRule,
+    SyncUnderLockRule,
 )

@@ -696,18 +696,26 @@ def _run_classify(args) -> None:
     # failed restore, an exception before the serve loop's own
     # try/finally — lands in this finally, which uninstalls and
     # reports iff the serve body's finish didn't already run
-    from .utils import locktrace
+    from .utils import locktrace, syncguard
 
     lock_witness = locktrace.maybe_trace_from_env()
+    # device-boundary witness (utils/syncguard.py): TCSDN_SYNCGUARD=1
+    # site-keys every host↔device conversion from here on and checks
+    # it live against the static hot-path sync budget — same
+    # leak-proofing shape as the lock witness above
+    sync_witness = syncguard.maybe_guard_from_env()
     try:
-        _run_classify_armed(args, lock_witness)
+        _run_classify_armed(args, lock_witness, sync_witness)
     finally:
         if (lock_witness is not None
                 and locktrace._installed is lock_witness):
             locktrace.finish(lock_witness)
+        if (sync_witness is not None
+                and syncguard._installed is sync_witness):
+            syncguard.finish(sync_witness)
 
 
-def _run_classify_armed(args, lock_witness) -> None:
+def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
     from .ingest.batcher import FlowStateEngine
     from .models import (
         SUBCOMMAND_ALIASES,
@@ -1169,6 +1177,12 @@ def _run_classify_armed(args, lock_witness) -> None:
             # cross-check before the recorder goes away (violations
             # also land in the ring as locktrace.violation events)
             locktrace.finish(lock_witness, recorder=recorder)
+        if sync_witness is not None:
+            # same for the device-boundary witness: unknown hot-span
+            # syncs land as syncguard.violation events + stderr
+            from .utils import syncguard
+
+            syncguard.finish(sync_witness, recorder=recorder)
         if server is not None:
             server.stop()
         if degrade_surface is not None:
@@ -1308,9 +1322,6 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
     # backpressure; 'off' keeps the serial chain byte-for-byte.
     pipe = None
     feature_stage = None
-    # consecutive render ticks whose idle eviction had to defer — the
-    # bounded catch-up in _dispatch_render keys off it
-    evict_state = {"misses": 0}
     host_busy = host_span = contextlib.nullcontext
     if getattr(args, "pipeline", "off") != "off":
         import functools
@@ -1455,7 +1466,6 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 args, engine, model, predict,
                                 serve_params, m, tracer, pipe,
                                 feature_stage, sharded,
-                                evict_state=evict_state,
                                 degrade=degrade, drift=drift, inc=inc,
                                 lat=lat,
                             )
@@ -1605,7 +1615,7 @@ def _evict_dead_namespaces(tier, engine, m, pipe, recorder,
 
 def _dispatch_render(args, engine, model, predict, serve_params, m,
                      tracer, pipe, feature_stage, sharded,
-                     evict_state=None, degrade=None, drift=None,
+                     degrade=None, drift=None,
                      inc=None, lat=None) -> None:
     """Host-stage half of one pipelined render tick: dispatch the read
     side against THIS tick's table and stage the device-stage job.
@@ -1655,26 +1665,25 @@ def _dispatch_render(args, engine, model, predict, serve_params, m,
         pipe.submit(sharded_job)
         return
     if idle is not None and engine.last_time:
-        if not pipe.idle():
-            # an eviction while a dispatched render is in flight could
-            # release a ranked slot's metadata before the device stage
-            # reads it — defer, and count the deferral
-            m.inc("evict_deferred")
-            if evict_state is not None:
-                evict_state["misses"] += 1
-                if evict_state["misses"] >= 2:
-                    # bounded catch-up: under sustained backpressure
-                    # "defer" must not become "never" (the table would
-                    # fill and drop flows forever) — wait out the
-                    # in-flight render, then reclaim
-                    pipe.drain(timeout=10.0)
-        if pipe.idle():
-            if evict_state is not None:
-                evict_state["misses"] = 0
-            m.inc(
-                "evicted",
-                engine.evict_idle(engine.last_time, idle),
-            )
+        # Whether an eviction is due is decided from DATA time alone
+        # (table state + the capture's last_time), so the stale set is
+        # byte-identical across runs; only WHEN the pipe happens to be
+        # busy is wall-clock. Deciding first and draining only on ticks
+        # that actually evict keeps pipelined output deterministic under
+        # host load — gating the whole pass on pipe.idle() (as this loop
+        # once did) deferred eviction by a tick whenever the render
+        # worker lagged, shifting slot reuse between otherwise identical
+        # runs.
+        stale = engine.stale_slots(engine.last_time, idle)
+        if stale.size:
+            if not pipe.idle():
+                # a released slot's metadata must outlive any render
+                # already in flight — wait it out, then reclaim; the
+                # drain is counted so overlap loss is observable
+                m.inc("evict_deferred")
+                pipe.drain(timeout=10.0)
+            if pipe.idle():
+                m.inc("evicted", engine.evict_slots(stale))
     with tracer.span("dispatch"):
         read = dispatch_read(
             engine, predict, serve_params, args.table_rows,
@@ -1805,9 +1814,13 @@ def _print_table(engine, model, predict, serve_params, args,
         return
     with tracer.span("render"):
         rows = []
-        idx = np.asarray(labels)
-        fwd_active = np.asarray(engine.table.fwd.active)[:-1]
-        rev_active = np.asarray(engine.table.rev.active)[:-1]
+        # one batched device→host fetch where three serial np.asarray
+        # round trips used to block the render one after another
+        idx, fwd_active, rev_active = jax.device_get(
+            (labels, engine.table.fwd.active, engine.table.rev.active)
+        )  # graftlint: disable=implicit-sync -- render-sync: the tick's one batched fetch
+        fwd_active = fwd_active[:-1]
+        rev_active = rev_active[:-1]
         for slot, (src, dst) in sorted(engine.slot_metadata().items()):
             rows.append(
                 (

@@ -554,11 +554,13 @@ class FlowStateEngine(HostSpine):
         """(capacity, 12) device feature matrix (classifier input)."""
         return ft.features12(self.table)
 
-    def evict_idle(self, now: int, idle_seconds: int) -> int:
-        """Release flows with no telemetry in either direction for
-        ``idle_seconds`` — the capacity-reclaim the reference lacks (its
-        ``flows`` dict grows forever, traffic_classifier.py:24). Returns
-        the number of evicted flows."""
+    def stale_slots(self, now: int, idle_seconds: int) -> "np.ndarray":
+        """Slot ids with no telemetry in either direction for
+        ``idle_seconds`` — the decision half of idle eviction, split
+        from the release half so the pipelined serve loop can ask "is
+        an eviction due this tick?" from data time alone (identical
+        across runs) and pay the render drain the release requires
+        only on ticks that actually evict (cli._dispatch_render)."""
         # Flush pending records first: device last_time must be current,
         # and no stale pending row may outlive its slot's eviction (it
         # would scatter into a reassigned slot).
@@ -572,8 +574,19 @@ class FlowStateEngine(HostSpine):
             ),
             count=self.table.capacity + 1,
         ).astype(bool)[:-1]
-        slots = np.nonzero(stale)[0]
+        return np.nonzero(stale)[0]
+
+    def evict_slots(self, slots: "np.ndarray") -> int:
+        """Release an explicit slot batch chosen by ``stale_slots`` —
+        the release half of idle eviction. Returns the evicted count."""
         return self._clear_and_release(slots)
+
+    def evict_idle(self, now: int, idle_seconds: int) -> int:
+        """Release flows with no telemetry in either direction for
+        ``idle_seconds`` — the capacity-reclaim the reference lacks (its
+        ``flows`` dict grows forever, traffic_classifier.py:24). Returns
+        the number of evicted flows."""
+        return self.evict_slots(self.stale_slots(now, idle_seconds))
 
     def _clear_and_release(self, slots: "np.ndarray") -> int:
         """Clear + release an explicit slot batch — the shared device

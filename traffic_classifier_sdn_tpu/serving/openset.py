@@ -212,7 +212,11 @@ class OpenSetGate:
         self._calibrated_at_rows = 0
         # device-path mirrors of the armed stats, cached per epoch so
         # the hot path never re-uploads them tick after tick
-        self._device_stats: tuple | None = None  # (epoch, mean32, inv32)
+        # the epoch tag is held OUTSIDE the device tuple so the hot
+        # path's cache-hit test compares two host ints — never a
+        # device value (graftsync: implicit-sync would flag it)
+        self._device_stats: tuple | None = None  # (mean32, inv32, thr32)
+        self._device_stats_epoch: int | None = None
         # counters / capture (capture is OPT-IN: without a drift
         # controller draining it, holding the last tick's full feature
         # matrix by reference would pin device memory for nothing)
@@ -374,8 +378,12 @@ class OpenSetGate:
         never the labels (they were already produced)."""
         try:
             faults.fault_point("openset.calibrate")
-            Xh = np.asarray(X, np.float64)
-            yh = np.asarray(labels).astype(np.int64).ravel()
+            Xh = np.asarray(
+                X, np.float64
+            )  # graftlint: disable=implicit-sync -- deferred-drain: prior tick's pair, materialized
+            yh = np.asarray(
+                labels
+            ).astype(np.int64).ravel()  # graftlint: disable=implicit-sync -- deferred-drain: prior tick
             yh = yh[: Xh.shape[0]]
             mask = Xh.any(axis=1)
             with self._lock:
@@ -430,6 +438,7 @@ class OpenSetGate:
             # present-class count), but the cached device copies are
             # stale now — the next device tick re-uploads once
             self._device_stats = None
+            self._device_stats_epoch = None
         if self._metrics is not None:
             self._metrics.set("openset_state", STATE_GAUGE[ARMED])
         if self._recorder is not None:
@@ -473,7 +482,9 @@ class OpenSetGate:
     def _apply_host(self, X, labels):
         with self._lock:
             mean, inv_std, thr = self._mean, self._inv_std, self._threshold
-        Xh = np.asarray(X, np.float64)
+        Xh = np.asarray(
+            X, np.float64
+        )  # graftlint: disable=implicit-sync -- host-native: host-mode gate, X is already host
         yh = np.asarray(labels)
         scores = openset_scores(Xh, mean, inv_std)
         active = Xh.any(axis=1)
@@ -501,6 +512,7 @@ class OpenSetGate:
             thr = self._threshold
             epoch = self._epoch
             cached = self._device_stats
+            cached_epoch = self._device_stats_epoch
         if fn is None:
             # mirror of openset_scores, device dtype; the unknown
             # index is a trace-time constant
@@ -524,15 +536,23 @@ class OpenSetGate:
             fn = jax.jit(_reject)
             with self._lock:
                 self._reject_jit = fn
-        if cached is not None and cached[0] == epoch:
-            _e, mean32, inv32, thr32 = cached
+        if cached is not None and cached_epoch == epoch:
+            mean32, inv32, thr32 = cached
         else:
-            mean32 = jnp.asarray(mean, jnp.float32)
-            inv32 = jnp.asarray(inv_std, jnp.float32)
+            # the PR 12 epoch-cached seam: one upload per calibration
+            # epoch, never per tick (re-armed only when _recalibrate
+            # bumps the epoch and clears the cache)
+            mean32 = jnp.asarray(
+                mean, jnp.float32
+            )  # graftlint: disable=transfer-discipline -- epoch-cached: one upload per epoch
+            inv32 = jnp.asarray(
+                inv_std, jnp.float32
+            )  # graftlint: disable=transfer-discipline -- epoch-cached: one upload per epoch
             thr32 = jnp.float32(thr)
             with self._lock:
                 if self._epoch == epoch:
-                    self._device_stats = (epoch, mean32, inv32, thr32)
+                    self._device_stats = (mean32, inv32, thr32)
+                    self._device_stats_epoch = epoch
         out, count = fn(X, labels, mean32, inv32, thr32)
         with self._lock:
             self._pending_count = count
@@ -546,7 +566,8 @@ class OpenSetGate:
         if count is None:
             return
         try:
-            self._note_rejections(int(count))
+            n = int(count)  # graftlint: disable=implicit-sync -- deferred-drain: last tick's count
+            self._note_rejections(n)
         except Exception:  # noqa: BLE001 — a deleted/donated scalar drops the sample
             pass
 

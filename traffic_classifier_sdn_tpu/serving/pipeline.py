@@ -420,7 +420,12 @@ class RankedRead:
         self.n_flows = n_flows
 
     def rows(self) -> list[tuple]:
-        idx, valid, lab, fa, ra = (np.asarray(o) for o in self._outs)
+        # ONE batched device→host fetch: device_get starts every
+        # leaf's copy asynchronously and blocks once, where a
+        # per-array np.asarray loop pays five serial round trips
+        idx, valid, lab, fa, ra = jax.device_get(
+            self._outs
+        )  # graftlint: disable=implicit-sync -- render-sync: the tick's one batched fetch
         return [
             (int(s), int(c), bool(f), bool(r))
             for s, v, c, f, r in zip(idx, valid, lab, fa, ra)
@@ -444,8 +449,12 @@ class NativeRankedRead:
         self.n_flows = n_flows
 
     def rows(self) -> list[tuple]:
-        labels = np.asarray(self._predict(self._params, self._X))
-        idx, valid, fa, ra = (np.asarray(o) for o in self._flags)
+        labels = np.asarray(
+            self._predict(self._params, self._X)
+        )  # graftlint: disable=implicit-sync -- host-native: C++ predict, already host-resident
+        idx, valid, fa, ra = jax.device_get(
+            self._flags
+        )  # graftlint: disable=implicit-sync -- render-sync: the tick's one batched fetch
         return [
             (int(s), int(labels[int(s)]), bool(f), bool(r))
             for s, v, f, r in zip(idx, valid, fa, ra)
@@ -477,11 +486,15 @@ class FullRead:
 
     def rows(self) -> list[tuple]:
         if self._labels is None:
-            labels = np.asarray(self._predict(self._params, self._X))
+            labels_out = self._predict(self._params, self._X)
         else:
-            labels = np.asarray(self._labels)
-        fa = np.asarray(self._fa)
-        ra = np.asarray(self._ra)
+            labels_out = self._labels
+        # device_get passes host-native labels through untouched and
+        # batches the device leaves into one blocking fetch
+        labels, fa, ra = jax.device_get(
+            (labels_out, self._fa, self._ra)
+        )  # graftlint: disable=implicit-sync -- render-sync: the tick's one batched fetch
+        labels = np.asarray(labels)
         return [
             (slot, src, dst, int(labels[slot]), bool(fa[slot]),
              bool(ra[slot]))

@@ -1,0 +1,496 @@
+"""syncguard: a runtime witness for the host↔device boundary.
+
+The static side (``analysis_static/graftsync.py``) proves the serve
+hot paths sync-clean over the calls it can SEE and exports the
+expected-sync ledger (``docs/artifacts/hot_path_sync_budget.json``);
+this module is the dynamic cross-check, in the locktrace mold. Opt-in
+(``TCSDN_SYNCGUARD=1``, or the tier-1 fixture over the
+pipeline/incremental/degrade/drift/openset suites): while installed,
+the process-wide conversion seams are wrapped in site-keyed counting
+shims —
+
+- ``np.asarray`` / ``np.array`` of a ``jax.Array``  → a device→host
+  sync (``kind="np.asarray"``),
+- ``jax.device_get``                                → the batched
+  device→host sync (``kind="device_get"``),
+- ``jnp.asarray`` / ``jnp.array`` of a host value   → a host→device
+  upload (``kind="upload"``),
+- ``jax.device_put``                                → an explicit
+  upload (``kind="device_put"``),
+
+each attributed to its nearest in-scope CALL SITE (``file:line`` — the
+same key the static pass stamps into the budget's ``allowed_syncs``).
+A sync observed inside a static hot span whose site is not on the
+allowlist is a violation: either a hot path regressed, or the static
+resolver has a hole (exactly locktrace's unknown-edge contract).
+Violations land in the flight recorder as ``syncguard.violation``
+events, recorded strictly AFTER the witness's own bookkeeping lock
+(``_meta``, a leaf — never a graftlock lock class) is released.
+
+``jax.transfer_guard`` is armed best-effort on top of the shims when
+``TCSDN_SYNCGUARD_TG`` names a level (``log``/``disallow``): on the
+CPU backend every jnp-of-host op is formally a transfer, so the guard
+is too loud to arm unconditionally under tier-1, but on a real chip
+window ``tools/tpu_day.sh`` can turn it on for free corroboration.
+
+Known blind spot, by construction: C-level scalarization
+(``.item()``, ``int()``/``float()``/``bool()`` via the dunders,
+truthiness, iteration) never routes through a patchable Python
+callable — those seams are covered by the STATIC half only, which is
+why the two halves cross-check by site instead of trusting either
+alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+from .locktrace import _PKG_NAME, _REPO_ROOT, _site_key
+
+DEFAULT_BUDGET_PATH = os.path.join(
+    _REPO_ROOT, "docs", "artifacts", "hot_path_sync_budget.json"
+)
+ENV_FLAG = "TCSDN_SYNCGUARD"
+ENV_TRANSFER_GUARD = "TCSDN_SYNCGUARD_TG"
+
+# device→host kinds vs host→device kinds (report bookkeeping only —
+# the allowlist check keys on site, not kind: a site the static pass
+# blessed for one boundary direction is its seam either way)
+D2H_KINDS = ("np.asarray", "device_get")
+H2D_KINDS = ("upload", "device_put")
+
+
+def _record_violation(recorder, violation: dict) -> None:
+    """Ring-event form of a violation: the recorder's first positional
+    is the EVENT kind, so the sync kind rides as ``sync_kind``."""
+    fields = dict(violation)
+    fields["sync_kind"] = fields.pop("kind")
+    recorder.record("syncguard.violation", **fields)
+
+
+def _default_scope(filename: str) -> bool:
+    norm = filename.replace(os.sep, "/")
+    if norm.endswith("utils/syncguard.py"):
+        return False
+    return f"/{_PKG_NAME}/" in norm or norm.startswith(
+        _PKG_NAME + "/"
+    )
+
+
+class SyncWitness:
+    """Site-keyed sync counts + the live allowlist check."""
+
+    def __init__(self, budget: dict | None = None, recorder=None,
+                 scope=None):
+        self.active = True
+        self.recorder = recorder  # obs.FlightRecorder, attached late
+        self.scope = scope if scope is not None else _default_scope
+        self._meta = threading.Lock()  # leaf: guards the counts only
+        self._local = threading.local()
+        self._counts: dict[str, dict[str, int]] = {}
+        self._violations: list[dict] = []
+        self._flagged: set[str] = set()
+        # parsed budget: hot spans by path + the allowed site set
+        self._spans: dict[str, list[tuple[int, int]]] = {}
+        self._allowed: set[str] = set()
+        if budget is not None:
+            for path, spans in budget.get("hot_spans", {}).items():
+                self._spans[path] = [(int(a), int(b)) for a, b in spans]
+            for entry in budget.get("allowed_syncs", ()):
+                self._allowed.add(entry["site"])
+
+    # -- reentrancy: a shim calling into numpy/jax must not re-count ----
+    def _enter(self) -> bool:
+        if getattr(self._local, "in_shim", False):
+            return False
+        self._local.in_shim = True
+        return True
+
+    def _exit(self) -> None:
+        self._local.in_shim = False
+
+    # -- site attribution ------------------------------------------------
+    def _find_site(self, depth: int = 2) -> str | None:
+        """The IMMEDIATE caller of the patched seam — the syntactic
+        call site the static pass keys. A conversion reached through
+        stdlib, jax-internal, or test frames is deliberately not
+        walked up to the package frame above it: an implicit
+        jit-boundary conversion of a host input is the workload
+        crossing the boundary (transfer-discipline's fresh-data
+        doctrine), not a seam the package wrote — attributing it to
+        the enclosing package line would charge every legitimate
+        dispatch against a site the static pass never keyed."""
+        try:
+            f = sys._getframe(depth)
+        except ValueError:
+            return None
+        fn = f.f_code.co_filename
+        if self.scope(fn):
+            return _site_key(fn, f.f_lineno)
+        return None
+
+    def _split(self, site: str) -> tuple[str, int]:
+        path, _, line = site.rpartition(":")
+        return path, int(line)
+
+    def _in_hot_span(self, path: str, line: int) -> bool:
+        # path-suffix tolerant: the package witness normalizes to
+        # pkg-relative paths (matching a pkg-anchored budget exactly);
+        # a tmp-dir fixture budget keys bare filenames the observed
+        # absolute path must still find
+        for bp, spans in self._spans.items():
+            if path == bp or path.endswith("/" + bp):
+                if any(lo <= line <= hi for lo, hi in spans):
+                    return True
+        return False
+
+    def _site_allowed(self, path: str, line: int) -> bool:
+        for site in self._allowed:
+            ap, al = self._split(site)
+            if al == line and (path == ap or path.endswith("/" + ap)):
+                return True
+        return False
+
+    def note_sync(self, kind: str, site: str | None) -> None:
+        if not self.active or site is None:
+            return
+        path, line = self._split(site)
+        fresh = None
+        with self._meta:
+            per = self._counts.setdefault(site, {})
+            per[kind] = per.get(kind, 0) + 1
+            if (
+                self._spans
+                and site not in self._flagged
+                and self._in_hot_span(path, line)
+                and not self._site_allowed(path, line)
+            ):
+                self._flagged.add(site)
+                fresh = {
+                    "site": site, "kind": kind,
+                    "thread": threading.current_thread().name,
+                }
+                self._violations.append(fresh)
+        recorder = self.recorder
+        if fresh is not None and recorder is not None:
+            # strictly AFTER _meta is released: the recorder's ring
+            # lock is traced project state — the witness stays a leaf
+            _record_violation(recorder, fresh)
+
+    # -- results -----------------------------------------------------------
+    @property
+    def violations(self) -> list[dict]:
+        with self._meta:
+            return list(self._violations)
+
+    def counts(self) -> dict[str, dict[str, int]]:
+        with self._meta:
+            return {s: dict(k) for s, k in self._counts.items()}
+
+    def report(self) -> dict:
+        with self._meta:
+            return {
+                "counts": {
+                    s: dict(k) for s, k in sorted(self._counts.items())
+                },
+                "violations": list(self._violations),
+            }
+
+    def check_against(self, budget: dict | None) -> dict:
+        """Re-run the allowlist check over everything observed —
+        the post-hoc form of the live check, for a budget loaded
+        after the fact (mirrors locktrace ``check_against``)."""
+        if budget is None:
+            return {"unknown_syncs": [], "checked": False}
+        probe = SyncWitness(budget=budget)
+        unknown = []
+        for site, kinds in self.counts().items():
+            path, line = probe._split(site)
+            if probe._in_hot_span(path, line) and not (
+                probe._site_allowed(path, line)
+            ):
+                unknown.append({
+                    "site": site, "kinds": dict(kinds),
+                })
+        return {
+            "unknown_syncs": sorted(unknown, key=lambda u: u["site"]),
+            "checked": True,
+        }
+
+
+# ---------------------------------------------------------------------------
+# installation: patch the conversion seams
+# ---------------------------------------------------------------------------
+
+_installed: SyncWitness | None = None
+_saved: dict[str, object] = {}
+_tg_prev: object | None = None
+
+
+def _jax_bits():
+    import jax
+
+    try:
+        from jax.core import Tracer
+    except ImportError:  # pragma: no cover - jax layout drift
+        from jax._src.core import Tracer
+    return jax, Tracer
+
+
+def install(witness: SyncWitness) -> None:
+    """Monkeypatch the conversion seams with counting shims. The
+    patched functions behave identically (same return, same raise) —
+    the witness only observes."""
+    global _installed
+    if _installed is not None:
+        raise RuntimeError("syncguard already installed")
+    import numpy
+    import jax
+    import jax.numpy as jnp
+
+    _, tracer_cls = _jax_bits()
+    real_np_asarray = numpy.asarray
+    real_np_array = numpy.array
+    real_device_get = jax.device_get
+    real_device_put = jax.device_put
+    real_jnp_asarray = jnp.asarray
+    real_jnp_array = jnp.array
+    _saved.update({
+        "np.asarray": real_np_asarray, "np.array": real_np_array,
+        "device_get": real_device_get, "device_put": real_device_put,
+        "jnp.asarray": real_jnp_asarray, "jnp.array": real_jnp_array,
+    })
+
+    def _note(kind: str) -> None:
+        witness.note_sync(kind, witness._find_site(depth=3))
+
+    def np_asarray(a, *args, **kwargs):
+        if witness._enter():
+            try:
+                if isinstance(a, jax.Array) and not isinstance(
+                    a, tracer_cls
+                ):
+                    _note("np.asarray")
+                return real_np_asarray(a, *args, **kwargs)
+            finally:
+                witness._exit()
+        return real_np_asarray(a, *args, **kwargs)
+
+    def np_array(a, *args, **kwargs):
+        if witness._enter():
+            try:
+                if isinstance(a, jax.Array) and not isinstance(
+                    a, tracer_cls
+                ):
+                    _note("np.asarray")
+                return real_np_array(a, *args, **kwargs)
+            finally:
+                witness._exit()
+        return real_np_array(a, *args, **kwargs)
+
+    def device_get(x):
+        if witness._enter():
+            try:
+                leaves = jax.tree_util.tree_leaves(x)
+                if any(
+                    isinstance(v, jax.Array)
+                    and not isinstance(v, tracer_cls)
+                    for v in leaves
+                ):
+                    _note("device_get")
+                return real_device_get(x)
+            finally:
+                witness._exit()
+        return real_device_get(x)
+
+    def device_put(x, *args, **kwargs):
+        if witness._enter():
+            try:
+                _note("device_put")
+                return real_device_put(x, *args, **kwargs)
+            finally:
+                witness._exit()
+        return real_device_put(x, *args, **kwargs)
+
+    def _upload_shim(real):
+        def shim(a, *args, **kwargs):
+            if witness._enter():
+                try:
+                    if not isinstance(a, (jax.Array, tracer_cls)):
+                        _note("upload")
+                    return real(a, *args, **kwargs)
+                finally:
+                    witness._exit()
+            return real(a, *args, **kwargs)
+        return shim
+
+    numpy.asarray = np_asarray
+    numpy.array = np_array
+    jax.device_get = device_get
+    jax.device_put = device_put
+    jnp.asarray = _upload_shim(real_jnp_asarray)
+    jnp.array = _upload_shim(real_jnp_array)
+    _installed = witness
+    _arm_transfer_guard()
+
+
+def uninstall() -> None:
+    """Restore the real seams; the witness goes inactive so any shim
+    reference still held (a bound import) stops counting."""
+    global _installed
+    if _saved:
+        import numpy
+        import jax
+        import jax.numpy as jnp
+
+        numpy.asarray = _saved["np.asarray"]
+        numpy.array = _saved["np.array"]
+        jax.device_get = _saved["device_get"]
+        jax.device_put = _saved["device_put"]
+        jnp.asarray = _saved["jnp.asarray"]
+        jnp.array = _saved["jnp.array"]
+        _saved.clear()
+    _disarm_transfer_guard()
+    if _installed is not None:
+        _installed.active = False
+    _installed = None
+
+
+def _arm_transfer_guard() -> None:
+    global _tg_prev
+    level = os.environ.get(ENV_TRANSFER_GUARD)
+    if level not in ("log", "disallow"):
+        return
+    try:  # best-effort: config name is jax-version-dependent
+        import jax
+
+        _tg_prev = jax.config.jax_transfer_guard
+        jax.config.update("jax_transfer_guard", level)
+    except Exception:  # noqa: BLE001 — corroboration only, never fatal
+        _tg_prev = None
+
+
+def _disarm_transfer_guard() -> None:
+    global _tg_prev
+    if _tg_prev is None:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_transfer_guard", _tg_prev)
+    except Exception:  # noqa: BLE001
+        pass
+    _tg_prev = None
+
+
+class guarding:
+    """``with guarding(budget) as witness:`` — scoped
+    install/uninstall, the test-fixture idiom."""
+
+    def __init__(self, budget: dict | None = None, recorder=None,
+                 scope=None):
+        self.witness = SyncWitness(budget=budget, recorder=recorder,
+                                   scope=scope)
+
+    def __enter__(self) -> SyncWitness:
+        install(self.witness)
+        return self.witness
+
+    def __exit__(self, *exc) -> bool:
+        uninstall()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# budget loading + the CLI env hook
+# ---------------------------------------------------------------------------
+
+
+def load_budget(path: str | None = None) -> dict | None:
+    """The exported hot-path sync budget, or None when absent (an
+    installed package without the repo's docs tree)."""
+    candidate = path or os.environ.get(
+        "TCSDN_SYNC_BUDGET", DEFAULT_BUDGET_PATH
+    )
+    try:
+        with open(candidate, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def maybe_guard_from_env() -> SyncWitness | None:
+    """CLI hook: install the witness when ``TCSDN_SYNCGUARD=1`` (the
+    chaos-matrix / operator opt-in). Returns the witness, or None when
+    the flag is off or a witness is already installed."""
+    if os.environ.get(ENV_FLAG) != "1" or _installed is not None:
+        return None
+    witness = SyncWitness(budget=load_budget())
+    install(witness)
+    return witness
+
+
+def append_report(witness: SyncWitness, path: str) -> dict:
+    """Accumulate this witness's observations into a JSON report file.
+
+    The chip-day sweep (``tools/tpu_day.sh``) runs the serve suites
+    with one witness per test; this merges them all into one artifact
+    (``hot_path_sync_budget_tpu.json``) — per-site counts summed,
+    violations concatenated, platform stamped from the live backend —
+    so the window lands the OBSERVED sync economy beside the static
+    budget's promised one. Returns the merged report."""
+    from .atomicio import atomic_write_bytes
+
+    merged: dict = {"platform": None, "counts": {}, "violations": []}
+    try:
+        with open(path, encoding="utf-8") as f:
+            prev = json.load(f)
+        merged["counts"] = {
+            s: dict(k) for s, k in prev.get("counts", {}).items()
+        }
+        merged["violations"] = list(prev.get("violations", ()))
+        merged["platform"] = prev.get("platform")
+    except (OSError, ValueError):
+        pass
+    for site, kinds in witness.counts().items():
+        per = merged["counts"].setdefault(site, {})
+        for kind, n in kinds.items():
+            per[kind] = per.get(kind, 0) + n
+    merged["violations"].extend(witness.violations)
+    try:  # stamp the backend the counts were observed on
+        import jax
+
+        merged["platform"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 — report is evidence, never fatal
+        pass
+    atomic_write_bytes(
+        path,
+        (json.dumps(merged, indent=2, sort_keys=True) + "\n").encode(),
+    )
+    return merged
+
+
+def finish(witness: SyncWitness | None, recorder=None) -> dict | None:
+    """CLI teardown: uninstall, surface violations (stderr + the
+    flight recorder) and the budget cross-check. Returns the report."""
+    if witness is None:
+        return None
+    if _installed is witness:
+        uninstall()
+    report = witness.report()
+    report["cross_check"] = witness.check_against(load_budget())
+    for v in report["violations"]:
+        print(
+            f"SYNCGUARD VIOLATION: {v['kind']} at {v['site']} is "
+            "inside a static hot span but not on the allowed-sync "
+            f"ledger (thread {v['thread']})",
+            file=sys.stderr, flush=True,
+        )
+        # live-recorded violations (witness.recorder attached) are
+        # already in the ring — re-recording would duplicate the event
+        if recorder is not None and recorder is not witness.recorder:
+            _record_violation(recorder, v)
+    return report
