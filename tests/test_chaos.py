@@ -1723,3 +1723,109 @@ def test_openset_probabilistic_any_seed_never_fabricates_unknown():
             else:
                 np.testing.assert_array_equal(out[:32], closed[:32])
                 assert (out[32:] == gate.unknown_index).all()
+
+
+# ----------------------------------------- obs.perf_ring / obs.profiler
+
+
+def test_perf_ring_fault_drops_segment_counts_and_continues(tmp_path):
+    """obs.perf_ring fires at the segment-commit seam: that segment's
+    samples are dropped and counted (perf_ring_dropped_segments), the
+    next segment starts clean, every COMMITTED segment stays strictly
+    replayable, and the recording caller — the serve tick — never sees
+    the failure."""
+    from traffic_classifier_sdn_tpu.obs import perf_recorder
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    rec = perf_recorder.PerfRecorder(
+        str(tmp_path), ticks_per_segment=2, keep_segments=16, metrics=m
+    )
+    # commit 1 clean, commits 2-3 fire: two whole segments drop
+    plan = faults.FaultPlan(
+        [faults.FaultRule("obs.perf_ring", after=1, times=2)], SEED
+    )
+    with faults.installed(plan):
+        for tick in range(8):  # 4 segment commits at 2 ticks each
+            rec.record({"tick": tick})
+    assert plan.fires == [("obs.perf_ring", 2), ("obs.perf_ring", 3)]
+    st = rec.status()
+    assert st["segments_committed"] == 2
+    assert st["segments_dropped"] == 2
+    assert int(m.counters["perf_ring_dropped_segments"]) == 2
+    # the survivors replay under the STRICT reader (torn = real bug):
+    # dropped segments consumed their seq numbers but left no file
+    assert [
+        s["tick"] for s in perf_recorder.replay(str(tmp_path))
+    ] == [0, 1, 6, 7]
+
+
+def test_perf_ring_probabilistic_accounting_any_seed(tmp_path):
+    """Probability-scheduled commit failures (any TCSDN_CHAOS_SEED):
+    whatever subset fires, every segment is accounted exactly once —
+    committed + dropped == commit attempts, the plan's fire count
+    reconciles with the dropped counter, and the survivors replay in
+    order."""
+    from traffic_classifier_sdn_tpu.obs import perf_recorder
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    rec = perf_recorder.PerfRecorder(
+        str(tmp_path), ticks_per_segment=2, keep_segments=32, metrics=m
+    )
+    with faults.installed(faults.FaultPlan(
+        [faults.FaultRule("obs.perf_ring", times=None, p=0.4)], SEED
+    )) as plan:
+        for tick in range(20):  # 10 commit attempts
+            rec.record({"tick": tick})
+    fired = len(plan.fires)
+    st = rec.status()
+    assert st["segments_dropped"] == fired
+    assert st["segments_committed"] + st["segments_dropped"] == 10
+    replayed = perf_recorder.replay(str(tmp_path))
+    assert len(replayed) == 2 * st["segments_committed"]
+    ticks = [s["tick"] for s in replayed]
+    assert ticks == sorted(ticks)
+
+
+def test_profiler_fault_500s_counts_and_next_capture_succeeds(tmp_path):
+    """obs.profiler fires inside ProfilerCapture.capture: the /profile
+    request 500s with the error, the failure is counted
+    (profiler_capture_failures), the busy guard releases, and the NEXT
+    capture succeeds — the serve loop is never touched."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from traffic_classifier_sdn_tpu.obs.device import ProfilerCapture
+    from traffic_classifier_sdn_tpu.obs.exposition import (
+        ExpositionServer,
+    )
+    from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    prof = ProfilerCapture(str(tmp_path / "profile"), metrics=m)
+    srv = ExpositionServer(m, port=0, host="127.0.0.1", profiler=prof)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    plan = faults.FaultPlan(
+        [faults.FaultRule("obs.profiler", times=1)], SEED
+    )
+    try:
+        with faults.installed(plan):
+            with pytest.raises(urllib.error.HTTPError) as e500:
+                urllib.request.urlopen(base + "/profile?seconds=0.05")
+            assert e500.value.code == 500
+            assert plan.fires == [("obs.profiler", 1)]
+            assert int(m.counters["profiler_capture_failures"]) == 1
+            # busy guard released: the retry captures a real trace
+            out = _json.loads(urllib.request.urlopen(
+                base + "/profile?seconds=0.05"
+            ).read())
+            assert out["seconds"] == 0.05
+            assert int(m.counters["profiler_captures"]) == 1
+    finally:
+        srv.stop()
+    st = prof.status()
+    assert st["failures"] == 1 and st["captures"] == 1
+    assert st["active"] is False

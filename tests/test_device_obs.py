@@ -1,0 +1,259 @@
+"""Device-runtime telemetry plane (obs/device.py + obs/perf_recorder.py):
+the edge-triggered retrace contract (exactly once per novel shape after
+warmup, zero on warmed serve paths), black-box perf-ring durability
+under kill -9 mid-rotation, ring-vs-latency-plane reconciliation, and
+the CLI byte-transparency pin for --device-obs on vs off.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.obs import DeviceTelemetry, FlightRecorder
+from traffic_classifier_sdn_tpu.obs.perf_recorder import (
+    PerfRecorder,
+    replay,
+    segment_files,
+)
+from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+
+# ---------------------------------------------------------------------------
+# retrace edge semantics
+
+
+def test_retrace_fires_exactly_once_per_novel_shape_after_warmup():
+    import jax
+    import jax.numpy as jnp
+
+    m = Metrics()
+    rec = FlightRecorder()
+    fn = jax.jit(lambda x: x * 2.0 + 1.0)
+    with DeviceTelemetry(metrics=m, recorder=rec) as dev:
+        # pre-build EVERY input while still inside warmup: constructing
+        # a jnp array compiles its own fill program, which would
+        # otherwise register as an honest-but-distracting extra retrace
+        x8 = jnp.ones(8)
+        x16 = jnp.arange(16.0)
+        x16b = jnp.zeros(16)
+        x24 = jnp.ones(24)
+        jax.block_until_ready(fn(x8))
+        dev.mark_warmup_complete()
+        jax.block_until_ready(fn(x8))    # warmed shape: cache hit
+        assert int(m.counters.get("retraces_after_warmup", 0)) == 0
+        jax.block_until_ready(fn(x16))   # novel shape: exactly one
+        assert int(m.counters["retraces_after_warmup"]) == 1
+        jax.block_until_ready(fn(x16))   # now cached
+        jax.block_until_ready(fn(x16b))  # same shape, distinct array
+        assert int(m.counters["retraces_after_warmup"]) == 1
+        jax.block_until_ready(fn(x24))   # second novel shape
+        assert int(m.counters["retraces_after_warmup"]) == 2
+        assert dev.status()["retraces_after_warmup"] == 2
+    events = [
+        e for e in rec.tail(4096) if e.get("kind") == "device.retrace"
+    ]
+    assert len(events) == 2
+    compiles_after_warm = [
+        e for e in rec.tail(4096)
+        if e.get("kind") == "device.compile" and e["after_warmup"]
+    ]
+    assert len(compiles_after_warm) == 2
+    # detached: further compiles are invisible to this telemetry
+    before = int(m.counters["jit_compiles"])
+    import jax.numpy as jnp2  # noqa: F401
+
+    jax.block_until_ready(jax.jit(lambda x: x - 3.0)(x8))
+    assert int(m.counters["jit_compiles"]) == before
+
+
+# ---------------------------------------------------------------------------
+# CLI serve harness (the test_latency.py idiom)
+
+
+@pytest.fixture(scope="module")
+def capture_file(tmp_path_factory):
+    from traffic_classifier_sdn_tpu.ingest.protocol import format_line
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+
+    path = tmp_path_factory.mktemp("dev_cap") / "capture.tsv"
+    syn = SyntheticFlows(n_flows=12, seed=11)
+    with open(path, "wb") as f:
+        for _ in range(12):
+            for r in syn.tick():
+                f.write(format_line(r))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def gnb_checkpoint(tmp_path_factory):
+    from traffic_classifier_sdn_tpu.io.checkpoint import save_model
+    from traffic_classifier_sdn_tpu.models import gnb
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (4, 12)),
+        "var": rng.gamma(2.0, 50.0, (4, 12)) + 1.0,
+        "class_prior": np.full(4, 0.25),
+    })
+    path = str(tmp_path_factory.mktemp("dev_model") / "gnb")
+    save_model(path, "gnb", params, ["dns", "ping", "telnet", "voice"])
+    return path
+
+
+def _serve_stdout(capsys, capture_file, gnb_checkpoint, *extra):
+    from traffic_classifier_sdn_tpu import cli
+
+    capsys.readouterr()
+    cli.main([
+        "gaussiannb", "--source", "replay", "--capture", capture_file,
+        "--native-checkpoint", gnb_checkpoint, "--capacity", "64",
+        "--print-every", "3", "--max-ticks", "12", *extra,
+    ])
+    return capsys.readouterr().out
+
+
+@pytest.mark.parametrize("incremental", ["auto", "off"])
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+def test_warmed_serve_trips_zero_retraces(
+    capsys, capture_file, gnb_checkpoint, tmp_path, pipeline,
+    incremental
+):
+    """The serve-path hygiene pin: with --warmup, NO jit compile fires
+    once the serve loop starts — serial and pipelined, with and
+    without the incremental label cache. A regression that
+    reintroduces per-tick retraces fails here, not in a chip-day
+    bench."""
+    from traffic_classifier_sdn_tpu.utils.metrics import global_metrics
+
+    _serve_stdout(
+        capsys, capture_file, gnb_checkpoint,
+        "--pipeline", pipeline, "--incremental", incremental,
+        "--warmup", "--obs-dir", str(tmp_path / "obs"),
+    )
+    assert int(
+        global_metrics.counters.get("retraces_after_warmup", 0)
+    ) == 0
+    # the plane was armed: the wire-donation probe only runs when the
+    # cli handed the engine a DeviceTelemetry (jit_compiles can be
+    # legitimately 0 here — later parametrizations inherit the
+    # process-wide jit cache)
+    assert "donation_expected_wire" in global_metrics.counters
+
+
+# ---------------------------------------------------------------------------
+# perf-ring durability
+
+
+def test_perf_ring_survives_kill_nine_mid_rotation(tmp_path):
+    """The black-box contract: SIGKILL mid-rotation loses at most the
+    uncommitted buffer — every committed segment replays under the
+    STRICT reader, seqs stay monotonic, and a restarted recorder
+    sweeps stale tmps and resumes numbering ABOVE the survivors."""
+    import traffic_classifier_sdn_tpu
+
+    root = os.path.dirname(
+        os.path.dirname(os.path.abspath(traffic_classifier_sdn_tpu.__file__))
+    )
+    ring = tmp_path / "perf"
+    child = (
+        "import sys\n"
+        f"sys.path.insert(0, {root!r})\n"
+        "from traffic_classifier_sdn_tpu.obs.perf_recorder import "
+        "PerfRecorder\n"
+        f"rec = PerfRecorder({str(ring)!r}, ticks_per_segment=4, "
+        "keep_segments=64)\n"
+        "i = 0\n"
+        "while True:\n"
+        "    rec.record({'tick': i})\n"
+        "    i += 1\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child], stderr=subprocess.PIPE,
+    )
+    try:
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "child died on its own: "
+                    + proc.stderr.read().decode()
+                )
+            if len(segment_files(str(ring))) >= 3:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("child never committed 3 segments")
+    finally:
+        proc.kill()  # SIGKILL: no flush, no atexit, no cooperation
+        proc.wait()
+    seqs = [seq for seq, _ in segment_files(str(ring))]
+    assert len(seqs) >= 3 and seqs == sorted(seqs)
+    samples = replay(str(ring))  # strict: a torn segment would raise
+    ticks = [s["tick"] for s in samples]
+    assert ticks == sorted(ticks) and len(ticks) == 4 * len(seqs)
+    # plant a mid-write victim; the restarted recorder must sweep it
+    # and resume seq numbering above the survivors
+    stale = ring / ".perf-99999999.jsonl.tmp.123"
+    stale.write_bytes(b"torn garbage")
+    rec2 = PerfRecorder(str(ring), ticks_per_segment=4,
+                        keep_segments=64)
+    assert not stale.exists()
+    for i in range(4):
+        rec2.record({"tick": 10_000 + i})
+    new_seqs = [seq for seq, _ in segment_files(str(ring))]
+    assert new_seqs[-1] > seqs[-1]
+    assert replay(str(ring))[-1]["tick"] == 10_003
+
+
+def test_perf_ring_last_segment_reconciles_with_latency_plane(
+    capsys, capture_file, gnb_checkpoint, tmp_path
+):
+    """The two planes must tell one story: the ring's per-tick
+    stage_tick_s samples and the tracer's stage_tick_s histogram are
+    fed by the same spans, so their p50s reconcile within 10% — if
+    they ever diverge, one plane is lying and the post-mortem built on
+    it is fiction."""
+    from traffic_classifier_sdn_tpu.utils.metrics import global_metrics
+
+    obs = tmp_path / "obs"
+    _serve_stdout(
+        capsys, capture_file, gnb_checkpoint,
+        "--warmup", "--obs-dir", str(obs), "--perf-ring-ticks", "4",
+    )
+    samples = [
+        s for s in replay(str(obs / "perf")) if "stage_tick_s" in s
+    ]
+    assert len(samples) == 12  # one per tick, every segment committed
+    ring_p50 = float(np.median([s["stage_tick_s"] for s in samples]))
+    plane_p50 = global_metrics.snapshot()["stage_tick_s_p50"]
+    assert ring_p50 > 0 and plane_p50 > 0
+    assert abs(ring_p50 - plane_p50) <= 0.10 * plane_p50
+
+
+# ---------------------------------------------------------------------------
+# byte transparency
+
+
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+def test_render_byte_identical_device_obs_on_vs_off(
+    capsys, capture_file, gnb_checkpoint, tmp_path, pipeline
+):
+    """The acceptance pin: the device plane observes, never perturbs —
+    rendered stdout is byte-identical with --device-obs auto vs off,
+    serial and pipelined."""
+    on = _serve_stdout(
+        capsys, capture_file, gnb_checkpoint,
+        "--pipeline", pipeline, "--obs-dir", str(tmp_path / "on"),
+        "--device-obs", "auto",
+    )
+    off = _serve_stdout(
+        capsys, capture_file, gnb_checkpoint,
+        "--pipeline", pipeline, "--obs-dir", str(tmp_path / "off"),
+        "--device-obs", "off",
+    )
+    assert on == off
+    assert on.count("+") > 0  # sanity: tables actually rendered

@@ -63,6 +63,7 @@ def _serve_budget(args) -> None:
     from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
     from traffic_classifier_sdn_tpu.models import gnb, jit_serving_fn
     from traffic_classifier_sdn_tpu.native import engine as native_engine
+    from traffic_classifier_sdn_tpu.obs.device import DeviceTelemetry
     from traffic_classifier_sdn_tpu.serving.warmup import warmup_serving
 
     print("# initializing devices", file=sys.stderr, flush=True)
@@ -70,6 +71,13 @@ def _serve_budget(args) -> None:
     print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
     if not native_engine.available():
         sys.exit("--serve-budget needs the C++ engine (g++)")
+
+    # Compile hygiene: this path warms explicitly, so a compile inside
+    # either measured loop means the budget timed XLA — hard-gated
+    # below (the tail still lands first).
+    dev = DeviceTelemetry()
+    dev.attach()
+    warm_marked = False
 
     rng = np.random.RandomState(0)
     params = gnb.from_numpy({
@@ -94,6 +102,11 @@ def _serve_budget(args) -> None:
         eng.ingest_bytes(fill)
         eng.step()
         jax.block_until_ready(eng.table)
+        if not warm_marked:
+            # the python mode reuses the native mode's jit caches, so
+            # one mark covers both measured loops
+            dev.mark_warmup_complete()
+            warm_marked = True
         timings = {k: [] for k in ("ingest", "step", "predict",
                                    "render", "tick")}
         rows_per_tick = []
@@ -172,10 +185,20 @@ def _serve_budget(args) -> None:
             ratio is not None and ratio <= 5.0
         ),
         "render_identical": render_identical,
+        "jit_compiles": dev.status()["jit_compiles"],
+        "retraces_after_warmup": dev.status()["retraces_after_warmup"],
     }
     print(json.dumps(out), flush=True)
     if not render_identical:
         sys.exit("FAIL: native vs python rendered rows diverged")
+    retraces = dev.status()["retraces_after_warmup"]
+    if retraces:
+        sys.exit(
+            f"FAIL: {retraces} compile(s) fired inside the measured "
+            "region after warmup — the budget timed XLA, not the "
+            "serve path (program: "
+            f"{dev.status()['last_compile_program']})"
+        )
 
 
 def _sync_scalar(x) -> float:
@@ -239,6 +262,14 @@ def main() -> None:
     print("# initializing devices", file=sys.stderr, flush=True)
     platform = jax.devices()[0].platform
     print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
+
+    # totals only here: the slice budget's stages each warm themselves
+    # inline (_median_time), so there is no single warm boundary to
+    # gate on — the count still lands in the artifact
+    from traffic_classifier_sdn_tpu.obs.device import DeviceTelemetry
+
+    dev = DeviceTelemetry()
+    dev.attach()
 
     models_dir = os.environ.get("TCSDN_MODELS_DIR", "/root/reference/models")
     g = tree_gemm.compile_forest(
@@ -365,6 +396,7 @@ def main() -> None:
             f"~{colocated_ms:.2f} ms per 16k slice"
         ),
         "native_ingest": native,
+        "jit_compiles": dev.status()["jit_compiles"],
     }
     print(json.dumps(line), flush=True)
 

@@ -414,7 +414,7 @@ def _mode_summary(args, runs, n_flows_per_tick):
 
 
 def _run_sweep(args, native, predict, params, raw_fn,
-               n_flows: int) -> None:
+               n_flows: int, dev=None) -> None:
     """The dirty sweep (docs/artifacts/serve_dirty_sweep_cpu.json): per
     churn level, A/B incremental vs full re-predict over IDENTICAL
     payloads with the median-of-interleaved-repeats machinery, assert
@@ -524,13 +524,20 @@ def _run_sweep(args, native, predict, params, raw_fn,
         **({"shards": args.shards} if args.shards >= 1 else {}),
         "platform": jax.devices()[0].platform,
         "warmup": args.warmup,
+        # totals only: levels interleave compiles by design (fresh
+        # engines per level share jit caches), so a per-region gate
+        # would be noise here — the single-measurement path gates
+        **(
+            {"jit_compiles": dev.status()["jit_compiles"]}
+            if dev is not None else {}
+        ),
         "levels": out_levels,
     }
     print(json.dumps(out), flush=True)
 
 
 def _run_fanin_sweep(args, native, predict, params,
-                     n_flows: int) -> None:
+                     n_flows: int, dev=None) -> None:
     """The fan-in source sweep (docs/artifacts/serve_fanin_sources_cpu
     .json): for each source count N, drive the REAL fan-in tier
     (ingest/fanin.py — per-source pump threads, the bounded MPSC queue,
@@ -703,6 +710,10 @@ def _run_fanin_sweep(args, native, predict, params,
             out_levels and holding
             and knee == out_levels[-1]["sources"]
         ),
+        **(
+            {"jit_compiles": dev.status()["jit_compiles"]}
+            if dev is not None else {}
+        ),
         "levels": out_levels,
     }
     print(json.dumps(out), flush=True)
@@ -864,14 +875,26 @@ def main() -> None:
     print("# initializing devices", file=sys.stderr, flush=True)
     print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
 
+    # Compile hygiene: every bench tail carries the jit-compile count so
+    # a regression that reintroduces per-tick retraces is visible in the
+    # artifact, not just as slower numbers. With --warmup the main path
+    # is a hard gate — a compile inside the measured region exits
+    # nonzero (the bench measured XLA, not the serve loop).
+    from traffic_classifier_sdn_tpu.obs.device import DeviceTelemetry
+
+    dev = DeviceTelemetry()
+    dev.attach()
+
     predict, params, raw_fn = _build_model(args)
 
     if args.sources_sweep is not None:
-        _run_fanin_sweep(args, native, predict, params, n_flows)
+        _run_fanin_sweep(args, native, predict, params, n_flows,
+                         dev=dev)
         return
 
     if args.churn_sweep is not None:
-        _run_sweep(args, native, predict, params, raw_fn, n_flows)
+        _run_sweep(args, native, predict, params, raw_fn, n_flows,
+                   dev=dev)
         return
 
     syn = SyntheticFlows(
@@ -968,6 +991,9 @@ def main() -> None:
             eng.mark_tick()
             eng.ingest_bytes(fill_payload)
             eng.step()
+    if args.warmup:
+        dev.mark_warmup_complete()
+    compiles_at_measure = dev.status()["jit_compiles"]
     runs: dict = {name: [] for name in mode_names}
     for rep, chunk in enumerate(payload_chunks):
         for name, pipelined, _inc_flag in modes:
@@ -982,6 +1008,10 @@ def main() -> None:
         name: _mode_summary(args, runs[name], n_flows)
         for name in mode_names
     }
+    dev_status = dev.status()
+    compiles_in_measured = (
+        dev_status["jit_compiles"] - compiles_at_measure
+    )
 
     eng = engines[mode_names[-1]]
     # Per-tick host->device wire bytes actually moved for the update
@@ -1023,6 +1053,9 @@ def main() -> None:
         "churn_fraction": args.churn_fraction,
         "incremental_mode": args.incremental,
         "warmup": args.warmup,
+        "jit_compiles": dev_status["jit_compiles"],
+        "retraces_after_warmup": dev_status["retraces_after_warmup"],
+        "compiles_in_measured_region": compiles_in_measured,
     }
 
     if args.pipeline == "both":
@@ -1067,6 +1100,13 @@ def main() -> None:
             **common,
         }
     print(json.dumps(out), flush=True)
+    if args.warmup and compiles_in_measured > 0:
+        sys.exit(
+            f"FAIL: {compiles_in_measured} compile(s) fired inside "
+            "the measured region despite --warmup — the bench timed "
+            "XLA, not the serve loop (program: "
+            f"{dev_status['last_compile_program']})"
+        )
 
 
 if __name__ == "__main__":

@@ -63,6 +63,7 @@ GROUPS_KEYS=(
   "fanin:fanin_put or fanin_source_dead"
   "native_ingest:native_parse"
   "obs:obs_stamp or sigusr1"
+  "obsdev:perf_ring or profiler"
   "openset:openset_score or openset_calibrate or openset_rebase or openset_probabilistic"
 )
 

@@ -1,10 +1,11 @@
 #!/bin/sh
 # Land every TPU-bound measurement in one pass (run when the chip is up):
 #   1. quick liveness probe (exits 1 fast if the worker is wedged)
-#   2. serve bench on TPU   -> docs/artifacts/serve_2m_tpu.json
-#   3. tools/bench_e2e.py   -> docs/artifacts/e2e_budget_tpu.json
-#   4. bench.py             -> docs/artifacts/bench_tpu_r05.{json,log}
-#   5. tools/tpu_proof.py   -> docs/artifacts/tpu_proof.json
+#   2. tools/tpu_doctor.py  -> docs/artifacts/tpu_doctor_tpu.json
+#   3. serve bench on TPU   -> docs/artifacts/serve_2m_tpu.json
+#   4. tools/bench_e2e.py   -> docs/artifacts/e2e_budget_tpu.json
+#   5. bench.py             -> docs/artifacts/bench_tpu_r05.{json,log}
+#   6. tools/tpu_proof.py   -> docs/artifacts/tpu_proof.json
 # Order is risk-ascending: the serve tick and e2e budget use short
 # kernels and land the scarcest artifacts first; the bench ladder's
 # 1M-row kernels and the Mosaic compiles in the proof have wedged the
@@ -45,6 +46,16 @@ run_step() {
     fi
   fi
 }
+
+# preflight doctor FIRST: ~30 s of instrumented micro-serve answering
+# "is this window worth spending?" — platform identity, compile-time
+# budget, zero-retrace hygiene, HBM headroom, transfer counts vs the
+# static sync ledger, tick cadence. The doctor writes its own bundle
+# atomically via --out, so the pass/fail evidence lands even when a
+# later stage wedges the worker and the suite aborts.
+run_step 300 /tmp/tpu_day_doctor.log python tools/tpu_doctor.py \
+  --platform default --expect tpu \
+  --out docs/artifacts/tpu_doctor_tpu.json
 
 run_step 1200 /tmp/tpu_day_serve.log python tools/bench_serve.py \
   --platform default --model forest --ticks 6

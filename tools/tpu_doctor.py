@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""Chip-window preflight doctor: a ~30 s instrumented micro-serve that
+answers "is this window worth spending?" BEFORE tpu_day.sh burns it.
+
+Five checks, each an independent pass/fail/skip row in one atomically
+written JSON bundle (--out — the bundle lands even on a failing
+verdict, so a dead chip still leaves evidence of HOW it was dead):
+
+  platform   — the backend jax actually initialized vs --expect
+               (a silently-CPU "TPU window" is the classic wasted day)
+  compile    — total XLA compile seconds for the full warm serve set
+               under --compile-budget-s (a wedged worker compiles
+               forever; a cold cache on a short window is a choice the
+               operator should make knowingly)
+  retrace    — ZERO compiles once the measured ticks start; a retrace
+               here means shape instability would poison every bench
+               downstream (obs/device.py edge-triggered accounting)
+  hbm        — device memory headroom after the table fill vs
+               --hbm-headroom (skip-with-note where memory_stats() is
+               unavailable, e.g. CPU)
+  transfers  — the runtime sync witness (utils/syncguard.py) armed
+               over the measured ticks, cross-checked against the
+               static ledger docs/artifacts/hot_path_sync_budget.json:
+               any hot-span sync off the allowlist fails
+  cadence    — measured tick p50 under --cadence-budget-s (the 1 s
+               render cadence the serve loop promises)
+
+Exit 0 iff every non-skip check passed. tools/tpu_day.sh runs this
+first; docs/artifacts/tpu_doctor_cpu.json is the committed CPU run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _check(checks: list, cid: str, status: str, detail: str,
+           **fields) -> None:
+    checks.append({"id": cid, "status": status, "detail": detail,
+                   **fields})
+    print(f"# doctor {cid}: {status} — {detail}",
+          file=sys.stderr, flush=True)
+
+
+def run_doctor(args) -> dict:
+    import numpy as np
+
+    import jax
+
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+    from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+    from traffic_classifier_sdn_tpu.models import gnb, jit_serving_fn
+    from traffic_classifier_sdn_tpu.obs.device import DeviceTelemetry
+    from traffic_classifier_sdn_tpu.serving.incremental import (
+        IncrementalLabels,
+    )
+    from traffic_classifier_sdn_tpu.serving.warmup import warmup_serving
+    from traffic_classifier_sdn_tpu.utils import syncguard
+
+    checks: list = []
+    dev = DeviceTelemetry()
+    dev.attach()
+
+    # -- platform ---------------------------------------------------------
+    platform = jax.devices()[0].platform
+    print(f"# devices: {jax.devices()}", file=sys.stderr, flush=True)
+    if args.expect == "any":
+        _check(checks, "platform", "pass",
+               f"platform={platform} (no expectation set)",
+               platform=platform)
+    elif platform == args.expect:
+        _check(checks, "platform", "pass",
+               f"platform={platform} as expected", platform=platform)
+    else:
+        _check(checks, "platform", "fail",
+               f"expected platform={args.expect}, got {platform} — "
+               "the window would measure the wrong backend",
+               platform=platform, expected=args.expect)
+
+    # -- compile budget: warm the whole serve set, timed ------------------
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (6, 12)),
+        "var": rng.gamma(2.0, 50.0, (6, 12)) + 1.0,
+        "class_prior": np.full(6, 1 / 6),
+    })
+    predict = jit_serving_fn(gnb.predict)
+    eng = FlowStateEngine(capacity=args.capacity, track_dirty=True)
+    t0 = time.perf_counter()
+    warmup_serving(eng, predict, params, table_rows=args.table_rows,
+                   idle_timeout=3600, incremental=True)
+    warm_wall = time.perf_counter() - t0
+    st = dev.status()
+    compile_s = st["jit_compile_s_total"]
+    if compile_s <= args.compile_budget_s:
+        _check(checks, "compile", "pass",
+               f"{st['jit_compiles']} compiles, "
+               f"{compile_s:.2f}s XLA time (warm wall "
+               f"{warm_wall:.2f}s) within {args.compile_budget_s}s",
+               jit_compiles=st["jit_compiles"],
+               compile_s=round(compile_s, 3),
+               warm_wall_s=round(warm_wall, 3))
+    else:
+        _check(checks, "compile", "fail",
+               f"{compile_s:.2f}s XLA compile time exceeds the "
+               f"{args.compile_budget_s}s budget — worker wedge or "
+               "pathological cache miss",
+               jit_compiles=st["jit_compiles"],
+               compile_s=round(compile_s, 3),
+               warm_wall_s=round(warm_wall, 3))
+
+    # -- measured micro-serve: retrace + transfers + cadence --------------
+    syn = SyntheticFlows(n_flows=args.flows_per_tick, seed=0)
+    fill = syn.tick_bytes()
+    payloads = [syn.tick_bytes() for _ in range(args.ticks)]
+    inc = IncrementalLabels(eng, predict, params)
+    eng.mark_tick()
+    eng.ingest_bytes(fill)
+    eng.step()
+    jax.block_until_ready(inc.labels())
+    dev.mark_warmup_complete()
+    budget = syncguard.load_budget()
+    tick_walls = []
+    with syncguard.guarding(budget=budget) as witness:
+        for payload in payloads:
+            t0 = time.perf_counter()
+            eng.mark_tick()
+            eng.ingest_bytes(payload)
+            eng.step()
+            labels = inc.labels()
+            jax.block_until_ready(labels)
+            eng.render_sample(labels, args.table_rows)
+            eng.evict_idle(now=eng.last_time, idle_seconds=3600)
+            tick_walls.append(time.perf_counter() - t0)
+    devs = dev.sample()
+
+    retraces = devs["retraces_after_warmup"]
+    if retraces == 0:
+        _check(checks, "retrace", "pass",
+               f"0 compiles across {args.ticks} measured ticks",
+               retraces_after_warmup=0)
+    else:
+        _check(checks, "retrace", "fail",
+               f"{retraces} compile(s) fired inside the measured "
+               "ticks (last program: "
+               f"{dev.status()['last_compile_program']}) — shape "
+               "instability would poison every downstream bench",
+               retraces_after_warmup=retraces)
+
+    # -- hbm headroom -----------------------------------------------------
+    stats = None
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    in_use = (stats or {}).get("bytes_in_use")
+    limit = (stats or {}).get("bytes_limit")
+    if in_use is None or not limit:
+        _check(checks, "hbm", "skip",
+               f"memory_stats() unavailable on platform={platform} — "
+               "headroom unverifiable here, not a failure",
+               hbm_bytes=devs["hbm_bytes"])
+    else:
+        headroom = 1.0 - in_use / limit
+        row = {
+            "bytes_in_use": int(in_use), "bytes_limit": int(limit),
+            "headroom_fraction": round(headroom, 4),
+        }
+        if headroom >= args.hbm_headroom:
+            _check(checks, "hbm", "pass",
+                   f"{headroom:.1%} HBM free after table fill "
+                   f"(floor {args.hbm_headroom:.0%})", **row)
+        else:
+            _check(checks, "hbm", "fail",
+                   f"only {headroom:.1%} HBM free after table fill — "
+                   "the 2^20 table or a leak would OOM mid-window",
+                   **row)
+
+    # -- transfers vs the static ledger -----------------------------------
+    if budget is None:
+        _check(checks, "transfers", "skip",
+               "docs/artifacts/hot_path_sync_budget.json missing — "
+               "run `python -m traffic_classifier_sdn_tpu."
+               "analysis_static --sync-budget` first")
+    else:
+        verdict = witness.check_against(budget)
+        counts = witness.counts()
+        d2h = sum(
+            n for kinds in counts.values()
+            for kind, n in kinds.items()
+            if kind in syncguard.D2H_KINDS
+        )
+        row = {
+            "d2h_syncs_observed": d2h,
+            "d2h_syncs_per_tick": round(d2h / args.ticks, 2),
+            "unknown_syncs": verdict["unknown_syncs"],
+        }
+        if verdict["unknown_syncs"]:
+            _check(checks, "transfers", "fail",
+                   f"{len(verdict['unknown_syncs'])} hot-span sync "
+                   "site(s) off the static allowlist — a hot path "
+                   "regressed or the resolver has a hole", **row)
+        else:
+            _check(checks, "transfers", "pass",
+                   f"{d2h} device→host syncs over {args.ticks} ticks, "
+                   "all hot-span sites on the allowlist", **row)
+
+    # -- cadence ----------------------------------------------------------
+    p50 = float(np.median(tick_walls))
+    row = {
+        "tick_p50_s": round(p50, 4),
+        "tick_max_s": round(max(tick_walls), 4),
+    }
+    if p50 <= args.cadence_budget_s:
+        _check(checks, "cadence", "pass",
+               f"tick p50 {p50 * 1e3:.1f} ms within the "
+               f"{args.cadence_budget_s}s cadence budget", **row)
+    else:
+        _check(checks, "cadence", "fail",
+               f"tick p50 {p50 * 1e3:.1f} ms blows the "
+               f"{args.cadence_budget_s}s cadence budget — the serve "
+               "loop cannot hold its render cadence here", **row)
+
+    dev.detach()
+    failed = [c["id"] for c in checks if c["status"] == "fail"]
+    skipped = [c["id"] for c in checks if c["status"] == "skip"]
+    return {
+        "metric": "tpu_doctor",
+        "verdict": "fail" if failed else "pass",
+        "platform": platform,
+        "failed_checks": failed,
+        "skipped_checks": skipped,
+        "checks": checks,
+        "config": {
+            "expect": args.expect,
+            "capacity": args.capacity,
+            "flows_per_tick": args.flows_per_tick,
+            "ticks": args.ticks,
+            "table_rows": args.table_rows,
+            "compile_budget_s": args.compile_budget_s,
+            "hbm_headroom": args.hbm_headroom,
+            "cadence_budget_s": args.cadence_budget_s,
+        },
+        "device": dev.status(),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--platform", choices=("cpu", "default"), default="cpu",
+        help="cpu forces the host platform (safe anywhere); default "
+        "lets jax pick the real device",
+    )
+    ap.add_argument(
+        "--expect", choices=("any", "cpu", "tpu", "gpu"), default="any",
+        help="fail the platform check unless jax initialized this "
+        "backend (tpu_day.sh passes tpu)",
+    )
+    ap.add_argument("--capacity", type=int, default=1 << 14)
+    ap.add_argument("--flows-per-tick", type=int, default=2048)
+    ap.add_argument("--ticks", type=int, default=8)
+    ap.add_argument("--table-rows", type=int, default=64)
+    ap.add_argument("--compile-budget-s", type=float, default=120.0)
+    ap.add_argument(
+        "--hbm-headroom", type=float, default=0.2,
+        help="minimum fraction of device memory that must be free "
+        "after the table fill (default 0.2)",
+    )
+    ap.add_argument("--cadence-budget-s", type=float, default=1.0)
+    ap.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="write the bundle here atomically (in addition to "
+        "stdout) — written on BOTH verdicts, so a failing preflight "
+        "still leaves its evidence",
+    )
+    args = ap.parse_args()
+    if args.platform == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    bundle = run_doctor(args)
+    print(json.dumps(bundle), flush=True)
+    if args.out:
+        from traffic_classifier_sdn_tpu.utils.atomicio import (
+            atomic_write_bytes,
+        )
+
+        out_dir = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(out_dir, exist_ok=True)
+        atomic_write_bytes(
+            args.out, (json.dumps(bundle, indent=2) + "\n").encode(),
+        )
+        print(f"# doctor bundle: {args.out}", file=sys.stderr,
+              flush=True)
+    if bundle["verdict"] != "pass":
+        sys.exit(
+            "tpu_doctor: FAIL — " + ", ".join(bundle["failed_checks"])
+        )
+
+
+if __name__ == "__main__":
+    main()

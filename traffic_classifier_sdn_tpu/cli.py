@@ -338,6 +338,31 @@ def _build_parser() -> argparse.ArgumentParser:
         "(0 disables — the default)",
     )
     p.add_argument(
+        "--device-obs", choices=("auto", "off"), default="auto",
+        help="device-runtime telemetry (obs/device.py): subscribe to "
+        "the jax.monitoring compile events (jit_compiles / "
+        "jit_compile_s / compilation_cache_hits, device.compile and "
+        "post-warmup device.retrace flight-recorder events), poll HBM "
+        "gauges per tick, reconcile donation effectiveness on the "
+        "double-buffered stages, and report the /healthz device block. "
+        "'auto' arms it whenever any obs surface is on (--obs-port or "
+        "--obs-dir); with --obs-dir it also runs the black-box perf "
+        "ring (obs/perf_recorder.py, <obs-dir>/perf/) and the "
+        "/profile endpoint. Byte-transparent: renders are identical "
+        "on vs off",
+    )
+    p.add_argument(
+        "--perf-ring-ticks", type=int, default=64, metavar="N",
+        help="black-box perf ring: per-tick samples per committed "
+        "segment (default 64; needs --obs-dir and --device-obs auto)",
+    )
+    p.add_argument(
+        "--perf-ring-keep", type=int, default=16, metavar="N",
+        help="black-box perf ring: committed segments retained on disk "
+        "— older ones are pruned, bounding the ring at "
+        "keep×ticks-per-segment ticks of evidence (default 16)",
+    )
+    p.add_argument(
         "--incremental", choices=("auto", "off"), default="auto",
         help="incremental active-set serving (serving/incremental.py): "
         "track which table rows each ingest scatter touched and "
@@ -797,6 +822,30 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             metrics=m, recorder=recorder, slo_s=args.latency_slo,
         )
 
+    # Device-runtime telemetry (obs/device.py): armed with the rest of
+    # the obs plane ('auto' + any obs surface on). Attached BEFORE the
+    # engine is built so table-construction and restore compiles are
+    # counted too; the retrace edge arms only after warmup. With
+    # --obs-dir the black-box perf ring rides along — per-tick samples
+    # committed to <obs-dir>/perf/ as atomic segments, so a kill -9 or
+    # a wedged device leaves on-disk evidence with no dump cooperation.
+    dev = None
+    perf = None
+    if args.device_obs != "off" and recorder is not None:
+        from .obs import DeviceTelemetry
+
+        dev = DeviceTelemetry(metrics=m, recorder=recorder)
+        dev.attach()
+        if args.obs_dir:
+            from .obs import PerfRecorder
+
+            perf = PerfRecorder(
+                os.path.join(args.obs_dir, "perf"),
+                ticks_per_segment=args.perf_ring_ticks,
+                keep_segments=args.perf_ring_keep,
+                metrics=m,
+            )
+
     # --native-ingest composes with --sources N: the C++ engine keys
     # per-source namespaces (tck_feed_lines folds the source id) and
     # owns the per-slot source map behind namespace eviction, so
@@ -853,6 +902,10 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             args.capacity, native=use_native,
             track_dirty=args.incremental != "off",
         )
+    if dev is not None and hasattr(engine, "donation_probe"):
+        # donation-effectiveness ledger on the donated wire scatter
+        # (the sharded engine has no single donated table to probe)
+        engine.donation_probe = dev.note_donation
 
     # Degradation ladder (serving/degrade.py): wraps the device predict
     # so a wedged/erroring dispatch demotes to a host fallback instead
@@ -903,6 +956,13 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             f"({', '.join(wstats['warmed'])})",
             file=sys.stderr,
         )
+        if dev is not None:
+            # arm the retrace edge: every compile from here on is a
+            # device.retrace event + retraces_after_warmup count. A
+            # surface warmup does not cover (an --openset calibration
+            # fold, a drift parity probe) registers honestly — it IS a
+            # compile the warmup contract missed.
+            dev.mark_warmup_complete()
 
     # Drift loop (serving/drift.py): wraps the (possibly ladder-
     # guarded) predict in a DriftGate — a transparent passthrough until
@@ -1079,9 +1139,24 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
         if lat is not None:
             # the live e2e budget: p50/p99 since emit + dominant stage
             health.set_latency(lat.status)
+        if dev is not None:
+            # compile/retrace counters, HBM watermark, last-dispatch
+            # age, donation effectiveness — the device block
+            health.set_device(dev.status)
+    profiler = None
+    if dev is not None and args.obs_dir:
+        from .obs import ProfilerCapture
+
+        profiler = ProfilerCapture(
+            os.path.join(args.obs_dir, "profile"),
+            metrics=m, recorder=recorder,
+        )
+    if args.obs_port is not None:
+        from .obs import ExpositionServer
+
         server = ExpositionServer(
             m, recorder=recorder, health=health, port=args.obs_port,
-            host=args.obs_host,
+            host=args.obs_host, profiler=profiler,
         )
         server.start()
         # --obs-port 0 binds ephemerally: report the ACTUAL port on
@@ -1092,7 +1167,8 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
         m.set("obs_port", server.port)
         print(
             f"observability plane on port {server.port} "
-            f"(/metrics /healthz /events)",
+            f"(/metrics /healthz /events"
+            f"{' /profile' if profiler is not None else ''})",
             file=sys.stderr,
         )
     # SIGTERM (the orchestrator's shutdown signal) must leave a
@@ -1141,7 +1217,7 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
                         tracer=tracer, recorder=recorder, health=health,
                         probe_out=probe_out, degrade=degrade_surface,
                         drift=drift, inc=inc, lat=lat, usr1=usr1,
-                        openset=openset)
+                        openset=openset, dev=dev, perf=perf)
     except BaseException as e:
         # the crash-forensics moment: record the terminal exception and
         # freeze the ring — safely outside any signal-handler frame.
@@ -1151,6 +1227,7 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             if sigterm_seen and isinstance(e, SystemExit):
                 recorder.record("signal.sigterm")
                 _dump_flight(recorder, args.obs_dir, "sigterm")
+                _dump_device(dev, perf, args.obs_dir, "sigterm")
             elif not isinstance(e, SystemExit):
                 recorder.record(
                     "serve.exception", error=type(e).__name__,
@@ -1162,6 +1239,7 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
                     else "serve-exception"
                 )
                 _dump_flight(recorder, args.obs_dir, reason)
+                _dump_device(dev, perf, args.obs_dir, reason)
         raise
     else:
         if recorder is not None:
@@ -1169,8 +1247,11 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
                 # the monitor died for good and the source drained — the
                 # loop ends "cleanly" but an operator needs the trail
                 _dump_flight(recorder, args.obs_dir, "supervisor-terminal")
+                _dump_device(dev, perf, args.obs_dir,
+                             "supervisor-terminal")
             elif args.obs_dump_on_exit:
                 _dump_flight(recorder, args.obs_dir, "on-demand")
+                _dump_device(dev, perf, args.obs_dir, "on-demand")
     finally:
         if lock_witness is not None:
             # surface ordering violations + the static-graph
@@ -1185,6 +1266,15 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             syncguard.finish(sync_witness, recorder=recorder)
         if server is not None:
             server.stop()
+        if perf is not None:
+            # commit the partial segment: every recorded tick is on
+            # disk before the process goes away (best-effort — the
+            # commit path absorbs its own failures)
+            perf.flush()
+        if dev is not None:
+            # unregister the monitoring listeners + restore the
+            # dispatch logger — a finished run must not haunt the next
+            dev.detach()
         if degrade_surface is not None:
             # the view closes both the live (possibly promoted) ladder
             # and the boot one; without drift it IS the boot ladder
@@ -1237,6 +1327,44 @@ def _dump_flight(recorder, obs_dir, reason: str) -> None:
               file=sys.stderr)
         return
     print(f"flight recorder dumped to {path} ({reason})", file=sys.stderr)
+
+
+def _dump_device(dev, perf, obs_dir, reason: str) -> None:
+    """Best-effort device-plane dump: the /healthz device block plus the
+    black-box perf-ring tail, frozen as one JSON bundle beside the
+    flight-recorder post-mortem — gate-breach forensics carry device
+    state (compiles, retraces, HBM watermark, last-dispatch age) and
+    the last ticks' stage timings without needing the obs port up."""
+    if (dev is None and perf is None) or not obs_dir:
+        return
+    import json
+
+    from .utils.atomicio import atomic_write_bytes
+
+    payload: dict = {"kind": "device", "reason": reason}
+    if dev is not None:
+        payload["device"] = dev.status()
+    if perf is not None:
+        # commit the partial segment first so the on-disk ring and the
+        # reported tail agree about the final ticks
+        perf.flush()
+        payload["perf"] = perf.status()
+        payload["perf_tail"] = perf.tail(64)
+    try:
+        os.makedirs(obs_dir, exist_ok=True)
+        path = os.path.join(
+            obs_dir,
+            f"device-{os.getpid()}-{time.monotonic_ns()}-{reason}.json",
+        )
+        atomic_write_bytes(
+            path,
+            json.dumps(payload, sort_keys=True, default=repr).encode(),
+        )
+    except OSError as e:
+        print(f"WARNING: device dump failed: {e}", file=sys.stderr)
+        return
+    print(f"device telemetry dumped to {path} ({reason})",
+          file=sys.stderr)
 
 
 def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
@@ -1311,7 +1439,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 use_native, dropped_seen, tracer, recorder=None,
                 health=None, probe_out=None, degrade=None,
                 drift=None, inc=None, lat=None, usr1=None,
-                openset=None) -> None:
+                openset=None, dev=None, perf=None) -> None:
     from .ingest.fanin import RawTick
     from .utils.profiling import trace
 
@@ -1338,7 +1466,9 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
             # donated double-buffers pin the per-render feature matrix
             # (full re-predict only: the incremental path gathers
             # per-bucket dirty rows instead of projecting the table)
-            feature_stage = FeatureStage(engine.table.capacity)
+            feature_stage = FeatureStage(
+                engine.table.capacity, telemetry=dev,
+            )
 
     ticks = 0
     # A restarted serve must keep numbering ABOVE the rotation's existing
@@ -1384,6 +1514,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                     recorder.record("signal.sigusr1")
                     _dump_flight(recorder, args.obs_dir, "sigusr1")
                     _dump_metrics(m, args.obs_dir, "sigusr1")
+                    _dump_device(dev, perf, args.obs_dir, "sigusr1")
                 if pipe is not None:
                     # a dead device stage must kill the serve (and leave
                     # a post-mortem), not let the host spin silently
@@ -1461,6 +1592,10 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 file=sys.stderr,
                             )
                             dropped_seen = engine.dropped
+                        if dev is not None:
+                            # render dispatch == device work this tick:
+                            # feeds the /healthz last-dispatch age
+                            dev.mark_dispatch()
                         if pipe is not None:
                             _dispatch_render(
                                 args, engine, model, predict,
@@ -1516,6 +1651,11 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 health=health, drift=drift,
                                 openset=openset,
                             )
+                if dev is not None or perf is not None:
+                    # after the tick span closes, so every stage
+                    # histogram's newest sample is THIS tick's
+                    _record_perf_tick(m, dev, perf, ticks,
+                                      degrade=degrade, drift=drift)
                 if args.metrics_every and ticks % args.metrics_every == 0:
                     print(m.report(), file=sys.stderr, flush=True)
                 if args.max_ticks and ticks >= args.max_ticks:
@@ -1533,6 +1673,45 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
         # collector) BEFORE the obs server goes down, so /healthz can
         # never observe a half-stopped source
         source.close()
+
+
+def _record_perf_tick(m, dev, perf, ticks, degrade=None, drift=None) -> None:
+    """One black-box sample per poll tick: refresh the HBM gauges
+    (``dev.sample``) and persist the tick's stage timings, queue/dirty
+    state, and degrade/drift positions into the on-disk perf ring.
+    Host-side dict reads only — the write path never touches jax."""
+    devs = dev.sample() if dev is not None else None
+    if perf is None:
+        return
+    sample: dict = {"tick": ticks}
+    # newest sample per latency surface — the same underlying readings
+    # the latency plane folds into its quantiles, so a ring segment's
+    # per-stage p50s reconcile against /healthz by construction
+    for name in ("stage_tick_s", "stage_parse_s", "stage_scatter_s",
+                 "stage_predict_s", "stage_render_s", "ingest_s",
+                 "predict_s"):
+        h = m.histograms.get(name)
+        if h is not None and h.last is not None:
+            sample[name] = round(h.last, 6)
+    for gauge in ("queue_depth", "dirty_rows", "flows_dropped"):
+        if gauge in m.gauges:
+            sample[gauge] = m.gauges[gauge]
+    if degrade is not None:
+        try:
+            sample["degrade_state"] = degrade.status().get("state")
+        except Exception:  # noqa: BLE001 — the black box must not inject
+            pass
+    if drift is not None:
+        try:
+            sample["drift_state"] = drift.status().get("state")
+        except Exception:  # noqa: BLE001 — the black box must not inject
+            pass
+    if devs is not None:
+        sample["jit_compiles"] = devs["jit_compiles"]
+        sample["retraces_after_warmup"] = devs["retraces_after_warmup"]
+        if devs["hbm_bytes"] is not None:
+            sample["hbm_bytes"] = devs["hbm_bytes"]
+    perf.record(sample)
 
 
 def _dump_metrics(m, obs_dir, reason: str) -> None:

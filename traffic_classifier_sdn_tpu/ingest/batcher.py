@@ -417,6 +417,11 @@ class FlowStateEngine(HostSpine):
                  native: bool = False, track_dirty: bool = False):
         self.table = ft.make_table(capacity)
         self.dirty = None
+        # obs/device.DeviceTelemetry.note_donation when the device plane
+        # is armed (cli.py): per-apply reconciliation of expected vs
+        # observed buffer reuse on the donated wire scatter. None = the
+        # probe costs one attribute load per apply.
+        self.donation_probe = None
         self._init_spine(capacity, buckets, native)
         if track_dirty:
             self.enable_dirty_tracking()
@@ -543,12 +548,29 @@ class FlowStateEngine(HostSpine):
         """One packed wire batch into the device table (dirty-fused when
         the incremental label cache is live)."""
         self.wire_bytes += w.nbytes  # padded, i.e. what actually moves
+        probe = self.donation_probe
+        ptr = None
+        if probe is not None:
+            try:
+                # the pointer must be read BEFORE the donating dispatch
+                # consumes the input buffer (afterwards it is deleted)
+                ptr = self.table.time_start.unsafe_buffer_pointer()
+            except Exception:  # noqa: BLE001 — telemetry must not inject
+                probe = None
         if self.dirty is None:
             self.table = apply_wire_jit(self.table, w)
         else:
             self.table, self.dirty = apply_wire_dirty_jit(
                 self.table, self.dirty, w
             )
+        if probe is not None:
+            try:
+                probe(
+                    "wire",
+                    self.table.time_start.unsafe_buffer_pointer() == ptr,
+                )
+            except Exception:  # noqa: BLE001 — telemetry must not inject
+                pass
 
     def features(self):
         """(capacity, 12) device feature matrix (classifier input)."""

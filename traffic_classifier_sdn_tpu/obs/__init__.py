@@ -20,6 +20,18 @@ Three cooperating layers, each dependency-free (stdlib + the existing
   ``/metrics`` (Prometheus text format), ``/healthz`` (liveness +
   staleness), and ``/events`` (flight-recorder tail as JSON), wired
   into ``cli.py`` behind ``--obs-port``.
+- ``obs.device`` — the device-runtime half: compile/retrace telemetry
+  off the ``jax.monitoring`` listener bus (``device.compile`` /
+  ``device.retrace`` events, ``jit_compiles`` / ``jit_compile_s`` /
+  ``retraces_after_warmup`` metrics), per-tick HBM gauges with a
+  watermark, donation-effectiveness reconciliation on the
+  double-buffered stages, the /healthz ``device`` block, and the
+  on-demand ``/profile`` capture (``ProfilerCapture``).
+- ``obs.perf_recorder`` — the black-box flight data recorder: per-tick
+  samples committed to disk as atomic bounded segments
+  (``perf-<seq>.jsonl``), jax-free on the write path, so a kill -9 or
+  an 11-hour device wedge leaves hours of per-tick evidence readable
+  via ``perf_recorder.replay``.
 - ``obs.latency`` — record-level latency provenance: host-side emit
   stamps on every telemetry batch, per-hop boundary marks (fan-in
   queue enter/exit, batcher parse, scatter dispatch, device
@@ -33,16 +45,22 @@ docs/OBSERVABILITY.md is the operator-facing catalog (metric names,
 span taxonomy, scrape and post-mortem workflow).
 """
 
+from .device import DeviceTelemetry, ProfilerBusy, ProfilerCapture
 from .exposition import ExpositionServer, HealthState, prometheus_text
 from .flight_recorder import FlightRecorder, dump_metrics_snapshot
 from .latency import LatencyProvenance
+from .perf_recorder import PerfRecorder
 from .trace import Span, Tracer
 
 __all__ = [
+    "DeviceTelemetry",
     "ExpositionServer",
     "FlightRecorder",
     "HealthState",
     "LatencyProvenance",
+    "PerfRecorder",
+    "ProfilerBusy",
+    "ProfilerCapture",
     "Span",
     "Tracer",
     "dump_metrics_snapshot",

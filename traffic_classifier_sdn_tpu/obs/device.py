@@ -1,0 +1,406 @@
+"""Device-runtime telemetry: compile/retrace accounting, HBM gauges,
+donation effectiveness, and on-demand profiler capture.
+
+All prior observability watches the HOST side of the serve (metrics,
+flight recorder, latency provenance, syncguard). This module is the
+device half — what the chip (or its CPU stand-in) is actually doing:
+
+- **Compile/retrace telemetry.** ``DeviceTelemetry.attach`` subscribes
+  to the ``jax.monitoring`` listener bus: every backend compile lands
+  in ``jit_compiles`` / the ``jit_compile_s`` histogram plus a
+  ``device.compile`` flight-recorder event carrying the program name
+  and duration; compilation-cache hits/misses count alongside. The
+  program name is not on the monitoring event (jax 0.4.x passes the
+  duration alone), so a logging handler on ``jax._src.dispatch``
+  captures the "Finished XLA compilation of jit(<name>)" line — which
+  fires immediately BEFORE the duration event on the same thread — and
+  the duration listener reads-and-clears it under the telemetry lock.
+- **Retrace discipline, enforced live.** ``mark_warmup_complete`` arms
+  the edge: any compile after it is a retrace — ``device.retrace``
+  event + ``retraces_after_warmup`` counter. Each novel shape costs
+  exactly one backend compile, so the event fires exactly once per
+  novel shape (tests/test_device_obs.py pins this), turning the PR 4/8
+  zero-retrace test discipline into a production runtime signal.
+- **HBM accounting.** ``sample()`` polls ``device.memory_stats()`` per
+  tick into ``device_memory_bytes`` / ``device_memory_peak_bytes``
+  gauges and a watermark; backends without it (CPU) report None and
+  everything degrades gracefully. ``note_donation`` reconciles expected
+  vs observed buffer reuse on the double-buffered wire/feature stages
+  (the probes live at the stages; this is just the ledger).
+- **Listener discipline.** Callbacks fire on whatever thread compiles;
+  ``_lock`` is a leaf held only for bookkeeping — metrics observes and
+  recorder appends happen strictly after release (the obs/latency.py
+  idiom). ``detach`` unregisters both listeners and restores the
+  logger, so a finished run cannot haunt the next in-process.
+
+``ProfilerCapture`` is the on-demand deep view: ``/profile?seconds=N``
+on the obs server starts a ``jax.profiler`` trace into ``--obs-dir``
+under a mutually-exclusive-capture guard — never on the hot path by
+default, and a capture failure 500s the endpoint (fault site
+``obs.profiler``), never the serve loop.
+
+jax imports are lazy (attach/capture time): importing this module pulls
+no device runtime, so the obs plane stays importable everywhere.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import time
+
+from ..utils import faults
+
+# the jax.monitoring event keys this plane consumes (jax 0.4.x names)
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_COMPILE_LOG_RE = re.compile(
+    r"Finished XLA compilation of (?P<name>.+?) in [0-9.eE+-]+ sec"
+)
+
+
+class _CompileNameHandler(logging.Handler):
+    """Captures the compiled program's name from the dispatch log line
+    that precedes each backend-compile duration event."""
+
+    def __init__(self, note):
+        super().__init__(level=logging.DEBUG)
+        self._note = note
+
+    def emit(self, record) -> None:  # noqa: D102
+        try:
+            m = _COMPILE_LOG_RE.search(record.getMessage())
+        except Exception:  # noqa: BLE001 — observation must not raise
+            return
+        if m:
+            self._note(m.group("name"))
+
+
+class DeviceTelemetry:
+    """Compile/retrace/HBM/donation ledger behind the obs plane.
+
+    Lifecycle: ``attach()`` before warmup, ``mark_warmup_complete()``
+    after, ``sample()`` per tick, ``detach()`` in the serve's finally.
+    Also a context manager (tests). Byte-transparent: everything lands
+    in metrics/recorder/stderr surfaces, never stdout.
+    """
+
+    def __init__(self, metrics=None, recorder=None, clock=time.time):
+        self._metrics = metrics
+        self._recorder = recorder
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._armed = False
+        self._warmed = False
+        self._compiles = 0
+        self._compile_s = 0.0
+        self._cache_hits = 0
+        self._cache_misses = 0
+        self._retraces = 0
+        self._pending_name: str | None = None
+        self._last_program: str | None = None
+        self._last_dispatch_at: float | None = None
+        self._donation: dict[str, list[int]] = {}
+        self._hbm_last: int | None = None
+        self._hbm_watermark = 0
+        self._backend = None
+        self._platform = None
+        self._device = None
+        self._logger = None
+        self._handler = None
+        self._prior_level: int | None = None
+        self._prior_propagate: bool | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def attach(self) -> DeviceTelemetry:
+        """Register the monitoring listeners + the name-capture logging
+        handler. Idempotent; returns self."""
+        with self._lock:
+            if self._armed:
+                return self
+            self._armed = True
+        import jax
+        from jax import monitoring
+
+        try:
+            devices = jax.devices()
+        except Exception:  # noqa: BLE001 — a dead backend must not kill obs
+            devices = []
+        dev = devices[0] if devices else None
+        with self._lock:
+            self._device = dev
+            self._backend = getattr(dev, "device_kind", None)
+            self._platform = getattr(dev, "platform", None)
+        # the dispatch logger must emit at DEBUG for the compile line to
+        # reach the handler; propagate=False keeps that DEBUG stream out
+        # of the root handlers (no stderr spam) while armed
+        logger = logging.getLogger("jax._src.dispatch")
+        handler = _CompileNameHandler(self._note_program)
+        self._prior_level = logger.level
+        self._prior_propagate = logger.propagate
+        logger.addHandler(handler)
+        logger.setLevel(logging.DEBUG)
+        logger.propagate = False
+        self._logger, self._handler = logger, handler
+        monitoring.register_event_duration_secs_listener(self._on_duration)
+        monitoring.register_event_listener(self._on_event)
+        return self
+
+    def detach(self) -> None:
+        """Unregister listeners and restore the dispatch logger.
+        Idempotent — safe from the CLI's finally after any failure."""
+        with self._lock:
+            if not self._armed:
+                return
+            self._armed = False
+        try:
+            from jax._src import monitoring as mon
+
+            mon._unregister_event_duration_listener_by_callback(
+                self._on_duration
+            )
+            mon._unregister_event_listener_by_callback(self._on_event)
+        except Exception:  # noqa: BLE001 — callbacks also no-op once disarmed
+            pass
+        logger, handler = self._logger, self._handler
+        self._logger = self._handler = None
+        if logger is not None and handler is not None:
+            logger.removeHandler(handler)
+            if self._prior_level is not None:
+                logger.setLevel(self._prior_level)
+            if self._prior_propagate is not None:
+                logger.propagate = self._prior_propagate
+
+    def __enter__(self) -> DeviceTelemetry:
+        return self.attach()
+
+    def __exit__(self, *exc) -> bool:
+        self.detach()
+        return False
+
+    # -- listener callbacks --------------------------------------------------
+    def _note_program(self, name: str) -> None:
+        with self._lock:
+            self._pending_name = name
+
+    def _on_duration(self, event: str, duration: float, **kw) -> None:
+        if event != BACKEND_COMPILE_EVENT:
+            return
+        with self._lock:
+            if not self._armed:
+                return
+            name, self._pending_name = self._pending_name, None
+            self._compiles += 1
+            self._compile_s += duration
+            if name is not None:
+                self._last_program = name
+            warmed = self._warmed
+            if warmed:
+                self._retraces += 1
+        m, rec = self._metrics, self._recorder
+        if m is not None:
+            m.inc("jit_compiles")
+            m.observe("jit_compile_s", duration)
+            if warmed:
+                m.inc("retraces_after_warmup")
+        if rec is not None:
+            rec.record("device.compile", program=name,
+                       duration_s=round(duration, 6), after_warmup=warmed)
+            if warmed:
+                # edge-triggered: a compile after warmup is a discipline
+                # breach — one event per novel program/shape
+                rec.record("device.retrace", program=name,
+                           duration_s=round(duration, 6))
+
+    def _on_event(self, event: str, **kw) -> None:
+        if event == CACHE_HIT_EVENT:
+            with self._lock:
+                if not self._armed:
+                    return
+                self._cache_hits += 1
+            if self._metrics is not None:
+                self._metrics.inc("compilation_cache_hits")
+        elif event == CACHE_MISS_EVENT:
+            with self._lock:
+                if not self._armed:
+                    return
+                self._cache_misses += 1
+            if self._metrics is not None:
+                self._metrics.inc("compilation_cache_misses")
+
+    # -- serve-loop hooks ----------------------------------------------------
+    def mark_warmup_complete(self) -> None:
+        """Arm the retrace edge: every compile from here on is a breach
+        of the zero-retrace discipline."""
+        with self._lock:
+            self._warmed = True
+            compiles, compile_s = self._compiles, self._compile_s
+        if self._recorder is not None:
+            self._recorder.record(
+                "device.warmup_complete", jit_compiles=compiles,
+                jit_compile_s=round(compile_s, 6),
+            )
+
+    def mark_dispatch(self) -> None:
+        """The serve loop dispatched device work this tick — feeds the
+        /healthz last-dispatch age (a wedged device shows a growing age
+        while host ticks keep beating)."""
+        with self._lock:
+            self._last_dispatch_at = self._clock()
+
+    def note_donation(self, stage: str, reused: bool) -> None:
+        """One donation outcome from a double-buffered stage: the donated
+        input's storage was (or was not) observed reused by the output."""
+        with self._lock:
+            ent = self._donation.setdefault(stage, [0, 0])
+            ent[0] += 1
+            if reused:
+                ent[1] += 1
+        m = self._metrics
+        if m is not None:
+            m.inc(f"donation_expected_{stage}")
+            if reused:
+                m.inc(f"donation_reused_{stage}")
+
+    def sample(self) -> dict:
+        """Per-tick poll: refresh the HBM gauges (graceful None on
+        backends without memory_stats) and return the compact dict the
+        perf recorder persists."""
+        dev = self._device
+        stats = None
+        if dev is not None:
+            try:
+                stats = dev.memory_stats()
+            except Exception:  # noqa: BLE001 — CPU backends raise/return None
+                stats = None
+        in_use = stats.get("bytes_in_use") if stats else None
+        peak = stats.get("peak_bytes_in_use") if stats else None
+        with self._lock:
+            if in_use is not None:
+                self._hbm_watermark = max(self._hbm_watermark, int(in_use))
+            self._hbm_last = in_use
+            watermark = self._hbm_watermark
+            out = {
+                "jit_compiles": self._compiles,
+                "retraces_after_warmup": self._retraces,
+                "hbm_bytes": in_use,
+            }
+        m = self._metrics
+        if m is not None and in_use is not None:
+            m.set("device_memory_bytes", in_use)
+            m.set("device_memory_peak_bytes",
+                  peak if peak is not None else watermark)
+        return out
+
+    # -- read ---------------------------------------------------------------
+    def status(self) -> dict:
+        """The /healthz ``device`` block."""
+        now = self._clock()
+        with self._lock:
+            last_dispatch = self._last_dispatch_at
+            return {
+                "armed": self._armed,
+                "backend": self._backend,
+                "platform": self._platform,
+                "jit_compiles": self._compiles,
+                "jit_compile_s_total": round(self._compile_s, 6),
+                "compilation_cache_hits": self._cache_hits,
+                "compilation_cache_misses": self._cache_misses,
+                "warmup_complete": self._warmed,
+                "retraces_after_warmup": self._retraces,
+                "last_compile_program": self._last_program,
+                "hbm_bytes": self._hbm_last,
+                "hbm_watermark_bytes": self._hbm_watermark or None,
+                "last_dispatch_age_s": (
+                    None if last_dispatch is None
+                    else round(now - last_dispatch, 6)
+                ),
+                "donation": {
+                    stage: {"expected": e, "reused": r}
+                    for stage, (e, r) in sorted(self._donation.items())
+                },
+            }
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in progress (the endpoint's 409)."""
+
+
+class ProfilerCapture:
+    """On-demand ``jax.profiler`` trace capture into one directory.
+
+    Mutually exclusive: a second ``capture`` while one runs raises
+    ``ProfilerBusy`` immediately (the guard is a flag flipped under the
+    leaf lock; the sleep happens outside it). Failures count and
+    re-raise — the /profile endpoint turns them into a 500, the serve
+    loop never sees them (fault site ``obs.profiler``).
+    """
+
+    MAX_SECONDS = 600.0
+
+    def __init__(self, directory: str, metrics=None, recorder=None):
+        self.directory = os.path.abspath(directory)
+        self._metrics = metrics
+        self._recorder = recorder
+        self._lock = threading.Lock()
+        self._active = False
+        self._captures = 0
+        self._failures = 0
+
+    def capture(self, seconds: float) -> dict:
+        seconds = float(seconds)
+        if not 0.0 < seconds <= self.MAX_SECONDS:
+            raise ValueError(
+                f"seconds must be in (0, {self.MAX_SECONDS:g}], got {seconds}"
+            )
+        with self._lock:
+            if self._active:
+                raise ProfilerBusy("a profiler capture is already running")
+            self._active = True
+        t0 = time.perf_counter()
+        try:
+            faults.fault_point("obs.profiler")
+            import jax
+
+            os.makedirs(self.directory, exist_ok=True)
+            jax.profiler.start_trace(self.directory)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as e:
+            with self._lock:
+                self._failures += 1
+                self._active = False
+            if self._metrics is not None:
+                self._metrics.inc("profiler_capture_failures")
+            if self._recorder is not None:
+                self._recorder.record("device.profile_failed", error=str(e))
+            raise
+        wall = time.perf_counter() - t0
+        with self._lock:
+            self._captures += 1
+            self._active = False
+        if self._metrics is not None:
+            self._metrics.inc("profiler_captures")
+        if self._recorder is not None:
+            self._recorder.record("device.profile", seconds=seconds,
+                                  wall_s=round(wall, 6))
+        return {
+            "directory": self.directory,
+            "seconds": seconds,
+            "wall_s": round(wall, 6),
+        }
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "directory": self.directory,
+                "active": self._active,
+                "captures": self._captures,
+                "failures": self._failures,
+            }

@@ -2,7 +2,7 @@
 
 A stdlib ``http.server`` thread an operator can point Prometheus at —
 no client library, no third-party deps (the container image is fixed).
-Three endpoints:
+Four endpoints:
 
 - ``/metrics`` — Prometheus text exposition format 0.0.4. Counters and
   gauges map directly; each ``utils.metrics.Histogram`` is rendered as
@@ -18,6 +18,10 @@ Three endpoints:
   Kubernetes/Prometheus probe verbatim.
 - ``/events`` — the flight-recorder tail as a JSON array (``?n=`` to
   bound), the live view of the same ring the post-mortem dump freezes.
+- ``/profile?seconds=N`` — on-demand ``jax.profiler`` trace capture
+  into the obs dir (obs/device.ProfilerCapture), armed only when the
+  CLI runs with ``--obs-dir``. Mutually exclusive (409 while one runs),
+  a failed capture is a 500 — never a serve-loop crash.
 
 The server runs on a daemon thread (``ThreadingHTTPServer``; handlers
 never block the serve loop — they read under the metrics/ring locks
@@ -34,6 +38,8 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
+
+from .device import ProfilerBusy
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _PREFIX = "tcsdn_"
@@ -128,6 +134,7 @@ class HealthState:
         self._label_cache = None
         self._sources = None
         self._latency = None
+        self._device = None
         self._obs_port: int | None = None
 
     def model_loaded(self) -> None:
@@ -190,6 +197,16 @@ class HealthState:
         with self._lock:
             self._latency = status_fn
 
+    def set_device(self, status_fn) -> None:
+        """``status_fn() -> dict`` (obs/device.DeviceTelemetry.status):
+        the device-runtime plane's self-report — backend/platform,
+        compile and retrace counters, HBM watermark, last-dispatch age,
+        donation effectiveness — folded into /healthz as a ``device``
+        object. Informational, never a health verdict: a retrace or a
+        high watermark is an alerting signal, not a restart reason."""
+        with self._lock:
+            self._device = status_fn
+
     def set_obs_port(self, port: int) -> None:
         """The exposition server's ACTUAL bound port — the /healthz
         self-reference. With ``--obs-port 0`` (ephemeral bind) this is
@@ -239,6 +256,7 @@ class HealthState:
             label_cache = self._label_cache
             sources = self._sources
             latency = self._latency
+            device = self._device
             obs_port = self._obs_port
             model_loaded = self._model_loaded_at
             model_promoted = self._model_promoted_at
@@ -338,6 +356,11 @@ class HealthState:
                 report["latency"] = latency()
             except Exception as e:  # noqa: BLE001 — health must not crash
                 report["latency"] = {"observed": False, "error": str(e)}
+        if device is not None:
+            try:
+                report["device"] = device()
+            except Exception as e:  # noqa: BLE001 — health must not crash
+                report["device"] = {"armed": False, "error": str(e)}
         if obs_port is not None:
             report["obs_port"] = obs_port
         return healthy, report
@@ -388,6 +411,40 @@ class _Handler(BaseHTTPRequestHandler):
                 events = owner.recorder.tail(n)
             body = json.dumps(events).encode()
             self._send(200, "application/json", body)
+        elif url.path == "/profile":
+            # on-demand jax.profiler capture (obs/device.ProfilerCapture)
+            # — blocks THIS handler thread for the capture window
+            # (ThreadingHTTPServer: /metrics scrapes keep answering);
+            # the busy guard makes concurrent requests a 409, so the
+            # capture itself is never concurrent with another
+            if owner.profiler is None:
+                self._send(
+                    404, "application/json",
+                    b'{"error": "profiler not armed (serve with '
+                    b'--obs-dir)"}',
+                )
+                return
+            raw = parse_qs(url.query).get("seconds")
+            try:
+                seconds = float(raw[0]) if raw else 2.0
+            except ValueError:
+                self._send(400, "application/json",
+                           b'{"error": "seconds must be a number"}')
+                return
+            try:
+                result = owner.profiler.capture(seconds)
+            except ProfilerBusy as e:
+                self._send(409, "application/json",
+                           json.dumps({"error": str(e)}).encode())
+            except ValueError as e:
+                self._send(400, "application/json",
+                           json.dumps({"error": str(e)}).encode())
+            except Exception as e:  # noqa: BLE001 — absorbed: 500, not a crash
+                self._send(500, "application/json",
+                           json.dumps({"error": str(e)}).encode())
+            else:
+                self._send(200, "application/json",
+                           json.dumps(result, sort_keys=True).encode())
         else:
             self._send(404, "application/json", b'{"error": "not found"}')
 
@@ -404,10 +461,11 @@ class ExpositionServer:
     explicit choice (CLI: ``--obs-host``)."""
 
     def __init__(self, metrics, recorder=None, health=None,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1", profiler=None):
         self.metrics = metrics
         self.recorder = recorder
         self.health = health
+        self.profiler = profiler
         self.host = host
         self.port = port
         self._server: ThreadingHTTPServer | None = None

@@ -41,8 +41,10 @@ import numpy as np
 
 from ..ingest.batcher import FlowStateEngine
 from ..ingest.fanin import FanInIngest
+from ..obs.device import DeviceTelemetry
 from ..obs.flight_recorder import FlightRecorder, dump_metrics_snapshot
 from ..obs.latency import LatencyProvenance
+from ..obs.perf_recorder import PerfRecorder
 from ..utils import faults
 from ..utils.atomicio import atomic_write_bytes
 from ..utils.metrics import Metrics
@@ -183,6 +185,18 @@ def run_scenario(sc: Scenario, *, native: str = "auto",
     )
     m = Metrics()
     recorder = FlightRecorder(capacity=8192)
+    # Per-scenario device plane: compile/retrace accounting scoped to
+    # this timeline, so a gate breach's post-mortem can say whether
+    # XLA recompiled mid-scenario. The black-box perf ring only exists
+    # when a bundle directory does — it is post-mortem evidence.
+    dev = DeviceTelemetry(metrics=m, recorder=recorder)
+    dev.attach()
+    perf = None
+    if obs_dir:
+        perf = PerfRecorder(
+            os.path.join(obs_dir, "perf", sc.id),
+            ticks_per_segment=32, keep_segments=8, metrics=m,
+        )
     vclock = {"t": 0.0}
     clock = time.monotonic if sc.real_clock else (lambda: vclock["t"])
     tier = FanInIngest(
@@ -235,6 +249,11 @@ def run_scenario(sc: Scenario, *, native: str = "auto",
         engine.evict_source(63)
         inc.invalidate("scenario-warmup")
         jax.block_until_ready(inc.labels())
+    # Any compile past this point happened inside the timeline — a
+    # retrace the scorecard's device block will carry. Openset
+    # scenarios skip the traffic warm above, so their calibration
+    # compile registers honestly here (they do not gate on e2e).
+    dev.mark_warmup_complete()
     tier.start()
     gen = tier.ticks(tick_timeout=sc.tick_timeout, poll_s=0.005)
     try:
@@ -282,20 +301,37 @@ def run_scenario(sc: Scenario, *, native: str = "auto",
                     if inc is not None and n:
                         inc.invalidate("idle-evict")
                 seal = lat.seal()
+                dev.mark_dispatch()
                 labels = inc.labels()
                 jax.block_until_ready(labels)
                 lat.mark_device(seal)
                 engine.render_sample(labels, sc.table_rows)
                 lat.render_visible(seal)
-                ctx.obs["tick_wall_s"].append(
-                    time.perf_counter() - t0
-                )
+                wall = time.perf_counter() - t0
+                ctx.obs["tick_wall_s"].append(wall)
+                devs = dev.sample()
+                if perf is not None:
+                    sample = {
+                        "tick": tick,
+                        "phase": phase.name,
+                        "tick_wall_s": round(wall, 6),
+                        "jit_compiles": devs["jit_compiles"],
+                        "retraces_after_warmup": devs[
+                            "retraces_after_warmup"
+                        ],
+                    }
+                    if devs["hbm_bytes"] is not None:
+                        sample["hbm_bytes"] = devs["hbm_bytes"]
+                    perf.record(sample)
                 vclock["t"] += sc.clock_step_s
     finally:
         gen.close()
         tier.stop()
         if degrade is not None:
             degrade.close()
+        if perf is not None:
+            perf.flush()
+        dev.detach()
     # final-state observations the ground-truth gates read: per-MAC
     # labels from the last tick's full label vector (capacities here
     # are scenario-sized — the full fetch the 2²⁰ serve avoids is
@@ -326,6 +362,7 @@ def run_scenario(sc: Scenario, *, native: str = "auto",
         "evicted_slots": int(ctx.obs["evicted_slots"]),
         "transitions": _transition_trace(recorder),
         "engine": "native" if use_native else "python",
+        "device": dev.status(),
     }
     if not passed:
         for r in results:
@@ -338,6 +375,7 @@ def run_scenario(sc: Scenario, *, native: str = "auto",
         if obs_dir:
             card["post_mortem"] = _dump_post_mortem(
                 sc, ctx, m, recorder, results, obs_dir,
+                dev=dev, perf=perf,
             )
     return card
 
@@ -359,7 +397,7 @@ def _transition_trace(recorder: FlightRecorder) -> list[dict]:
 
 def _dump_post_mortem(sc: Scenario, ctx: RunContext, m: Metrics,
                       recorder: FlightRecorder, results,
-                      obs_dir: str) -> dict:
+                      obs_dir: str, dev=None, perf=None) -> dict:
     """The satellite-2 contract: a gate failure leaves an atomic
     bundle named by scenario id — flight-recorder JSONL + metrics
     snapshot (the PR 3/PR 11 dump paths) + a manifest carrying the
@@ -392,6 +430,20 @@ def _dump_post_mortem(sc: Scenario, ctx: RunContext, m: Metrics,
         "flight": bundle.get("flight"),
         "metrics": bundle.get("metrics"),
     }
+    # Device-plane evidence: what the chip was doing when the gate
+    # broke. Attempted independently — a wedged device must not cost
+    # us the manifest.
+    if dev is not None:
+        try:
+            manifest["device"] = dev.status()
+        except Exception as e:
+            manifest["device_error"] = str(e)
+    if perf is not None:
+        try:
+            perf.flush()
+            manifest["perf_tail"] = perf.tail(32)
+        except Exception as e:
+            manifest["perf_tail_error"] = str(e)
     path = os.path.join(obs_dir, f"scenario-{sc.id}-postmortem.json")
     try:
         os.makedirs(obs_dir, exist_ok=True)

@@ -386,18 +386,38 @@ class FeatureStage:
     predict; JAX's dependency tracking orders the aliasing write after
     every dispatched reader."""
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, telemetry=None):
         self._bufs = [
             jnp.zeros((capacity, ft.NUM_FEATURES), jnp.float32)
             for _ in range(2)
         ]
         self._turn = 0
+        # obs/device.DeviceTelemetry, when the device plane is armed:
+        # each rotation reports whether XLA actually reused the donated
+        # buffer's storage (donation-effectiveness reconciliation)
+        self._telemetry = telemetry
 
     def features(self, table: ft.FlowTable) -> jax.Array:
         i = self._turn
         self._turn = 1 - i
-        out = _FEATURES_INTO(self._bufs[i], table)
+        donated = self._bufs[i]
+        tel = self._telemetry
+        ptr = None
+        if tel is not None:
+            try:
+                # read BEFORE the donating dispatch deletes the input
+                ptr = donated.unsafe_buffer_pointer()
+            except Exception:  # noqa: BLE001 — telemetry must not inject
+                tel = None
+        out = _FEATURES_INTO(donated, table)
         self._bufs[i] = out
+        if tel is not None:
+            try:
+                tel.note_donation(
+                    "feature", out.unsafe_buffer_pointer() == ptr
+                )
+            except Exception:  # noqa: BLE001 — telemetry must not inject
+                pass
         return out
 
 

@@ -91,6 +91,21 @@ SITES: dict[str, str] = {
         "the rest of the batch parses normally — never a crash, never "
         "a torn row, and every other source's telemetry is untouched"
     ),
+    "obs.perf_ring": (
+        "obs/perf_recorder.PerfRecorder segment commit — the black-box "
+        "ring's atomic segment write fails (ENOSPC, dead disk, torn "
+        "rename); ABSORBED inside the recorder: that segment's samples "
+        "are dropped and counted (perf_ring_dropped_segments), the next "
+        "segment starts clean, and the serve tick never sees the "
+        "failure — the black box must not stall the plane it records"
+    ),
+    "obs.profiler": (
+        "obs/device.ProfilerCapture.capture — the on-demand "
+        "jax.profiler trace capture fails mid-start; ABSORBED at the "
+        "/profile endpoint: the request 500s with the error, the "
+        "failure is counted (profiler_capture_failures) and recorded, "
+        "the busy guard releases, and the serve loop never sees it"
+    ),
     "obs.stamp": (
         "ingest/protocol.stamp_records — the latency-provenance emit "
         "stamp itself fails; ABSORBED at the stamping seam: the batch "
