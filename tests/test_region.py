@@ -1,0 +1,451 @@
+"""Region-scale serving: the composed spine (fan-in x sharded x
+incremental x native ingest) must be indistinguishable from every
+single-spine path it fuses.
+
+Pinned here:
+
+- the composed CLI serve renders BYTE-IDENTICAL to the un-sharded
+  fan-in serve on the same lockstep traffic, serial and pipelined;
+- the same replay records through one direct source vs split across
+  two fan-in sources on the sharded spine produce identical per-flow
+  labels at every render (namespace-stripped: slots relocate across
+  namespaces, labels must not);
+- ``--shards 1`` is an EXPLICIT single-shard mesh — the sharded engine
+  and programs on one device, byte-identical output — not a silent
+  fallback to the un-sharded engine;
+- serving checkpoints work on the composed spine end to end through
+  the CLI (write mid-serve, restore sharded->sharded AND cross-spine
+  sharded->single);
+- kill-one-of-N blast radius across SHARD boundaries: a dead source's
+  quarantine evicts exactly its own namespace from the sharded table
+  (whose slots interleave round-robin across every shard), survivors'
+  slots byte-untouched;
+- the drift loop promotes ON the sharded spine: a retrained candidate
+  installs through ``ShardedFlowEngine.install_predict`` via
+  ``ShardedDriftGate``, and post-promotion renders serve the promoted
+  model's labels.
+"""
+
+import contextlib
+import io
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from traffic_classifier_sdn_tpu import cli
+from traffic_classifier_sdn_tpu.ingest import fanin
+from traffic_classifier_sdn_tpu.ingest.protocol import (
+    TelemetryRecord,
+    format_line,
+)
+from traffic_classifier_sdn_tpu.ingest.replay import SyntheticFlows
+from traffic_classifier_sdn_tpu.models import gnb
+from traffic_classifier_sdn_tpu.parallel import mesh as meshlib
+from traffic_classifier_sdn_tpu.parallel import table_sharded as ts
+from traffic_classifier_sdn_tpu.serving import retrain
+from traffic_classifier_sdn_tpu.serving.drift import (
+    PROMOTED,
+    RETRAINING,
+    DriftController,
+    ShardedDriftGate,
+    default_build_serving,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="region tests need the conftest's 8-device CPU mesh",
+)
+
+
+def _label_fn(_params, X):
+    return (jnp.sum(X, axis=1).astype(jnp.int32) % 6).astype(jnp.int32)
+
+
+@pytest.fixture(scope="module")
+def gnb_checkpoint(tmp_path_factory):
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (2, 12)),
+        "var": rng.gamma(2.0, 50.0, (2, 12)) + 1.0,
+        "class_prior": np.full(2, 0.5),
+    })
+    path = str(tmp_path_factory.mktemp("region_ckpt") / "gnb")
+    ck.save_model(path, "gnb", params, classes=("ping", "voice"))
+    return path
+
+
+def _serve(argv):
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        cli.main(argv)
+    return out.getvalue(), err.getvalue()
+
+
+def _composed_args(ckpt):
+    """The region serve minus --shards: two lockstep fan-in sources,
+    incremental label cache, native ingest where available."""
+    return [
+        "gaussiannb", "--native-checkpoint", ckpt,
+        "--source", "synthetic", "--synthetic-flows", "16",
+        "--capacity", "64", "--print-every", "2", "--max-ticks", "6",
+        "--idle-timeout", "0", "--table-rows", "8",
+        "--sources", "2", "--source-lockstep",
+        "--incremental", "auto", "--native-ingest", "auto",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# composed-spine byte identity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+def test_composed_region_byte_identical_to_unsharded(
+    gnb_checkpoint, pipeline
+):
+    """THE de-gating acceptance: fan-in x sharded x incremental x
+    native renders byte-identical to the un-sharded fan-in serve on
+    the same lockstep traffic — the shard scatter is invisible."""
+    common = _composed_args(gnb_checkpoint) + ["--pipeline", pipeline]
+    unsharded, _ = _serve(common)
+    composed, _ = _serve(common + ["--shards", "8"])
+    assert "Flow ID" in unsharded
+    assert composed == unsharded
+
+
+def _parse_tables(out):
+    """Rendered tables keyed (src, dst) — the namespace-stripped view
+    (slot ids deliberately dropped: namespacing relocates flows,
+    labels must not move with them)."""
+    tables, current = [], None
+    for line in out.splitlines():
+        if line.startswith("| Flow ID"):
+            current = {}
+            tables.append(current)
+            continue
+        if current is None or not line.startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        if len(cells) == 6 and cells[0] != "Flow ID":
+            _slot, src, dst, label, fwd, rev = cells
+            current[(src, dst)] = (label, fwd, rev)
+    return tables
+
+
+def test_composed_region_matches_direct_path_on_same_capture(
+    gnb_checkpoint, tmp_path
+):
+    """The same replay records through the DIRECT single-source
+    un-sharded serve vs split across two fan-in sources on the sharded
+    spine: identical per-flow labels at every render once namespaces
+    are stripped."""
+    syn = SyntheticFlows(n_flows=8, seed=7)
+    ticks = [syn.tick() for _ in range(6)]
+    whole = tmp_path / "whole.tsv"
+    part_a = tmp_path / "part_a.tsv"
+    part_b = tmp_path / "part_b.tsv"
+    macs_a = {syn._mac(i, 0) for i in range(4)}
+    with open(whole, "wb") as fw, open(part_a, "wb") as fa, \
+            open(part_b, "wb") as fb:
+        for tick in ticks:
+            for r in tick:
+                fw.write(format_line(r))
+                if r.eth_src in macs_a or r.eth_dst in macs_a:
+                    fa.write(format_line(r))
+                else:
+                    fb.write(format_line(r))
+    base = [
+        "gaussiannb", "--native-checkpoint", gnb_checkpoint,
+        "--capacity", "64", "--print-every", "2", "--max-ticks", "6",
+        "--table-rows", "8", "--incremental", "auto",
+        "--native-ingest", "auto", "--source-lockstep",
+    ]
+    direct, _ = _serve(base + ["--source-spec", f"capture:{whole}"])
+    composed, _ = _serve(base + [
+        "--shards", "8",
+        "--source-spec", f"capture:{part_a}",
+        "--source-spec", f"capture:{part_b}",
+    ])
+    t_one, t_two = _parse_tables(direct), _parse_tables(composed)
+    assert t_one and len(t_one) == len(t_two)
+    for i, (a, b) in enumerate(zip(t_one, t_two)):
+        assert a == b, f"render {i} diverged direct vs composed region"
+    assert len(t_one[-1]) == 8  # every conversation actually appeared
+
+
+def test_shards_one_is_explicit_single_shard_mesh(
+    gnb_checkpoint, monkeypatch
+):
+    """--shards 1 must build the SHARDED engine on a 1-device mesh and
+    render byte-identically — it used to silently mean un-sharded."""
+    built = []
+    orig = ts.ShardedFlowEngine
+
+    class Spy(orig):
+        def __init__(self, mesh, *a, **kw):
+            built.append(mesh)
+            super().__init__(mesh, *a, **kw)
+
+    monkeypatch.setattr(ts, "ShardedFlowEngine", Spy)
+    common = _composed_args(gnb_checkpoint)
+    single, _ = _serve(common)
+    assert not built  # --shards 0 is the single-device engine
+    one_shard, _ = _serve(common + ["--shards", "1"])
+    assert len(built) == 1
+    assert built[0].shape[meshlib.DATA_AXIS] == 1
+    assert one_shard == single
+
+
+# ---------------------------------------------------------------------------
+# serving checkpoints on the composed spine (CLI end to end)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_serve_checkpoints_write_and_restore(
+    gnb_checkpoint, tmp_path
+):
+    from traffic_classifier_sdn_tpu.io import serving_checkpoint as sc
+
+    ckpt_dir = str(tmp_path / "rotation")
+    common = _composed_args(gnb_checkpoint) + ["--shards", "8"]
+    baseline, _ = _serve(common)
+    saved, _ = _serve(common + [
+        "--serve-checkpoint-every", "3",
+        "--serve-checkpoint-dir", ckpt_dir,
+    ])
+    assert saved == baseline  # snapshotting never perturbs the render
+    members = sc.list_checkpoints(ckpt_dir)
+    assert members  # mid-serve snapshots actually rotated
+
+    # sharded -> sharded restore: the composed serve continues
+    restored, err = _serve(common + ["--restore-serve-state", ckpt_dir])
+    assert "Flow ID" in restored
+    assert "restored" in err and "tracked flows" in err
+
+    # cross-spine: the SAME checkpoint restores into the un-sharded
+    # serve (the format is spine-agnostic, global slot layout)
+    crossed, err = _serve(
+        _composed_args(gnb_checkpoint)
+        + ["--restore-serve-state", ckpt_dir]
+    )
+    assert "Flow ID" in crossed
+    assert "restored" in err and "tracked flows" in err
+
+
+# ---------------------------------------------------------------------------
+# blast radius across shard boundaries
+# ---------------------------------------------------------------------------
+
+
+def _drive_tier(tier, eng, gen, ticks):
+    evicted = {}
+    for _ in range(ticks):
+        batch = next(gen, None)
+        if batch is None:
+            break
+        eng.mark_tick()
+        if isinstance(batch, fanin.RawTick):
+            for sid, data in batch:
+                eng.ingest_bytes(data, sid)
+        else:
+            eng.ingest(batch)
+        eng.step()
+        for sid in tier.take_evictions():
+            evicted[sid] = eng.evict_source(sid)
+    return evicted
+
+
+def _source_slots(eng, sid):
+    if eng.native:
+        return sorted(eng.batcher.slots_for_source(sid).tolist())
+    return sorted(eng.index.slots_for_source(sid))
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_kill_one_of_three_sharded_evicts_only_its_namespace(native):
+    """A dead source's quarantine evicts exactly its own namespace from
+    the SHARDED table. The global slots interleave round-robin across
+    all 8 shards (slot g on shard g % 8), so both the eviction and the
+    survivors' untouched state necessarily cross shard boundaries."""
+    if native:
+        from traffic_classifier_sdn_tpu.native import engine as ne
+
+        if not ne.available():
+            pytest.skip("C++ engine unavailable")
+    mesh = meshlib.make_mesh()
+    specs = [
+        fanin.SourceSpec(kind="synthetic", sid=i, n_flows=4, seed=i,
+                         mac_base=i * 4, lockstep=True)
+        for i in range(3)
+    ]
+    tier = fanin.FanInIngest(specs, quarantine_s=0.1, raw=native)
+    eng = ts.ShardedFlowEngine(
+        mesh, 64, predict_fn=_label_fn, params=None, table_rows=8,
+        native=native,
+    )
+    gen = tier.ticks(tick_timeout=5.0)
+    try:
+        _drive_tier(tier, eng, gen, 3)
+        assert eng.num_flows() == 12
+        before = {sid: _source_slots(eng, sid) for sid in range(3)}
+        assert all(len(s) == 4 for s in before.values())
+        # the namespaces genuinely span shards: 12 slots over 8 shards
+        shards_touched = {g % eng.n_shards for s in before.values()
+                         for g in s}
+        assert len(shards_touched) > 1
+
+        tier.kill_source(1)
+        evicted = {}
+        deadline = time.monotonic() + 20.0
+        while not evicted and time.monotonic() < deadline:
+            evicted.update(_drive_tier(tier, eng, gen, 1))
+        assert evicted == {1: 4}
+        # blast radius: namespace 1 gone, 0 and 2 byte-untouched
+        assert _source_slots(eng, 1) == []
+        assert _source_slots(eng, 0) == before[0]
+        assert _source_slots(eng, 2) == before[2]
+        assert eng.num_flows() == 8
+        # survivors render: the evicted rows are really cleared on
+        # their shards (a stale row would surface in the ranked read)
+        rows, _ = eng.tick_render(now=eng.last_time, idle_seconds=None)
+        assert {s for s, *_ in rows} == set(before[0] + before[2])
+    finally:
+        gen.close()
+
+
+# ---------------------------------------------------------------------------
+# drift promotion ON the sharded spine
+# ---------------------------------------------------------------------------
+
+
+def _teacher(params, X):
+    return (np.asarray(X)[:, 0] > 500.0).astype(np.int32)
+
+
+def _batch(lo, hi, n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 12), np.float32)
+    X[: n // 2, 0] = lo * (1 + 0.01 * rng.rand(n // 2))
+    X[n // 2:, 0] = hi * (1 + 0.01 * rng.rand(n - n // 2))
+    X[:, 1] = 1.0
+    return X
+
+
+def _boot_params():
+    return gnb.from_numpy({
+        "theta": np.asarray(
+            [[10.0] * 12, [1000.0] * 12], dtype=np.float64
+        ),
+        "var": np.ones((2, 12), np.float64),
+        "class_prior": np.full(2, 0.5),
+    })
+
+
+def _wait_retrain(ctl, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    while ctl._retrainer.poll() == retrain.RUNNING:
+        if time.monotonic() > deadline:
+            pytest.fail("background retrain never finished")
+        time.sleep(0.05)
+
+
+def test_sharded_drift_promotion_installs_through_engine(tmp_path):
+    """Drift e2e on the sharded spine: shifted captures trip the
+    monitor, the retrained candidate passes its parity probes and
+    installs through ShardedDriftGate -> engine.install_predict — and
+    the engine's REBUILT read programs serve the promoted model's
+    labels on the next render."""
+    mesh = meshlib.make_mesh()
+    boot_fn, boot_p = default_build_serving(
+        "gnb", ("ping", "voice")
+    )(_boot_params())
+    eng = ts.ShardedFlowEngine(
+        mesh, 64, predict_fn=boot_fn, params=boot_p, table_rows=8,
+        incremental=True,
+    )
+    gate = ShardedDriftGate(eng)
+    ctl = DriftController(
+        gate, family="gnb", classes=("ping", "voice"),
+        directory=str(tmp_path / "drift"),
+        window=3, threshold=3.0, trips=2, calibration_windows=2,
+        probe_successes=2, min_retrain_rows=16,
+        boot_params=_boot_params(),
+    )
+    try:
+        i = 0
+        while ctl.state != PROMOTED and i < 200:
+            i += 1
+            shifted = i > 12
+            lo, hi = (100.0, 10000.0) if shifted else (10.0, 1000.0)
+            X = _batch(lo, hi, seed=i)
+            # the serve loop's feed: per-render (features, labels)
+            gate.feed_capture(X, _teacher(None, X))
+            ctl.poll()
+            if ctl.state == RETRAINING:
+                _wait_retrain(ctl)
+        assert ctl.state == PROMOTED
+        assert gate.swapped
+        assert eng._predict_fn is not boot_fn  # really installed
+
+        # the rebuilt read programs serve the PROMOTED model: rendered
+        # labels equal the installed predict on the rendered features
+        for t in (1, 2):
+            eng.mark_tick()
+            eng.ingest([
+                TelemetryRecord(
+                    time=t, datapath="1", in_port=1,
+                    eth_src=f"s{i:02x}", eth_dst=f"d{i:02x}",
+                    out_port=2, packets=10 * t, bytes=1000 * t + i,
+                )
+                for i in range(12)
+            ])
+            eng.step()
+        rows, _ = eng.tick_render(now=eng.last_time, idle_seconds=None)
+        assert rows
+        slots = [s for s, *_ in rows]
+        X = eng.feature_sample(slots)
+        want = np.asarray(eng._predict_fn(eng.params, X)).astype(np.int64)
+        got = np.asarray([c for _, c, *_ in rows]).astype(np.int64)
+        np.testing.assert_array_equal(got, want)
+    finally:
+        ctl.close()
+
+
+def test_sharded_scatter_warm_covers_varied_wire_buckets():
+    """``warmup_serving`` on the sharded spine primes EVERY plausible
+    write-side wire bucket (``ShardedFlowEngine.warmup_scatter``): a
+    serve whose per-tick batch sizes vary — exactly what non-lockstep
+    fan-in and sub-1.0 churn produce — must never pay an apply compile
+    inside a live tick. Regression pin for the region bench's
+    ``compiles_in_measured_region: 0`` gate."""
+    from traffic_classifier_sdn_tpu.obs.device import DeviceTelemetry
+    from traffic_classifier_sdn_tpu.serving.warmup import warmup_serving
+
+    mesh = meshlib.make_mesh()
+    eng = ts.ShardedFlowEngine(
+        mesh, 4096, predict_fn=_label_fn, params=None,
+        table_rows=16, incremental=True,
+    )
+    with DeviceTelemetry() as dev:
+        stats = warmup_serving(
+            eng, _label_fn, None, table_rows=16, incremental=True
+        )
+        assert any(
+            w.startswith("sharded.apply_dirty[") for w in stats["warmed"]
+        )
+        c0 = dev.status()["jit_compiles"]
+        # churn the batch size across bucket boundaries with ZERO warm
+        # ticks beforehand — every wire shape must already be compiled
+        for churn in (0.01, 0.3, 1.0, 0.05):
+            gen = SyntheticFlows(1500, seed=3, churn=churn)
+            eng.mark_tick()
+            eng.ingest(gen.tick())
+            eng.step()
+            eng.tick_render(now=eng.last_time, idle_seconds=3600)
+        assert dev.status()["jit_compiles"] == c0

@@ -157,13 +157,20 @@ def _make_engine(args, native, raw_fn, params, incremental=False):
     from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
 
     if args.shards >= 1:
+        import jax
+
         from traffic_classifier_sdn_tpu.parallel import (
             mesh as meshlib,
             table_sharded as tsh,
         )
 
+        # explicit sub-mesh over the leading devices (the region sweep
+        # varies shard count under one forced device pool)
         return tsh.ShardedFlowEngine(
-            meshlib.make_mesh(n_data=args.shards, n_state=1),
+            meshlib.make_mesh(
+                n_data=args.shards, n_state=1,
+                devices=jax.devices()[: args.shards],
+            ),
             args.capacity, predict_fn=raw_fn, params=params,
             table_rows=args.table_rows, native=native,
             incremental=incremental,
@@ -719,6 +726,320 @@ def _run_fanin_sweep(args, native, predict, params,
     print(json.dumps(out), flush=True)
 
 
+def _region_identity(max_shards: int) -> dict:
+    """Deterministic byte-identity: the composed region serve (fan-in ×
+    sharded × incremental × native ingest) vs EACH single-spine path,
+    end to end through the real CLI on lockstep synthetic traffic. The
+    composed render must be byte-equal to every de-composition — the
+    sweep's perf claims only count if the fused spine is literally the
+    same serve."""
+    import contextlib
+    import io
+    import tempfile
+
+    import numpy as np
+
+    from traffic_classifier_sdn_tpu import cli as _cli
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+    from traffic_classifier_sdn_tpu.models import gnb as _gnb
+
+    rng = np.random.RandomState(0)
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "gnb")
+        ck.save_model(
+            ckpt, "gnb",
+            _gnb.from_numpy({
+                "theta": rng.gamma(2.0, 100.0, (2, 12)),
+                "var": rng.gamma(2.0, 50.0, (2, 12)) + 1.0,
+                "class_prior": np.full(2, 0.5),
+            }),
+            classes=("ping", "voice"),
+        )
+        base = [
+            "gaussiannb", "--native-checkpoint", ckpt,
+            "--source", "synthetic", "--synthetic-flows", "16",
+            "--sources", "2", "--source-lockstep",
+            "--capacity", "64", "--print-every", "2",
+            "--max-ticks", "6", "--idle-timeout", "0",
+            "--table-rows", "8",
+        ]
+
+        def run(extra):
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out), \
+                    contextlib.redirect_stderr(io.StringIO()):
+                _cli.main(base + extra)
+            return out.getvalue()
+
+        s = str(max_shards)
+        composed = run(["--shards", s, "--incremental", "auto",
+                        "--native-ingest", "auto"])
+        spines = {
+            "unsharded_fanin": ["--incremental", "auto",
+                                "--native-ingest", "auto"],
+            "sharded_full_predict": ["--shards", s, "--incremental",
+                                     "off", "--native-ingest", "auto"],
+            "sharded_python_ingest": ["--shards", s, "--incremental",
+                                      "auto", "--native-ingest", "off"],
+            "pipelined_composed": ["--shards", s, "--incremental",
+                                   "auto", "--native-ingest", "auto",
+                                   "--pipeline", "on"],
+        }
+        verdicts = {name: run(extra) == composed
+                    for name, extra in spines.items()}
+    return verdicts
+
+
+# region-sweep warm ticks per level: tick 0 (pump spin-up + first-flush
+# bucket compiles) and tick 1 (the steady-churn dirty-bucket compile)
+# are excluded from both the timing and the compile-count region
+_WARM_TICKS = 2
+
+
+def _run_region_sweep(args, native, predict, params, raw_fn,
+                      n_flows: int, dev=None) -> None:
+    """The region sweep (docs/artifacts/serve_region_cpu.json): drive
+    the COMPOSED spine — real fan-in tier feeding the mesh-sharded
+    table with per-shard dirty masks/label caches and native ingest —
+    across (sources × shards × churn), and measure the aggregate churn
+    it holds under the 1 s serve cadence.
+
+    shards=0 levels run the single-device fan-in path (the un-sharded
+    comparator, full-table predict — the historical sweep); sharded
+    levels run the whole composed spine (incremental ON: the per-shard
+    dirty-set read is part of what got de-gated). Two comparators must
+    both fall: the recorded un-sharded fan-in knee
+    (serve_fanin_sources_native_cpu.json) and this sweep's own
+    single-source sharded level — otherwise the de-gating bought
+    nothing. Byte-identity vs every single-spine path rides in the
+    same artifact (``render_identical``), and compiles inside any
+    measured region are counted and gated."""
+    import numpy as np
+
+    import jax
+
+    from traffic_classifier_sdn_tpu.ingest import fanin
+    from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+    from traffic_classifier_sdn_tpu.serving.warmup import warmup_serving
+
+    src_levels = [int(x) for x in args.region_sources.split(",")]
+    shard_levels = [int(x) for x in args.region_shards.split(",")]
+    churn_levels = [float(x) for x in args.region_churn.split(",")]
+    max_shards = max([s for s in shard_levels if s >= 1], default=0)
+    if max_shards < 1:
+        sys.exit("--region-sweep needs at least one sharded level")
+
+    identity = _region_identity(max_shards)
+
+    out_levels = []
+    compiles_in_measured = 0
+    for shards in shard_levels:
+        for churn in churn_levels:
+            for n_sources in src_levels:
+                per = max(1, n_flows // n_sources)
+                specs = [
+                    fanin.SourceSpec(
+                        kind="synthetic", sid=sid, n_flows=per,
+                        seed=sid, mac_base=sid * per, churn=churn,
+                        max_ticks=args.ticks,
+                        interval=args.source_interval,
+                    )
+                    for sid in range(n_sources)
+                ]
+                tier = fanin.FanInIngest(
+                    specs, quarantine_s=5.0, raw=native,
+                    queue_records=max(1 << 16, 4 * 2 * n_flows),
+                )
+                args.shards = shards
+                eng = _make_engine(args, native, raw_fn, params,
+                                   incremental=shards >= 1)
+                warmup_serving(
+                    eng, predict, params, table_rows=args.table_rows,
+                    idle_timeout=None if shards >= 1 else 3600,
+                    incremental=shards >= 1,
+                )
+                timings = {k: [] for k in ("drain", "tick")}
+                n_records = 0
+                roster = []
+                compiles_at_steady = None
+                gen = tier.ticks(
+                    tick_timeout=max(10.0, 4 * args.source_interval)
+                )
+                t_wall0 = time.perf_counter()
+                try:
+                    for ti in range(args.ticks * 2):
+                        t_w = time.perf_counter()
+                        batch = next(gen, None)
+                        if batch is None:
+                            break
+                        t0 = time.perf_counter()
+                        eng.mark_tick()
+                        if isinstance(batch, fanin.RawTick):
+                            n_records += sum(
+                                eng.ingest_bytes(data, sid)
+                                for sid, data in batch
+                            )
+                        else:
+                            n_records += eng.ingest(batch)
+                        eng.step()
+                        for sid in tier.take_evictions():
+                            eng.evict_source(sid)
+                        if shards >= 1:
+                            ranked, _ev = eng.tick_render(
+                                now=eng.last_time, idle_seconds=3600
+                            )
+                        else:
+                            labels = predict(params, eng.features())
+                            jax.block_until_ready(labels)
+                            ranked = eng.render_sample(
+                                labels, args.table_rows
+                            )
+                        sample = eng.slot_metadata(
+                            slots=[s for s, *_ in ranked]
+                        )
+                        rows = [
+                            (s, *sample[s], c)
+                            for s, c, *_ in ranked if s in sample
+                        ]
+                        if shards < 1:
+                            eng.evict_idle(
+                                now=eng.last_time, idle_seconds=3600
+                            )
+                        t1 = time.perf_counter()
+                        assert len(rows) <= args.table_rows
+                        timings["drain"].append(t0 - t_w)
+                        timings["tick"].append(t1 - t0)
+                        if (dev is not None
+                                and compiles_at_steady is None
+                                and len(timings["tick"]) >= _WARM_TICKS):
+                            # measured region = steady ticks: tick 0
+                            # carries pump spin-up plus the first-flush
+                            # bucket compiles, tick 1 the level's
+                            # steady-churn dirty-bucket compile — both
+                            # are warmup, not serve work
+                            compiles_at_steady = (
+                                dev.status()["jit_compiles"]
+                            )
+                        roster = tier.roster()
+                finally:
+                    gen.close()
+                wall = time.perf_counter() - t_wall0
+                if dev is not None and compiles_at_steady is not None:
+                    compiles_in_measured += (
+                        dev.status()["jit_compiles"] - compiles_at_steady
+                    )
+                steady = (timings["tick"][_WARM_TICKS:]
+                          or timings["tick"])
+                p50 = float(np.median(steady))
+                total_drops = sum(r["drops"] for r in roster)
+                holds = p50 <= 1.0 and total_drops == 0
+                serve_ticks = len(timings["tick"])
+                level = {
+                    "sources": n_sources,
+                    "shards": shards,
+                    "churn_fraction": churn,
+                    "flows_per_source": per,
+                    "incremental": shards >= 1,
+                    "records_ingested": n_records,
+                    "serve_ticks": serve_ticks,
+                    "wall_s": round(wall, 3),
+                    "aggregate_records_per_tick": (
+                        round(n_records / serve_ticks)
+                        if serve_ticks else 0
+                    ),
+                    "records_per_sec": (
+                        round(n_records / wall) if wall else 0
+                    ),
+                    "tick_processing_p50_ms": round(p50 * 1e3, 2),
+                    "tick_processing_p95_ms": round(
+                        float(np.percentile(steady, 95)) * 1e3, 2
+                    ),
+                    "tracked_flows": eng.num_flows(),
+                    "total_drops": total_drops,
+                    "holds_1s_cadence": holds,
+                }
+                out_levels.append(level)
+                print(
+                    f"# sources={n_sources} shards={shards} "
+                    f"churn={churn} tick_p50="
+                    f"{level['tick_processing_p50_ms']} ms "
+                    f"drops={total_drops} holds={holds}",
+                    file=sys.stderr, flush=True,
+                )
+                del tier, eng
+
+    # comparator 1: the recorded un-sharded fan-in knee
+    knee_rate = None
+    try:
+        with open(args.baseline_fanin) as f:
+            knee_doc = json.load(f)
+        knee_n = knee_doc["max_sources_holding_1s_p50"]
+        knee_lv = next(
+            lv for lv in knee_doc["levels"] if lv["sources"] == knee_n
+        )
+        knee_rate = round(knee_lv["records_ingested"]
+                          / knee_lv["wall_s"])
+    except (OSError, KeyError, StopIteration, ValueError) as e:
+        print(f"# no un-sharded knee baseline ({e})",
+              file=sys.stderr, flush=True)
+
+    # comparator 2: this sweep's own single-source sharded level
+    single_sharded = [
+        lv for lv in out_levels
+        if lv["sources"] == 1 and lv["shards"] >= 1
+        and lv["churn_fraction"] == max(churn_levels)
+    ]
+    single_rate = (max(lv["records_per_sec"] for lv in single_sharded)
+                   if single_sharded else None)
+
+    composed = [
+        lv for lv in out_levels
+        if lv["shards"] >= 1 and lv["sources"] > 1
+        and lv["holds_1s_cadence"]
+    ]
+    best_rate = (max(lv["records_per_sec"] for lv in composed)
+                 if composed else 0)
+    max_churn = (max(lv["aggregate_records_per_tick"]
+                     for lv in composed) if composed else 0)
+
+    out = {
+        "metric": "serve_region",
+        "capacity": args.capacity,
+        "aggregate_flows_per_tick": n_flows,
+        "ticks_per_source": args.ticks,
+        "source_interval_s": args.source_interval,
+        "table_rows_rendered": args.table_rows,
+        "predict_model": args.model,
+        "native_ingest": native,
+        "platform": jax.devices()[0].platform,
+        "max_aggregate_records_per_tick_holding_1s": max_churn,
+        "best_composed_records_per_sec": best_rate,
+        "unsharded_fanin_knee_records_per_sec": knee_rate,
+        "exceeds_unsharded_fanin_knee": (
+            best_rate > knee_rate if knee_rate is not None else None
+        ),
+        "single_source_sharded_records_per_sec": single_rate,
+        "exceeds_single_source_sharded": (
+            best_rate > single_rate if single_rate is not None else None
+        ),
+        "render_identical": all(identity.values()),
+        "identity_paths": identity,
+        "compiles_in_measured_region": compiles_in_measured,
+        **(
+            {"jit_compiles": dev.status()["jit_compiles"]}
+            if dev is not None else {}
+        ),
+        "levels": out_levels,
+    }
+    print(json.dumps(out), flush=True)
+    if compiles_in_measured > 0:
+        sys.exit(
+            f"FAIL: {compiles_in_measured} compile(s) fired inside "
+            "the region sweep's measured ticks — the sweep timed XLA, "
+            "not the composed spine"
+        )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--capacity", type=int, default=1 << 20)
@@ -745,6 +1066,42 @@ def main() -> None:
         "--source-interval", type=float, default=1.0, metavar="SECS",
         help="fan-in sweep emission cadence per source (default 1.0, "
         "the reference monitor's poll rate)",
+    )
+    ap.add_argument(
+        "--region-sweep", action="store_true",
+        help="run the REGION sweep: the composed spine (fan-in × "
+        "sharded × incremental × native ingest) across "
+        "(--region-sources × --region-shards × --region-churn), plus "
+        "a lockstep byte-identity check of the composed serve vs "
+        "every single-spine path through the real CLI — one "
+        "serve_region JSON object "
+        "(docs/artifacts/serve_region_cpu.json)",
+    )
+    ap.add_argument(
+        "--region-sources", default="1,96,384", metavar="N0,N1,...",
+        help="region sweep source-count axis (default 1,96,384 — 1 "
+        "anchors the single-source sharded comparator)",
+    )
+    ap.add_argument(
+        "--region-shards", default="0,8", metavar="S0,S1,...",
+        help="region sweep shard axis (default 0,8 — 0 anchors the "
+        "un-sharded fan-in comparator; sharded levels run the "
+        "composed spine with per-shard dirty masks/label caches)",
+    )
+    ap.add_argument(
+        "--region-churn", default="1.0,0.25", metavar="C0,C1,...",
+        help="region sweep churn axis: fraction of each source's flow "
+        "population emitting per tick (default 1.0,0.25)",
+    )
+    ap.add_argument(
+        "--baseline-fanin",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "docs", "artifacts", "serve_fanin_sources_native_cpu.json",
+        ),
+        metavar="PATH",
+        help="recorded un-sharded fan-in sweep whose knee the region "
+        "sweep must beat (default: the committed artifact)",
     )
     ap.add_argument(
         "--churn-sweep", default=None, metavar="L0,L1,...",
@@ -830,10 +1187,17 @@ def main() -> None:
     )
     args = ap.parse_args()
 
+    # the region sweep varies shard count per level under ONE device
+    # pool: force the pool to the widest sharded level
+    forced_devices = args.shards
+    if args.region_sweep:
+        forced_devices = max(
+            [int(x) for x in args.region_shards.split(",")] + [0]
+        )
     if args.platform == "cpu":
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"
-        if args.shards >= 1:
+        if forced_devices >= 1:
             import re
 
             flags = re.sub(
@@ -842,7 +1206,8 @@ def main() -> None:
             )
             os.environ["XLA_FLAGS"] = (
                 flags
-                + f" --xla_force_host_platform_device_count={args.shards}"
+                + f" --xla_force_host_platform_device_count="
+                f"{forced_devices}"
             ).strip()
     sys.path.insert(
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -886,6 +1251,11 @@ def main() -> None:
     dev.attach()
 
     predict, params, raw_fn = _build_model(args)
+
+    if args.region_sweep:
+        _run_region_sweep(args, native, predict, params, raw_fn,
+                          n_flows, dev=dev)
+        return
 
     if args.sources_sweep is not None:
         _run_fanin_sweep(args, native, predict, params, n_flows,

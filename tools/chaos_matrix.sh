@@ -61,6 +61,7 @@ GROUPS_KEYS=(
   "drift:drift_window or retrain_fit or promote_swap or promote_rollback or drift_loop"
   "dirty:serve_dirty_mask or serve_label_cache"
   "fanin:fanin_put or fanin_source_dead"
+  "region:region_source_dead or region_dirty_mask or region_fanin_put"
   "native_ingest:native_parse"
   "obs:obs_stamp or sigusr1"
   "obsdev:perf_ring or profiler"

@@ -101,6 +101,21 @@ if [ -f tools/bench_e2e_live.py ]; then
   fi
 fi
 
+# region composition on chip: fan-in × sharded mesh × incremental ×
+# native ingest in one serve, swept over (sources × shards × churn)
+# with the byte-identity phase and the zero-compiles-in-measured-ticks
+# gate — the TPU twin of serve_region_cpu.json. Runs behind the doctor
+# preflight above like everything else; short per-level kernels, but
+# the grid is 12 levels, so it gets the full step budget.
+run_step 1200 /tmp/tpu_day_region.log python tools/bench_serve.py \
+  --region-sweep --platform default \
+  --capacity 262144 --flows-per-tick 131072 --ticks 6 --table-rows 64
+if [ "$STEP_OK" = 1 ] && grep '^{' /tmp/tpu_day_region.log | tail -1 \
+    | grep -q '"platform": "tpu"'; then
+  grep '^{' /tmp/tpu_day_region.log | tail -1 \
+    > docs/artifacts/serve_region_tpu.json
+fi
+
 # open-set eval on chip: the six-family fit + score sweep is short
 # kernels only (~2 min) — the TPU twin of openset_eval_cpu.json
 if [ -f tools/bench_openset.py ]; then

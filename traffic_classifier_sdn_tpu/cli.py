@@ -183,7 +183,11 @@ def _build_parser() -> argparse.ArgumentParser:
         "--shards", type=int, default=0,
         help="partition the flow table over an N-device mesh "
         "(parallel/table_sharded.py) — serving capacity beyond one "
-        "chip's table; requires N visible devices",
+        "chip's table; requires N visible devices. 1 is an EXPLICIT "
+        "single-shard mesh (the sharded engine and programs on one "
+        "device); 0 (default) is the single-device engine. Composes "
+        "with --sources, --incremental, --native-ingest, serving "
+        "checkpoints, and --drift (the region serve)",
     )
     p.add_argument(
         "--save-serve-state", default=None, metavar="FILE",
@@ -448,10 +452,21 @@ def _build_parser() -> argparse.ArgumentParser:
         "feature stream against a training-time reference, retrain in "
         "the background on sustained divergence, and hot-promote the "
         "fresh checkpoint through a parity-gated probe — wrong-but-"
-        "fresh never promotes, a bad promotion rolls back. 'auto' "
-        "enables it for single-device serves (sharded serves are "
-        "skipped); with no drift the output is byte-identical to "
-        "'off'. Requires --drift-dir",
+        "fresh never promotes, a bad promotion rolls back. Works on "
+        "both spines: single-device serves hot-swap through the "
+        "DriftGate, sharded serves install through the engine's "
+        "install_predict (per-shard read programs rebuilt, label "
+        "caches reset); with no drift the output is byte-identical "
+        "to 'off'. Requires --drift-dir",
+    )
+    p.add_argument(
+        "--drift-follow", action="store_true",
+        help="fleet mode (serving/fleet.py): adopt newer rotation "
+        "members that PEER serves sharing this --drift-dir stage, as "
+        "this serve's own candidates — each adoption still earns its "
+        "own parity probes against this serve's live labels before "
+        "installing, and a rejected adoption never discards the "
+        "peer's member. Requires --drift auto",
     )
     p.add_argument(
         "--drift-dir", default=None, metavar="DIR",
@@ -751,14 +766,14 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
 
     # serve-durability flag validation runs before any model/device work
     # so misuse fails fast (and identically with or without checkpoints)
-    sharded = args.shards > 1
-    if sharded and (args.restore_serve_state or args.save_serve_state
-                    or args.serve_checkpoint_every):
-        sys.exit("serving-state checkpoints are single-device (no --shards)")
-    if _fanin_active(args) and sharded:
-        # the sharded engine has no per-slot source map, so a dead
-        # source's namespace could not be quarantine-evicted
-        sys.exit("the fan-in ingest tier is single-device (no --shards)")
+    #
+    # --shards >= 1 is the sharded spine; 1 is an EXPLICIT single-shard
+    # mesh (same wire scatter, same shard_mapped read programs, one
+    # device) — it used to silently mean "un-sharded", which made
+    # "--shards 1" lie about which engine served. Serving checkpoints,
+    # the fan-in tier, and the drift loop all compose with the sharded
+    # spine now; the region serve is their fusion.
+    sharded = args.shards >= 1
     if args.serve_checkpoint_every and not args.serve_checkpoint_dir:
         sys.exit("--serve-checkpoint-every needs --serve-checkpoint-dir")
     if args.obs_dump_on_exit and not args.obs_dir:
@@ -769,10 +784,15 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             "read side has no single render-visibility point to close "
             "an end-to-end measurement at (auto skips it)"
         )
-    if args.drift != "off" and not sharded and not args.drift_dir:
+    if args.drift != "off" and not args.drift_dir:
         sys.exit(
             "--drift auto needs --drift-dir (the candidate checkpoint "
             "rotation and rollback target)"
+        )
+    if args.drift_follow and args.drift == "off":
+        sys.exit(
+            "--drift-follow needs --drift auto (the follower IS the "
+            "drift loop, adopting peers' rotation members)"
         )
 
     name = SUBCOMMAND_ALIASES[args.subcommand]
@@ -851,27 +871,7 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
     # owns the per-slot source map behind namespace eviction, so
     # multi-source fan-in rides the raw wire path end to end
     use_native = _use_native(args)
-    if args.restore_serve_state:
-        from .io import serving_checkpoint as _sc
-
-        engine = _sc.restore(args.restore_serve_state, recorder=recorder)
-        if args.incremental != "off":
-            # restored rows predate the label cache: everything starts
-            # dirty, so the first render re-predicts the whole table
-            engine.enable_dirty_tracking()
-        if engine.table.capacity != args.capacity:
-            print(
-                f"WARNING: --capacity {args.capacity} ignored — the "
-                f"checkpoint fixes capacity at {engine.table.capacity}",
-                file=sys.stderr,
-            )
-            args.capacity = engine.table.capacity
-        print(
-            f"restored {engine.num_flows()} tracked flows from "
-            f"{args.restore_serve_state}",
-            file=sys.stderr,
-        )
-    elif sharded:
+    if sharded:
         from .parallel import mesh as meshlib
         from .parallel import table_sharded as tsh
 
@@ -890,12 +890,76 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
                 "--shards requires a bounded --table-rows "
                 "(the sharded render merges per-shard top-k candidates)"
             )
-        engine = tsh.ShardedFlowEngine(
-            meshlib.make_mesh(n_data=args.shards, n_state=1),
-            args.capacity, predict_fn=serve_fn, params=serve_params,
-            table_rows=args.table_rows,
-            native=use_native,
-            incremental=args.incremental != "off",
+        import jax as _jax
+
+        _devs = _jax.devices()
+        if args.shards > len(_devs):
+            sys.exit(
+                f"--shards {args.shards} needs {args.shards} visible "
+                f"devices (have {len(_devs)})"
+            )
+        # an explicit sub-mesh (--shards 1 included) takes the leading
+        # devices; make_mesh's all-devices default stays for the tools
+        mesh = meshlib.make_mesh(
+            n_data=args.shards, n_state=1, devices=_devs[:args.shards],
+        )
+        if args.restore_serve_state:
+            from .io import serving_checkpoint as _sc
+
+            # composed-spine restore: the checkpoint's GLOBAL leaf
+            # layout scatters across the mesh (restore_sharded) — the
+            # format is spine-agnostic, so a single-device checkpoint
+            # restores into a sharded serve and vice versa
+            try:
+                engine = _sc.restore_sharded(
+                    args.restore_serve_state, mesh,
+                    predict_fn=serve_fn, params=serve_params,
+                    table_rows=args.table_rows,
+                    incremental=args.incremental != "off",
+                    recorder=recorder,
+                )
+            except ValueError as e:
+                sys.exit(str(e))
+            if engine.capacity != args.capacity:
+                print(
+                    f"WARNING: --capacity {args.capacity} ignored — "
+                    f"the checkpoint fixes capacity at "
+                    f"{engine.capacity}",
+                    file=sys.stderr,
+                )
+                args.capacity = engine.capacity
+            print(
+                f"restored {engine.num_flows()} tracked flows from "
+                f"{args.restore_serve_state}",
+                file=sys.stderr,
+            )
+        else:
+            engine = tsh.ShardedFlowEngine(
+                mesh,
+                args.capacity, predict_fn=serve_fn, params=serve_params,
+                table_rows=args.table_rows,
+                native=use_native,
+                incremental=args.incremental != "off",
+            )
+    elif args.restore_serve_state:
+        from .io import serving_checkpoint as _sc
+
+        engine = _sc.restore(args.restore_serve_state, recorder=recorder)
+        if args.incremental != "off":
+            # restored rows predate the label cache: everything starts
+            # dirty, so the first render re-predicts the whole table
+            engine.enable_dirty_tracking()
+        if engine.table.capacity != args.capacity:
+            print(
+                f"WARNING: --capacity {args.capacity} ignored — the "
+                f"checkpoint fixes capacity at {engine.table.capacity}",
+                file=sys.stderr,
+            )
+            args.capacity = engine.table.capacity
+        print(
+            f"restored {engine.num_flows()} tracked flows from "
+            f"{args.restore_serve_state}",
+            file=sys.stderr,
         )
     else:
         engine = FlowStateEngine(
@@ -964,49 +1028,74 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             # compile the warmup contract missed.
             dev.mark_warmup_complete()
 
-    # Drift loop (serving/drift.py): wraps the (possibly ladder-
-    # guarded) predict in a DriftGate — a transparent passthrough until
-    # the first promotion, the hot-swap point after it. Built AFTER
-    # warmup so warmup primes the BOOT model's programs (a candidate's
-    # serving program compiles during its parity probes — the exact
-    # serving shape — so the first post-swap tick is already warm).
-    # 'auto' skips sharded serves: the sharded engine binds its predict
-    # at construction, so there is no single swap point to promote into.
+    # Drift loop (serving/drift.py): on the single-device spine it
+    # wraps the (possibly ladder-guarded) predict in a DriftGate — a
+    # transparent passthrough until the first promotion, the hot-swap
+    # point after it. The SHARDED spine compiles its predict INTO the
+    # per-shard read programs, so there is no call site to wrap:
+    # ShardedDriftGate routes install through engine.install_predict
+    # (rebuilds the read programs, resets the per-shard label caches)
+    # and the serve loop hands it per-render (features, labels)
+    # captures explicitly. Built AFTER warmup so warmup primes the
+    # BOOT model's programs (a candidate's serving program compiles
+    # during its parity probes — the exact serving shape — so the
+    # first post-swap tick is already warm).
     drift = None
+    drift_feed = None  # sharded capture hand-off (fed per render tick)
     degrade_surface = degrade  # what the render/healthz paths consult
-    if args.drift != "off" and not sharded:
+    if args.drift != "off":
         from .serving.drift import (
             DriftController,
             DriftGate,
             GateLadderView,
+            ShardedDriftGate,
         )
 
         from .serving.drift import default_build_serving
 
-        gate = DriftGate(predict)
         _build_bare = default_build_serving(
             name, tuple(model.classes.names)
         )
+        if sharded:
+            gate = ShardedDriftGate(engine)
+            drift_feed = gate
 
-        def _build_promoted(params):
-            """Candidate params → the serving pair a promotion installs:
-            the default resolution (models.serving_path + jit rule),
-            PLUS the degradation ladder when --degrade engaged — a
-            promoted checkpoint must keep the watchdog/fallback
-            guarantees, not silently shed them at the first swap."""
-            pred, p = _build_bare(params)
-            if degrade is None or getattr(pred, "host_native", False):
+            def _build_promoted(params):
+                """Candidate params → the serving pair a promotion
+                installs on the sharded spine. A host-native candidate
+                can never install here (its predict would have to
+                compile into shard_map) — raising makes it a counted
+                retrain failure instead of a mid-promotion crash."""
+                pred, p = _build_bare(params)
+                if getattr(pred, "host_native", False):
+                    raise RuntimeError(
+                        "host-native candidate kernels cannot install "
+                        "on the sharded spine"
+                    )
                 return pred, p
-            from .models import resolve_fallback
-            from .serving.degrade import DegradeLadder
+        else:
+            gate = DriftGate(predict)
 
-            return DegradeLadder(
-                pred, resolve_fallback(name, params),
-                deadline=args.device_deadline,
-                probe_every=args.probe_every,
-                probe_successes=args.probe_successes,
-                metrics=m, recorder=recorder,
-            ), p
+            def _build_promoted(params):
+                """Candidate params → the serving pair a promotion
+                installs: the default resolution (models.serving_path +
+                jit rule), PLUS the degradation ladder when --degrade
+                engaged — a promoted checkpoint must keep the
+                watchdog/fallback guarantees, not silently shed them at
+                the first swap."""
+                pred, p = _build_bare(params)
+                if degrade is None or getattr(pred, "host_native", False):
+                    return pred, p
+                from .models import resolve_fallback
+                from .serving.degrade import DegradeLadder
+
+                return DegradeLadder(
+                    pred, resolve_fallback(name, params),
+                    deadline=args.device_deadline,
+                    probe_every=args.probe_every,
+                    probe_successes=args.probe_successes,
+                    metrics=m, recorder=recorder,
+                ), p
 
         drift = DriftController(
             gate,
@@ -1031,13 +1120,18 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             boot_params=model.params,
             metrics=m,
             recorder=recorder,
+            # fleet mode: adopt newer rotation members staged by peer
+            # serves sharing --drift-dir (each adoption still earns its
+            # own parity probes before installing here)
+            follow_rotation=args.drift_follow,
         )
-        predict = gate
-        if degrade is not None:
-            # promotions rebuild the ladder around the new kernel, so
-            # the render STALE column and /healthz must follow the
-            # gate's CURRENT ladder, not the boot object
-            degrade_surface = GateLadderView(gate, degrade)
+        if not sharded:
+            predict = gate
+            if degrade is not None:
+                # promotions rebuild the ladder around the new kernel,
+                # so the render STALE column and /healthz must follow
+                # the gate's CURRENT ladder, not the boot object
+                degrade_surface = GateLadderView(gate, degrade)
 
     # Open-set rejection tier (serving/openset.py): the OUTERMOST
     # predict wrapper — drift promotions hot-swap INSIDE it, so a
@@ -1046,8 +1140,10 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
     # serve an explicit 'unknown' label; the model's class list is
     # extended so every render path decodes the unknown index to
     # "unknown" (never "?" and never a fabricated known class).
-    # 'auto' skips sharded serves (their predict binds at
-    # construction — the same carve-out as --drift).
+    # 'auto' skips sharded serves: unlike --drift (whose sharded
+    # adapter swaps whole models through install_predict), per-row
+    # rejection would need the unknown index threaded through every
+    # per-shard read program — a deliberate remaining carve-out.
     openset = None
     if args.openset != "off" and not sharded:
         import dataclasses
@@ -1216,8 +1312,9 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
                         sharded, use_native, dropped_seen=0,
                         tracer=tracer, recorder=recorder, health=health,
                         probe_out=probe_out, degrade=degrade_surface,
-                        drift=drift, inc=inc, lat=lat, usr1=usr1,
-                        openset=openset, dev=dev, perf=perf)
+                        drift=drift, drift_feed=drift_feed, inc=inc,
+                        lat=lat, usr1=usr1, openset=openset, dev=dev,
+                        perf=perf)
     except BaseException as e:
         # the crash-forensics moment: record the terminal exception and
         # freeze the ring — safely outside any signal-handler frame.
@@ -1438,8 +1535,8 @@ def _snapshot_if_due(args, engine, m, ticks: int, loop_t0: float,
 def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 use_native, dropped_seen, tracer, recorder=None,
                 health=None, probe_out=None, degrade=None,
-                drift=None, inc=None, lat=None, usr1=None,
-                openset=None, dev=None, perf=None) -> None:
+                drift=None, drift_feed=None, inc=None, lat=None,
+                usr1=None, openset=None, dev=None, perf=None) -> None:
     from .ingest.fanin import RawTick
     from .utils.profiling import trace
 
@@ -1601,7 +1698,8 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 args, engine, model, predict,
                                 serve_params, m, tracer, pipe,
                                 feature_stage, sharded,
-                                degrade=degrade, drift=drift, inc=inc,
+                                degrade=degrade, drift=drift,
+                                drift_feed=drift_feed, inc=inc,
                                 lat=lat,
                             )
                         elif sharded:
@@ -1623,6 +1721,16 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                     engine, model, rows,
                                     engine.num_flows(),
                                 )
+                            if drift is not None:
+                                # off the hot path: the tick's frame
+                                # is already printed. The observation
+                                # is exact — serial loop, no ingest
+                                # between render and capture.
+                                if drift_feed is not None and rows:
+                                    _feed_sharded_capture(
+                                        engine, drift_feed, rows,
+                                    )
+                                drift.poll()
                         else:
                             if args.idle_timeout and engine.last_time:
                                 m.inc(
@@ -1792,9 +1900,21 @@ def _evict_dead_namespaces(tier, engine, m, pipe, recorder,
         )
 
 
+def _feed_sharded_capture(engine, gate, rows) -> None:
+    """Hand the sharded drift gate one render's (features, labels)
+    observation — the stand-in for ``DriftGate.__call__``'s
+    by-reference capture. The ranked rows' labels were produced by the
+    per-shard predict this render; ``feature_sample`` re-reads the same
+    slots through one gathered shard_map fetch."""
+    X = engine.feature_sample([s for s, *_ in rows])
+    gate.feed_capture(
+        X, np.asarray([c for _, c, *_ in rows], dtype=np.int64)
+    )
+
+
 def _dispatch_render(args, engine, model, predict, serve_params, m,
                      tracer, pipe, feature_stage, sharded,
-                     degrade=None, drift=None,
+                     degrade=None, drift=None, drift_feed=None,
                      inc=None, lat=None) -> None:
     """Host-stage half of one pipelined render tick: dispatch the read
     side against THIS tick's table and stage the device-stage job.
@@ -1823,10 +1943,18 @@ def _dispatch_render(args, engine, model, predict, serve_params, m,
             # tick's ingest, and a deferred lookup on the worker would
             # print the NEW flow's addresses under the OLD flow's label
             sample = engine.slot_metadata([s for s, *_ in rows])
+            if drift is not None and drift_feed is not None and rows:
+                # exact pairing: still the host stage, before ingest
+                # resumes — the sampled features are this render's
+                _feed_sharded_capture(engine, drift_feed, rows)
 
             def render_only(rows=rows, n_flows=n_flows, sample=sample):
                 with tracer.span("stage.device"), tracer.span("render"):
                     _print_ranked_resolved(model, rows, sample, n_flows)
+                if drift is not None:
+                    # the device-stage worker's idle time, same as the
+                    # single-device pipelined job
+                    drift.poll()
 
             pipe.submit(render_only)
             return
@@ -1840,6 +1968,16 @@ def _dispatch_render(args, engine, model, predict, serve_params, m,
                     rows = engine.tick_read_finish(outs)
                 with tracer.span("render"):
                     _print_ranked(engine, model, rows, n_flows)
+            if drift is not None:
+                if drift_feed is not None and rows:
+                    # worker-side capture: feature_sample re-reads the
+                    # LIVE table, which the overlapped host stage may
+                    # already be advancing — a slightly torn
+                    # observation is acceptable drift signal, and a
+                    # torn parity probe only defers promotion by one
+                    # window (probes demand fresh captures anyway)
+                    _feed_sharded_capture(engine, drift_feed, rows)
+                drift.poll()
 
         pipe.submit(sharded_job)
         return

@@ -81,6 +81,10 @@ class SourceSpec:
     n_flows: int = 0
     seed: int = 0
     mac_base: int = 0
+    # synthetic churn fraction: share of this source's flow population
+    # emitting telemetry each tick (replay.SyntheticFlows churn — the
+    # dirty-fraction knob behind incremental serving, per source)
+    churn: float = 1.0
     max_ticks: int = 0  # synthetic bound (0 = unbounded)
     max_restarts: int = 5
     interval: float = 1.0
@@ -618,7 +622,7 @@ class SourceWorker:
 
         syn = SyntheticFlows(
             n_flows=self.spec.n_flows, seed=self.spec.seed,
-            mac_base=self.spec.mac_base,
+            mac_base=self.spec.mac_base, churn=self.spec.churn,
         )
         i = 0
         while self.spec.max_ticks <= 0 or i < self.spec.max_ticks:

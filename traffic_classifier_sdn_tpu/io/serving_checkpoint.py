@@ -125,6 +125,32 @@ def _get_leaf(table: ft.FlowTable, name: str):
     return getattr(table, name)
 
 
+def _is_sharded(engine) -> bool:
+    # ShardedFlowEngine holds a stacked ``tables`` pytree; the
+    # single-device spine a flat ``table``
+    return getattr(engine, "tables", None) is not None
+
+
+def _fetch_leaf(engine, name: str) -> np.ndarray:
+    """One table leaf in the GLOBAL (capacity+1,) slot layout, whichever
+    spine wrote it: single-device leaves pass through; sharded leaves
+    (n_shards, local+1) interleave by the engine's routing invariant —
+    global slot g lives on shard g % n_shards at local row g // n_shards
+    — so the on-disk format is spine-agnostic and a checkpoint restores
+    across spine kinds. The global scratch row is written zeroed (each
+    shard's scratch is a local scatter target, never global state)."""
+    if not _is_sharded(engine):
+        return np.asarray(_get_leaf(engine.table, name))
+    stacked = np.asarray(_get_leaf(engine.tables, name))
+    n, local = stacked.shape[0], stacked.shape[1] - 1
+    cap = n * local
+    glob = np.zeros((cap + 1,) + stacked.shape[2:], stacked.dtype)
+    glob[:cap] = np.swapaxes(stacked[:, :local], 0, 1).reshape(
+        (cap,) + stacked.shape[2:]
+    )
+    return glob
+
+
 def _content_crc(data: dict) -> int:
     """CRC32 over every entry's name, dtype, shape, and raw bytes (sorted
     key order). Computed over the *uncompressed* content, so it survives
@@ -151,9 +177,12 @@ def save(engine, path: str, feature_reference: dict | None = None) -> int:
     ``feature_reference/`` key prefix and covered by the same content
     CRC; ``restore`` hands it back on the engine."""
     engine.step()  # flush: the device table is the only counter state
+    capacity = (
+        engine.capacity if _is_sharded(engine) else engine.table.capacity
+    )
     data: dict = {
         "format_version": FORMAT_VERSION,
-        "capacity": engine.table.capacity,
+        "capacity": capacity,
         "native": int(engine.native),
         "last_time": int(engine.last_time),
         "tick_floor": int(engine._tick_floor),
@@ -162,7 +191,7 @@ def save(engine, path: str, feature_reference: dict | None = None) -> int:
         for key, value in feature_reference.items():
             data[f"{_REF_PREFIX}{key}"] = np.asarray(value)
     for name in _TABLE_LEAVES:
-        data[f"table/{name}"] = np.asarray(_get_leaf(engine.table, name))
+        data[f"table/{name}"] = _fetch_leaf(engine, name)
 
     if engine.native:
         fp, used, next_slot, free = engine.batcher.export_index()
@@ -339,12 +368,11 @@ def resolve_latest(directory: str) -> str | None:
     return _resolve_and_load(directory)[0]
 
 
-def restore(path: str, buckets=None, recorder=None):
-    """Rebuild a ``FlowStateEngine`` from ``save`` output. ``path`` may
-    be a rotation directory, resolved through ``resolve_latest``.
-    ``recorder`` receives rollback/restore events (obs plane)."""
-    from ..ingest.batcher import DEFAULT_BUCKETS, FlowStateEngine
-
+def _load_for_restore(path: str, recorder=None):
+    """The shared restore prologue: fault site, directory resolution,
+    required-key check, native-availability gate. Returns
+    ``(resolved_path, content, native)`` — both spine restores build on
+    the same validated load."""
     fault_point("serving_ckpt.restore")
     if os.path.isdir(path):
         resolved, z = _resolve_and_load(path, recorder=recorder)
@@ -378,28 +406,13 @@ def restore(path: str, buckets=None, recorder=None):
                 "is unavailable here — its fingerprints are not "
                 "compatible with the Python index's keys"
             )
-    eng = FlowStateEngine(
-        int(z["capacity"]), buckets=buckets or DEFAULT_BUCKETS,
-        native=native,
-    )
+    return path, z, native
 
-    leaves = {
-        name: jnp.asarray(z[f"table/{name}"]) for name in _TABLE_LEAVES
-    }
 
-    def dirstate(side: str) -> ft.DirState:
-        return ft.DirState(**{
-            f: leaves[f"{side}.{f}"]
-            for f in ft.DirState.__dataclass_fields__
-        })
-
-    eng.table = ft.FlowTable(
-        time_start=leaves["time_start"],
-        in_use=leaves["in_use"],
-        fwd=dirstate("fwd"),
-        rev=dirstate("rev"),
-    )
-
+def _import_index(eng, z, native: bool) -> None:
+    """Rebuild the host flow index (either kind) and the engine clocks
+    from checkpoint content. Slot ids are GLOBAL on both spines — the
+    sharded engine keys its one index globally — so this is shared."""
     slots = z["index/slots"]
     keys = z["index/keys"]
     next_slot = int(z["index/next_slot"])
@@ -423,6 +436,9 @@ def restore(path: str, buckets=None, recorder=None):
         idx.next_slot = next_slot
     eng._last_time = last_time
     eng._tick_floor = int(z["tick_floor"])
+
+
+def _reference_block(z) -> dict | None:
     # v3 drift reference (absent in v2 checkpoints): handed back on the
     # engine so the CLI can re-seed the drift monitor — a restored serve
     # must not re-calibrate its reference on already-drifted traffic
@@ -431,5 +447,100 @@ def restore(path: str, buckets=None, recorder=None):
         for k, v in z.items()
         if k.startswith(_REF_PREFIX)
     }
-    eng.feature_reference = reference or None
+    return reference or None
+
+
+def restore(path: str, buckets=None, recorder=None):
+    """Rebuild a ``FlowStateEngine`` from ``save`` output. ``path`` may
+    be a rotation directory, resolved through ``resolve_latest``.
+    ``recorder`` receives rollback/restore events (obs plane)."""
+    from ..ingest.batcher import DEFAULT_BUCKETS, FlowStateEngine
+
+    path, z, native = _load_for_restore(path, recorder=recorder)
+    eng = FlowStateEngine(
+        int(z["capacity"]), buckets=buckets or DEFAULT_BUCKETS,
+        native=native,
+    )
+
+    leaves = {
+        name: jnp.asarray(z[f"table/{name}"]) for name in _TABLE_LEAVES
+    }
+
+    def dirstate(side: str) -> ft.DirState:
+        return ft.DirState(**{
+            f: leaves[f"{side}.{f}"]
+            for f in ft.DirState.__dataclass_fields__
+        })
+
+    eng.table = ft.FlowTable(
+        time_start=leaves["time_start"],
+        in_use=leaves["in_use"],
+        fwd=dirstate("fwd"),
+        rev=dirstate("rev"),
+    )
+
+    _import_index(eng, z, native)
+    eng.feature_reference = _reference_block(z)
+    return eng
+
+
+def restore_sharded(path: str, mesh, *, predict_fn=None, params=None,
+                    table_rows: int = 64, incremental: bool = False,
+                    buckets=None, recorder=None):
+    """Rebuild a ``ShardedFlowEngine`` from ``save`` output — the same
+    spine-agnostic on-disk format: each GLOBAL table leaf scatters back
+    to shard g % n_shards at local row g // n_shards (the engine's
+    routing invariant), so a checkpoint written by EITHER spine restores
+    onto the mesh and a sharded checkpoint restores onto the
+    single-device spine through plain ``restore``. The writer's
+    native/Python index kind still binds (fingerprints differ). When
+    ``incremental``, the cache/dirty pair boots all-dirty, so the first
+    render re-predicts every restored row — never a stale label."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ingest.batcher import DEFAULT_BUCKETS
+    from ..parallel import table_sharded as ts
+    from ..parallel.mesh import DATA_AXIS
+
+    path, z, native = _load_for_restore(path, recorder=recorder)
+    capacity = int(z["capacity"])
+    n = mesh.shape[DATA_AXIS]
+    if capacity % n:
+        raise ValueError(
+            f"checkpoint capacity {capacity} does not divide across "
+            f"{n} shards ({path})"
+        )
+    eng = ts.ShardedFlowEngine(
+        mesh, capacity, buckets=buckets or DEFAULT_BUCKETS,
+        predict_fn=predict_fn, params=params, table_rows=table_rows,
+        native=native, incremental=incremental,
+    )
+    local = capacity // n
+    stacked = {}
+    for name in _TABLE_LEAVES:
+        glob = np.asarray(z[f"table/{name}"])
+        arr = np.zeros((n, local + 1) + glob.shape[1:], glob.dtype)
+        arr[:, :local] = np.swapaxes(
+            glob[:capacity].reshape((local, n) + glob.shape[1:]), 0, 1
+        )
+        stacked[name] = arr
+
+    def dirstate(side: str) -> ft.DirState:
+        return ft.DirState(**{
+            f: stacked[f"{side}.{f}"]
+            for f in ft.DirState.__dataclass_fields__
+        })
+
+    eng.tables = jax.device_put(
+        ft.FlowTable(
+            time_start=stacked["time_start"],
+            in_use=stacked["in_use"],
+            fwd=dirstate("fwd"),
+            rev=dirstate("rev"),
+        ),
+        NamedSharding(mesh, P(DATA_AXIS)),
+    )
+    _import_index(eng, z, native)
+    eng.feature_reference = _reference_block(z)
     return eng

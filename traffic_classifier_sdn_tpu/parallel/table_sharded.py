@@ -212,6 +212,31 @@ def make_tick_outputs_inc(mesh, predict_fn, n_rows: int):
     return tick
 
 
+def make_feature_sample(mesh):
+    """jit'd (tables, slots) → (n_shards, k, 12) float32 feature rows,
+    replicated: per-shard ``features12_at`` over (n_shards, k) LOCAL
+    slot ids padded with local_capacity (scratch — never in use, so
+    padding rows project zeros), all_gathered so the host can reassemble
+    the sample anywhere. O(k) across the wire; the drift monitor's
+    observation tap on the composed spine."""
+
+    @jax.jit
+    def sample(tables, slots):
+        def local(t, s):
+            t1 = jax.tree.map(lambda a: a[0], t)
+            X = ft.features12_at(t1, s[0])
+            return jax.lax.all_gather(X, DATA_AXIS)
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(),
+            check_vma=False,
+        )(tables, slots)
+
+    return sample
+
+
 def make_clear(mesh):
     """jit'd (tables, slots) → tables: per-shard ``clear_slots``; ``slots``
     is (n_shards, E) LOCAL slot ids padded with local_capacity."""
@@ -284,6 +309,8 @@ class ShardedFlowEngine(HostSpine):
         # shard also cannot CONTRIBUTE more than it holds, so clamping the
         # per-shard k keeps the global top-table_rows merge exact
         self.table_rows = table_rows
+        self._predict_fn = predict_fn
+        self._feature_sample = None
         self._tick_outputs = (
             make_tick_outputs(
                 mesh, predict_fn, min(table_rows, self.local_capacity)
@@ -403,13 +430,37 @@ class ShardedFlowEngine(HostSpine):
         within a drain; the native engine's size-rollover generations
         (the common case at scale) coalesce freely. Order-preserving
         routing and sequential chunk cuts keep any split create/update
-        pair in create-then-update order."""
+        pair in create-then-update order.
+
+        Native drain: ``tck_flush_wire`` stages packed wire in TWO pinned
+        buffers (flush k reuses flush k-2's), so before each next flush
+        every held view but the newest is materialized into host memory
+        the C++ side can never overwrite. Unlike the single-device spine
+        no ``block_until_ready`` is needed here: ``_route_chunks`` copies
+        every row host-side (the stable-sort fancy index plus the padded
+        per-shard chunks) before any dispatch consumes it, so a staged
+        view is never handed to an async device op."""
         groups: list[list[np.ndarray]] = []
-        while (batch := self.batcher.flush()) is not None:
-            conflict = self.batcher.last_flush_was_conflict()
-            if not groups or (conflict and groups[-1]):
-                groups.append([])
-            groups[-1].append(ft.pack_wire(batch))
+        if self.native:
+            pending: list[tuple[int, int]] = []  # uncopied staged views
+            while len(self.batcher):
+                while len(pending) > 1:
+                    g, i = pending.pop(0)
+                    groups[g][i] = np.array(groups[g][i])
+                w = self.batcher.flush_wire()
+                if w is None:
+                    break
+                conflict = self.batcher.last_flush_was_conflict()
+                if not groups or (conflict and groups[-1]):
+                    groups.append([])
+                groups[-1].append(w)
+                pending.append((len(groups) - 1, len(groups[-1]) - 1))
+        else:
+            while (batch := self.batcher.flush()) is not None:
+                conflict = self.batcher.last_flush_was_conflict()
+                if not groups or (conflict and groups[-1]):
+                    groups.append([])
+                groups[-1].append(ft.pack_wire(batch))
         if not groups:
             return False
         for packed in groups:
@@ -547,9 +598,16 @@ class ShardedFlowEngine(HostSpine):
             (self.batcher if self.native else self.index).release_slots(
                 slots * self.n_shards + s
             )
-        # clear in largest-bucket chunks: an idle storm can mark more
-        # slots than the biggest padded shape admits (same chunking as
-        # FlowStateEngine.evict_idle)
+        self._clear_sharded(clear_batches)
+        return rows, evicted
+
+    def _clear_sharded(self, clear_batches) -> None:
+        """Clear per-shard LOCAL slot batches in largest-bucket chunks:
+        an idle storm — or a dead source's whole namespace — can mark
+        more slots than the biggest padded shape admits (same chunking
+        as FlowStateEngine.evict_idle). When incremental, the fused
+        clear also invalidates the per-shard label cache rows."""
+        local_cap = self.local_capacity
         E_max = max((b.size for b in clear_batches), default=0)
         step = self.buckets[-1]
         for off in range(0, E_max, step):
@@ -562,13 +620,113 @@ class ShardedFlowEngine(HostSpine):
             for s, c in enumerate(chunks):
                 padded[s, : c.size] = c
             if self.incremental:
-                # eviction invalidates the per-shard label cache rows
                 self.tables, self.dirty = self._clear_dirty(
                     self.tables, self.dirty, padded
                 )
             else:
                 self.tables = self._clear(self.tables, padded)
-        return rows, evicted
+
+    def evict_source(self, source: int) -> int:
+        """Release every flow owned by ``source`` across ALL shards —
+        the per-source blast radius (quarantine evict, flap escalation)
+        preserved over shard boundaries; the composed-spine twin of
+        ``FlowStateEngine.evict_source``. Flushes pending updates first
+        so no in-flight record re-creates a slot being evicted, drops
+        the source's reassembly tail, releases the GLOBAL slots in one
+        bulk index call, then clears the state rows per shard through
+        the bucket-padded chunk shapes tick_render already compiles."""
+        self.step()
+        self._tails.pop(source, None)
+        if self.native:
+            self.batcher.reset_tail(source)
+            slots = self.batcher.slots_for_source(source).astype(np.int64)
+        else:
+            slots = np.asarray(
+                sorted(self.index.slots_for_source(source)), np.int64
+            )
+        if slots.size:
+            (self.batcher if self.native else self.index).release_slots(
+                slots
+            )
+            shard = (slots % self.n_shards).astype(np.int64)
+            local = (slots // self.n_shards).astype(np.int64)
+            self._clear_sharded(
+                [local[shard == s] for s in range(self.n_shards)]
+            )
+        return int(slots.size)
+
+    def install_predict(self, predict_fn, params):
+        """Hot-swap the serving model (drift promotion/rollback on the
+        composed spine): rebuild the read-side programs around the new
+        fn and reset the incremental cache/dirty pair all-dirty, so no
+        label cached under the OLD model ever renders as fresh under
+        the new one — the sharded twin of the label-epoch invalidation
+        the single-device gate drives. Returns the previous
+        ``(predict_fn, params)`` pair so the caller can retire it."""
+        prev = (self._predict_fn, self.params)
+        self._predict_fn = predict_fn
+        self.params = params
+        n_rows = min(self.table_rows, self.local_capacity)
+        self._tick_outputs = make_tick_outputs(
+            self.mesh, predict_fn, n_rows
+        )
+        if self.incremental:
+            sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+            self._tick_outputs_inc = make_tick_outputs_inc(
+                self.mesh, predict_fn, n_rows
+            )
+            label_dtype = jax.eval_shape(
+                predict_fn, params,
+                jax.ShapeDtypeStruct((1, 12), jnp.float32),
+            ).dtype
+            self.dirty = jax.device_put(
+                np.ones((self.n_shards, self.local_capacity + 1), bool),
+                sharding,
+            )
+            self.caches = jax.device_put(
+                np.zeros(
+                    (self.n_shards, self.local_capacity), label_dtype
+                ),
+                sharding,
+            )
+        return prev
+
+    def feature_sample(self, gslots) -> np.ndarray:
+        """(len(gslots), 12) float32 feature rows for the given GLOBAL
+        slots, in input order — the drift monitor's per-render
+        observation tap. One fixed-shape shard_map gather (k = the
+        render-row clamp, so exactly one compile); slots route to their
+        owning shard, padding entries read each shard's scratch row and
+        are dropped on reassembly. Rows evicted between render and
+        sample read as zeros, which the monitor's any-feature mask
+        already discards."""
+        g = np.asarray(gslots, np.int64)
+        k = min(self.table_rows, self.local_capacity)
+        if g.size == 0:
+            return np.zeros((0, 12), np.float32)
+        if self._feature_sample is None:
+            self._feature_sample = make_feature_sample(self.mesh)
+        shard = (g % self.n_shards).astype(np.int64)
+        local = (g // self.n_shards).astype(np.int64)
+        padded = np.full((self.n_shards, k), self.local_capacity, np.int32)
+        pos = np.full((self.n_shards, k), -1, np.int64)
+        counts = np.zeros(self.n_shards, np.int64)
+        for i in range(g.size):
+            s = shard[i]
+            if counts[s] >= k:
+                raise ValueError(
+                    f"feature_sample: >{k} slots routed to shard {s}"
+                )
+            padded[s, counts[s]] = local[i]
+            pos[s, counts[s]] = i
+            counts[s] += 1
+        X = np.asarray(self._feature_sample(self.tables, padded))
+        out = np.zeros((g.size, 12), np.float32)
+        for s in range(self.n_shards):
+            m = int(counts[s])
+            if m:
+                out[pos[s, :m]] = X[s, :m]
+        return out
 
     def warmup_incremental(self) -> list[str]:
         """AOT-compile the incremental read program for EVERY dirty
@@ -616,6 +774,46 @@ class ShardedFlowEngine(HostSpine):
             )
             warmed.append(f"sharded.dirty[{b}]")
         jax.block_until_ready(scratch_c)
+        return warmed
+
+    def warmup_scatter(self) -> list[str]:
+        """AOT-compile the write-side scatter for EVERY wire bucket a
+        tick can plausibly fill (≤ two records per tracked flow per
+        shard). The apply program's shape is (n_shards, B, 4) and B
+        follows the widest per-shard sub-batch of each routed chunk,
+        so a serve whose batch sizes vary tick to tick pays a compile
+        at the first hit of every new bucket — inside a live tick's
+        latency budget — unless they are all primed here. All-padding
+        chunks (slot == local_capacity) are a clean no-op; scratch
+        state absorbs the donation, never the live table. The rare
+        full-width (B, 6) wire still compiles lazily, matching the
+        single-device warm."""
+        warmed = []
+        limit = bucket_size(
+            min(2 * self.local_capacity, self.buckets[-1]), self.buckets
+        )
+        scratch_t = make_sharded_table(self.mesh, self.capacity)
+        scratch_d = None
+        if self.incremental:
+            scratch_d = jax.device_put(
+                np.ones((self.n_shards, self.local_capacity + 1), bool),
+                NamedSharding(self.mesh, P(DATA_AXIS)),
+            )
+        for b in self.buckets:
+            if b > limit:
+                break
+            chunk = np.empty((self.n_shards, b, 4), np.uint32)
+            chunk[:, :, 0] = np.uint32(self.local_capacity)
+            chunk[:, :, 1:] = 0
+            if self.incremental:
+                scratch_t, scratch_d = self._apply_dirty(
+                    scratch_t, scratch_d, chunk
+                )
+                warmed.append(f"sharded.apply_dirty[{b}]")
+            else:
+                scratch_t = self._apply(scratch_t, chunk)
+                warmed.append(f"sharded.apply[{b}]")
+        jax.block_until_ready(scratch_t)
         return warmed
 
     def slot_metadata(self, slots):

@@ -596,6 +596,55 @@ class GateLadderView:
                     pass
 
 
+class ShardedDriftGate:
+    """DriftGate-shaped adapter for the SHARDED spine. The single-device
+    gate wraps the predict callable the serve loop invokes; the sharded
+    engine instead compiles its predict INTO the per-shard read programs
+    (parallel/table_sharded.make_tick_outputs*), so there is no call
+    site to wrap. This adapter hands the DriftController the same
+    surface — ``take_capture``/``install``/``swapped``/``inner`` — with
+    ``install`` routed through ``ShardedFlowEngine.install_predict``
+    (rebuilds the read programs and resets the per-shard label caches
+    all-dirty: the sharded label-epoch invalidation) and captures FED by
+    the serve loop, which samples the rendered rows' features
+    (``engine.feature_sample``) and labels after each render and hands
+    the pair to ``feed_capture``."""
+
+    host_native = False
+
+    def __init__(self, engine):
+        self._engine = engine
+        self._lock = threading.Lock()
+        self._capture = None
+        self._swapped = False
+
+    def feed_capture(self, X, labels) -> None:
+        """Per-render observation hand-off — the sharded stand-in for
+        ``DriftGate.__call__``'s by-reference capture."""
+        with self._lock:
+            self._capture = (X, labels)
+
+    def take_capture(self):
+        with self._lock:
+            cap, self._capture = self._capture, None
+            return cap
+
+    def install(self, fn, params):
+        prev_fn, _prev_params = self._engine.install_predict(fn, params)
+        with self._lock:
+            self._swapped = True
+        return prev_fn
+
+    @property
+    def inner(self):
+        return self._engine._predict_fn
+
+    @property
+    def swapped(self) -> bool:
+        with self._lock:
+            return self._swapped
+
+
 def default_build_serving(family: str, classes):
     """``params -> (jitted predict_fn, serve_params)`` through the same
     resolution the CLI boot path uses (models.serving_path +
@@ -640,7 +689,8 @@ class DriftController:
                  reference: dict | None = None, build_serving=None,
                  fit_kwargs: dict | None = None, metrics=None,
                  recorder=None, health=None, clock=time.monotonic,
-                 boot_params=None, feature_names=None):
+                 boot_params=None, feature_names=None,
+                 follow_rotation: bool = False):
         self._gate = gate
         self._family = family
         self._classes = tuple(classes)
@@ -700,6 +750,17 @@ class DriftController:
         self._lock = threading.Lock()
         self._state = STEADY
         self._candidate = None  # (fn, params, path, seq)
+        # fleet follower mode: scan the shared rotation for members a
+        # PEER serve staged and adopt them as candidates — promotion
+        # then rides the same parity-gated probe ladder, so fleet-wide
+        # propagation never bypasses the wrong-but-fresh gate
+        self.follow_rotation = bool(follow_rotation)
+        self._candidate_adopted = False
+        # highest ADOPTED seq already judged (either way): a rejected
+        # adoption must not be re-adopted every poll — but the member
+        # stays in the rotation (it is the PEER's, maybe its promoted
+        # model; a follower never discards shared members)
+        self._follow_seen = 0
         # the latest FULL-shape capture (X f32, y, active mask) — probes
         # run the exact serving shape so the candidate compiles the one
         # program it will serve with, never a fresh shadow shape (the
@@ -725,9 +786,13 @@ class DriftController:
         # Seed the rotation with the BOOT model (staged-commit save) so
         # "roll back via resolve_latest" is well-defined before any
         # promotion has ever happened. Idempotent across restarts: an
-        # existing loadable member is kept.
+        # existing loadable member is kept. A follow_rotation member
+        # NEVER seeds: the shared rotation belongs to the fleet and two
+        # members racing to write seq 0 would collide on one member
+        # path — the leader owns the boot seed, followers adopt.
         latest = retrain.resolve_latest(directory)
-        if boot_params is not None and latest is None:
+        if (boot_params is not None and latest is None
+                and not self.follow_rotation):
             latest = retrain.save_candidate(
                 directory, 0, family, boot_params, self._classes
             )
@@ -826,6 +891,12 @@ class DriftController:
         report = self._observe(cap) if cap is not None else None
         if self.state == RETRAINING:
             self._check_retrain()
+        if self.follow_rotation and self.state in (STEADY, DRIFTING):
+            # fleet follower: a peer's freshly staged rotation member
+            # becomes a candidate HERE too — probed below like any
+            # locally retrained one (the scan is one listdir; a member
+            # already judged or predating the promoted seq is skipped)
+            self._check_rotation()
         if report is None:
             return
         state = self.state
@@ -1055,10 +1126,50 @@ class DriftController:
             return
         with self._lock:
             self._candidate = (fn, p, path, seq)
+            self._candidate_adopted = False
             self._probe_ok = 0
             self._probe_failures = 0
         self._transition(
             CANDIDATE, f"staged:{os.path.basename(path)}"
+        )
+
+    def _check_rotation(self) -> None:
+        """Adopt a NEWER rotation member staged by a peer serve sharing
+        this checkpoint directory (fleet mode): load it, build the
+        serving pair, and stage it as this serve's candidate — the
+        parity probes then judge it against THIS serve's own live
+        labels before it can install. NEVER raises (poll's contract):
+        a peer's torn write or a garbage member is counted and skipped,
+        and its seq is remembered so it is not re-tried every tick."""
+        try:
+            members = retrain.list_candidates(self._directory)
+        except Exception:  # noqa: BLE001 — a scan failure must not kill the serve
+            return
+        if not members:
+            return
+        seq, path = members[0]
+        with self._lock:
+            if seq <= max(self._promoted_seq, self._follow_seen):
+                return
+            self._follow_seen = seq
+        try:
+            loaded = retrain.load_candidate(path)
+            fn, p = self._build(loaded.params)
+        except Exception as e:  # noqa: BLE001 — a peer's torn member must not kill this serve
+            self._count("retrain_failures", metric="retrain_failures")
+            if self._recorder is not None:
+                self._recorder.record(
+                    "drift.follow_error", member=path,
+                    error=type(e).__name__, detail=str(e),
+                )
+            return
+        with self._lock:
+            self._candidate = (fn, p, path, seq)
+            self._candidate_adopted = True
+            self._probe_ok = 0
+            self._probe_failures = 0
+        self._transition(
+            CANDIDATE, f"adopted:{os.path.basename(path)}"
         )
 
     # -- probing / promotion -----------------------------------------------
@@ -1122,14 +1233,20 @@ class DriftController:
             rejected = (
                 self._probe_failures >= self.candidate_max_failures
             )
+            adopted = self._candidate_adopted
             if rejected:
                 self._candidate = None
+                self._candidate_adopted = False
         if rejected:
             # wrong-but-fresh: the candidate disagrees with the live
             # model on the very window it was trained against — it
             # never promotes, and the rotation forgets it; its predict
-            # (a rebuilt ladder's watchdog included) is retired too
-            retrain.discard_candidate(path)
+            # (a rebuilt ladder's watchdog included) is retired too.
+            # An ADOPTED member stays: it belongs to the peer that
+            # staged it (possibly that peer's promoted model) — the
+            # remembered _follow_seen keeps it from being re-adopted
+            if not adopted:
+                retrain.discard_candidate(path)
             self._retire(fn)
             self._transition(STEADY, f"candidate-rejected:{detail}")
 
@@ -1170,6 +1287,7 @@ class DriftController:
             return
         with self._lock:
             self._candidate = None
+            self._candidate_adopted = False
             self._probe_ok = 0
             self._promoted_seq = seq
             self._last_shadow = None  # O(capacity) host memory: only

@@ -81,9 +81,10 @@ def warmup_serving(engine, predict, params, *, table_rows: int,
 
     Single-device engines get the full treatment. The mesh-sharded
     engine's read side is warmed through one inert
-    ``tick_read_dispatch`` (its apply path compiles per bucket on
-    first flush — those programs are per-shard-shaped and cheap next
-    to the read side's full-shard predict)."""
+    ``tick_read_dispatch`` and its write side through
+    ``warmup_scatter`` (one apply program per wire bucket — a serve
+    whose batch sizes vary tick to tick would otherwise pay a compile
+    at the first hit of each new bucket shape)."""
     t0 = time.perf_counter()
     warmed: list[str] = []
     host_native = getattr(predict, "host_native", False)
@@ -92,6 +93,12 @@ def warmup_serving(engine, predict, params, *, table_rows: int,
         outs = engine.tick_read_dispatch(now=0)
         jax.block_until_ready(outs)
         warmed.append("sharded.tick_read")
+        warmed.extend(engine.warmup_scatter())
+        if getattr(engine, "native", False) and hasattr(
+            engine.batcher, "warm_stage"
+        ):
+            engine.batcher.warm_stage()
+            warmed.append("wire_stage")
         if incremental and getattr(engine, "incremental", False):
             # every dirty-bucket variant of the incremental read side
             # (one tick_read_dispatch only hit one bucket)
