@@ -1,0 +1,799 @@
+"""The actuation tier (controller/policy.py + serving/actuation.py) —
+the F14 guarantees, each pinned here:
+
+- the declarative ``--policy`` spec parses exactly (unknown classes,
+  malformed actions, duplicate clauses, and any clause for the open-set
+  ``unknown`` label are refused at parse time);
+- every action kind compiles to a byte-golden OF1.3 flow-mod — pinned
+  literally and via ``parse_flow_mod``/``decode_instructions``
+  round-trips — and retraction is cookie-masked while the reconcile
+  wipe is not;
+- the hysteresis FSM on a virtual clock: a rule installs after exactly
+  ``k_install`` consecutive ticks of a stable label, retracts after
+  exactly ``k_retract`` deviating ticks, and an ``unknown`` blip or a
+  single-tick flip never touches the switch (``flaps_suppressed``);
+- a drift rollback latches the plane demoted (hold-and-retract) until
+  the drift loop PROMOTES again; a stale render demotes the same way
+  but un-latches as soon as freshness returns;
+- the rule ledger (intended == installed + refused + retracted) is
+  exact at every boundary and spans restarts via ``ledger=``;
+- quarantine blast radius retracts exactly the dead namespace's rules,
+  pinned over BOTH ingest spines (python index walk and native tag
+  scan) through ``engine.slots_for_source``;
+- the end-to-end replay acceptance (ISSUE 20) against the accounting
+  FakeSwitch: classify → hysteresis install → quarantine retract →
+  drift-rollback demote → re-promotion re-installs — and an armed
+  ``actuation.send`` stall never breaks the observe cadence, with the
+  ledger exact and zero rule flaps recorded;
+- ``--actuation off`` (the default) is byte-transparent: dry-run
+  stdout is byte-identical to off across serial/pipelined ×
+  incremental auto/off, with the intended-mods table on stderr only.
+"""
+
+import contextlib
+import io
+import time
+
+import numpy as np
+import pytest
+
+from traffic_classifier_sdn_tpu import cli
+from traffic_classifier_sdn_tpu.controller import openflow as of
+from traffic_classifier_sdn_tpu.controller.policy import (
+    POLICY_PRIORITY,
+    PolicyAction,
+    compile_install,
+    compile_retract,
+    compile_wipe,
+    parse_policy,
+)
+from traffic_classifier_sdn_tpu.ingest.batcher import FlowStateEngine
+from traffic_classifier_sdn_tpu.ingest.protocol import TelemetryRecord
+from traffic_classifier_sdn_tpu.models import gnb
+from traffic_classifier_sdn_tpu.obs import HealthState
+from traffic_classifier_sdn_tpu.scenarios.runner import (
+    _accounting_switch_cls,
+)
+from traffic_classifier_sdn_tpu.serving.actuation import (
+    ActuationPlane,
+    SwitchLink,
+)
+from traffic_classifier_sdn_tpu.utils import faults
+from traffic_classifier_sdn_tpu.utils.metrics import Metrics
+
+CLASSES = ("video", "attack", "bulk", "web")
+SPEC = "video=queue:1,attack=drop,bulk=meter:2"
+
+SRC = "aa:bb:cc:00:00:01"
+DST = "aa:bb:cc:00:00:02"
+
+
+def _plane(vclock, mode="dry-run", switch=None, k_install=3, k_retract=3,
+           **kw):
+    link_factory = None
+    if switch is not None:
+        link_factory = lambda: SwitchLink(switch.host, switch.port)  # noqa: E731
+    return ActuationPlane(
+        parse_policy(SPEC, CLASSES), mode=mode,
+        k_install=k_install, k_retract=k_retract,
+        clock=lambda: vclock["t"], link_factory=link_factory,
+        out=io.StringIO(), **kw,
+    )
+
+
+def _rows(label, n=3):
+    return [
+        (i, f"aa:00:00:00:00:{2 * i + 1:02x}", f"aa:00:00:00:00:{2 * i + 2:02x}",
+         label)
+        for i in range(n)
+    ]
+
+
+def _settle(sw, accessor, n, timeout=5.0):
+    """The switch logs flow-mods on its service thread: wait (bounded)
+    for ``n`` entries before asserting on them."""
+    deadline = time.monotonic() + timeout
+    while len(getattr(sw, accessor)()) < n:
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.01)
+    return getattr(sw, accessor)()
+
+
+# ---------------------------------------------------------------------------
+# policy spec parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_policy_full_spec():
+    policy = parse_policy(
+        "video=queue:1,attack=drop,bulk=meter:2,web=mirror:7", CLASSES,
+    )
+    assert policy == {
+        "video": PolicyAction("queue", 1),
+        "attack": PolicyAction("drop"),
+        "bulk": PolicyAction("meter", 2),
+        "web": PolicyAction("mirror", 7),
+    }
+    assert policy["video"].describe() == "queue queue=1"
+    assert policy["attack"].describe() == "drop"
+
+
+@pytest.mark.parametrize("spec, fragment", [
+    ("nosuch=drop", "not in model classes"),
+    ("video=frobnicate:1", "unknown policy action"),
+    ("video=queue", "integer argument"),
+    ("video=queue:x", "integer argument"),
+    ("video=queue:-1", "must be >= 0"),
+    ("video=drop:1", "takes no argument"),
+    ("video=queue:1,video=drop", "duplicate policy clause"),
+    ("video", "want CLASS=ACTION"),
+    ("", "empty --policy spec"),
+    ("unknown=drop", "never touch the switch"),
+])
+def test_parse_policy_refuses(spec, fragment):
+    with pytest.raises(ValueError, match=fragment):
+        parse_policy(spec, CLASSES)
+
+
+# ---------------------------------------------------------------------------
+# byte-golden flow-mod encodings
+# ---------------------------------------------------------------------------
+
+# compile_install(7, SRC, DST, queue:1, cookie=9) pinned byte-for-byte:
+# OF1.3 header (v4, FLOW_MOD, len 104, xid 7), cookie 9 unmasked, ADD,
+# priority 10, OXM eth_dst+eth_src match, set_queue(1)+output(NORMAL).
+_GOLDEN_QUEUE_INSTALL = bytes.fromhex(
+    "040e00680000000700000000000000090000000000000000000000000000000a"
+    "ffffffffffffffffffffffff000000000001001880000606aabbcc0000028000"
+    "0806aabbcc0000010004002000000000001500080000000100000010fffffffa"
+    "ffff000000000000"
+)
+
+
+def test_install_golden_bytes():
+    raw = compile_install(7, SRC, DST, PolicyAction("queue", 1), cookie=9)
+    assert raw == _GOLDEN_QUEUE_INSTALL
+
+
+@pytest.mark.parametrize("action, instructions", [
+    (PolicyAction("queue", 1), [
+        {"type": "apply_actions", "actions": [
+            {"type": "set_queue", "queue_id": 1},
+            {"type": "output", "port": of.OFPP_NORMAL},
+        ]},
+    ]),
+    (PolicyAction("meter", 5), [
+        {"type": "meter", "meter_id": 5},
+        {"type": "apply_actions", "actions": [
+            {"type": "output", "port": of.OFPP_NORMAL},
+        ]},
+    ]),
+    (PolicyAction("drop"), []),
+    (PolicyAction("mirror", 7), [
+        {"type": "apply_actions", "actions": [
+            {"type": "output", "port": 7},
+            {"type": "output", "port": of.OFPP_NORMAL},
+        ]},
+    ]),
+])
+def test_install_round_trip(action, instructions):
+    raw = compile_install(3, SRC, DST, action, cookie=42)
+    version, mtype, length, xid = of.OFP_HEADER.unpack_from(raw)
+    assert (version, mtype, length, xid) == (4, of.OFPT_FLOW_MOD, len(raw), 3)
+    mod = of.parse_flow_mod(raw[of.OFP_HEADER.size:])
+    assert mod["command"] == of.OFPFC_ADD
+    assert mod["priority"] == POLICY_PRIORITY
+    assert mod["cookie"] == 42 and mod["cookie_mask"] == 0
+    assert mod["match"] == {"eth_src": SRC, "eth_dst": DST}
+    assert of.decode_instructions(mod["instructions"]) == instructions
+
+
+def test_retract_is_cookie_masked_delete():
+    mod = of.parse_flow_mod(
+        compile_retract(4, SRC, DST, 42)[of.OFP_HEADER.size:]
+    )
+    assert mod["command"] == of.OFPFC_DELETE
+    assert mod["cookie"] == 42
+    assert mod["cookie_mask"] == 0xFFFFFFFFFFFFFFFF
+    assert mod["match"] == {"eth_src": SRC, "eth_dst": DST}
+    assert mod["instructions"] == b""
+
+
+def test_wipe_is_unmasked_delete():
+    mod = of.parse_flow_mod(compile_wipe(5, SRC, DST)[of.OFP_HEADER.size:])
+    assert mod["command"] == of.OFPFC_DELETE
+    assert mod["cookie_mask"] == 0  # any cookie: clears orphans too
+    assert mod["match"] == {"eth_src": SRC, "eth_dst": DST}
+
+
+# ---------------------------------------------------------------------------
+# hysteresis FSM on a virtual clock
+# ---------------------------------------------------------------------------
+
+
+def test_install_after_exactly_k_ticks():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=3)
+    for tick in range(2):
+        plane.observe(_rows("video"))
+        vclock["t"] += 1.0
+        assert plane.status()["installed_rules"] == 0, f"tick {tick}"
+    plane.observe(_rows("video"))
+    st = plane.status()
+    assert st["installed_rules"] == 3
+    assert st["ledger"] == {
+        "intended": 3, "installed": 3, "refused": 0, "retracted": 0,
+        "exact": True,
+    }
+
+
+def test_unknown_blip_resets_streak_and_never_installs():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=3)
+    plane.observe(_rows("video", 1))
+    plane.observe(_rows("video", 1))
+    plane.observe(_rows("unknown", 1))   # blip at streak 2
+    st = plane.status()
+    assert st["installed_rules"] == 0
+    assert st["flaps_suppressed"] == 1
+    # the streak restarts from scratch: two more stable ticks still
+    # earn nothing, the third installs
+    plane.observe(_rows("video", 1))
+    plane.observe(_rows("video", 1))
+    assert plane.status()["installed_rules"] == 0
+    plane.observe(_rows("video", 1))
+    assert plane.status()["installed_rules"] == 1
+
+
+def test_single_flip_never_installs():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=2)
+    for label in ("video", "attack", "video", "attack"):
+        plane.observe(_rows(label, 1))
+    st = plane.status()
+    assert st["installed_rules"] == 0
+    assert st["ledger"]["intended"] == 0  # never even armed
+    assert st["flaps_suppressed"] == 3
+
+
+def test_observe_only_class_never_tracks():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=1)
+    plane.observe(_rows("web", 2))       # classified, no policy clause
+    st = plane.status()
+    assert st["rules"] == {} and st["ledger"]["intended"] == 0
+
+
+def test_installed_rule_survives_short_deviation():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=2, k_retract=3)
+    plane.observe(_rows("video", 1))
+    plane.observe(_rows("video", 1))
+    assert plane.status()["installed_rules"] == 1
+    plane.observe(_rows("attack", 1))    # deviation 1 of 3
+    plane.observe(_rows("attack", 1))    # deviation 2 of 3
+    plane.observe(_rows("video", 1))     # episode ends early
+    st = plane.status()
+    assert st["installed_rules"] == 1
+    assert st["ledger"]["retracted"] == 0
+    assert st["flaps_suppressed"] == 1   # one suppressed episode
+
+
+def test_retract_after_exactly_k_deviations_then_flap_counted():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=2, k_retract=2)
+    plane.observe(_rows("video", 1))
+    plane.observe(_rows("video", 1))
+    plane.observe(_rows("attack", 1))
+    assert plane.status()["ledger"]["retracted"] == 0
+    plane.observe(_rows("attack", 1))    # k_retract reached
+    st = plane.status()
+    assert st["installed_rules"] == 0
+    assert st["ledger"]["retracted"] == 1
+    assert st["rule_flaps"] == 0
+    # the replacement label earns its own install — and because this
+    # pair was label-retracted, the re-install IS a rule flap
+    plane.observe(_rows("attack", 1))
+    st = plane.status()
+    assert st["installed_rules"] == 1
+    assert st["rule_flaps"] == 1
+    assert st["ledger"]["exact"]
+
+
+def test_slot_reuse_retracts_old_pair():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=1)
+    plane.observe([(0, SRC, DST, "video")])
+    plane.observe([(0, SRC, DST, "video")])
+    assert plane.status()["installed_rules"] == 1
+    # same slot, different flow pair: the old match no longer
+    # describes the slot — retract immediately, new pair starts over
+    new = (0, "aa:00:00:00:00:09", "aa:00:00:00:00:0a", "video")
+    plane.observe([new])
+    st = plane.status()
+    assert st["ledger"]["retracted"] == 1
+    assert st["installed_rules"] == 0    # new pair earns its own streak
+    plane.observe([new])
+    st = plane.status()
+    assert st["installed_rules"] == 1
+    assert st["rule_flaps"] == 0         # not a label flap
+
+
+# ---------------------------------------------------------------------------
+# demotion: drift rollback latches, stale render un-latches on freshness
+# ---------------------------------------------------------------------------
+
+
+def test_drift_rollback_demotes_until_promoted():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=2)
+    plane.observe(_rows("video"), drift_state="STEADY")
+    plane.observe(_rows("video"), drift_state="STEADY")
+    assert plane.status()["installed_rules"] == 3
+    plane.observe(_rows("video"), drift_state="ROLLED_BACK")
+    st = plane.status()
+    assert st["state"] == "demoted"
+    assert st["demote_reason"] == "drift_rollback"
+    assert st["installed_rules"] == 0
+    assert st["ledger"]["retracted"] == 3
+    # streaks keep building but may not install while latched — and a
+    # fresh render alone does NOT un-latch a rollback
+    plane.observe(_rows("video"), drift_state="ROLLED_BACK")
+    plane.observe(_rows("video"))
+    plane.observe(_rows("video"))
+    assert plane.status()["installed_rules"] == 0
+    # only PROMOTED un-latches; the next earned streak re-installs
+    plane.observe(_rows("video"), drift_state="PROMOTED")
+    plane.observe(_rows("video"))
+    st = plane.status()
+    assert st["state"] == "dry-run"
+    assert st["installed_rules"] == 3
+    assert st["ledger"]["exact"]
+
+
+def test_stale_render_demotes_and_freshness_unlatches():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=2)
+    plane.observe(_rows("video", 2))
+    plane.observe(_rows("video", 2))
+    assert plane.status()["installed_rules"] == 2
+    plane.observe(_rows("video", 2), stale=True)
+    st = plane.status()
+    assert st["state"] == "demoted"
+    assert st["demote_reason"] == "stale_render"
+    assert st["installed_rules"] == 0
+    # freshness returned (ladder probed back): un-latch on its own
+    plane.observe(_rows("video", 2))
+    plane.observe(_rows("video", 2))
+    plane.observe(_rows("video", 2))
+    st = plane.status()
+    assert st["state"] == "dry-run"
+    assert st["installed_rules"] == 2
+    assert st["ledger"]["exact"]
+
+
+# ---------------------------------------------------------------------------
+# ledger spans restarts; obs surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_spans_restarts():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=1)
+    plane.observe(_rows("video", 2))
+    plane.observe(_rows("video", 2))
+    carried = plane.status()["ledger"]
+    carried["flaps_suppressed"] = plane.status()["flaps_suppressed"]
+    carried["rule_flaps"] = plane.status()["rule_flaps"]
+    # a rebuilt plane adopts the previous run's totals: accounting is
+    # an invariant of the deployment, not of one process
+    reborn = ActuationPlane(
+        parse_policy(SPEC, CLASSES), k_install=1,
+        clock=lambda: vclock["t"], ledger=carried, out=io.StringIO(),
+    )
+    st = reborn.status()
+    assert st["ledger"]["intended"] == 2
+    assert st["ledger"]["installed"] == 2
+    assert st["ledger"]["exact"]
+    reborn.observe(_rows("attack", 1))
+    reborn.observe(_rows("attack", 1))
+    st = reborn.status()
+    assert st["ledger"]["intended"] == 3 and st["ledger"]["exact"]
+
+
+def test_state_gauge_and_counters():
+    vclock = {"t": 0.0}
+    m = Metrics()
+    plane = ActuationPlane(
+        parse_policy(SPEC, CLASSES), k_install=1, k_retract=1,
+        clock=lambda: vclock["t"], metrics=m, out=io.StringIO(),
+    )
+    assert m.gauges["actuation_state"] == 1  # dry-run
+    plane.observe(_rows("video", 1))
+    plane.observe(_rows("video", 1))         # install video
+    plane.observe(_rows("attack", 1))        # k_retract=1: retract
+    plane.observe(_rows("attack", 1))        # install attack
+    snap = m.snapshot()
+    assert snap["actuation_rules_installed"] == 2
+    assert snap["actuation_rules_retracted"] == 1
+    plane.observe(_rows("unknown", 1))       # retract again (k=1)
+    plane.observe(_rows("video", 1))         # new streak...
+    plane.observe(_rows("unknown", 1))       # ...broken: suppressed
+    plane.observe(_rows("video", 1), drift_state="ROLLED_BACK")
+    assert m.gauges["actuation_state"] == 4  # demoted
+    assert m.counters["actuation_flaps_suppressed"] >= 1
+
+
+def test_healthz_actuation_block():
+    vclock = {"t": 0.0}
+    plane = _plane(vclock, k_install=1)
+    plane.observe(_rows("video", 2))
+    plane.observe(_rows("video", 2))
+    health = HealthState(clock=lambda: vclock["t"])
+    health.set_actuation(plane.status)
+    health.tick()
+    ok, report = health.check()
+    assert ok
+    assert report["actuation"]["state"] == "dry-run"
+    assert report["actuation"]["installed_rules"] == 2
+    assert report["actuation"]["ledger"]["exact"]
+    # a broken status_fn degrades the block, never the verdict
+    health.set_actuation(lambda: 1 / 0)
+    ok, report = health.check()
+    assert ok
+    assert report["actuation"]["state"] == "unknown"
+
+
+def test_dry_run_renders_to_out_only():
+    vclock = {"t": 0.0}
+    out = io.StringIO()
+    plane = ActuationPlane(
+        parse_policy(SPEC, CLASSES), k_install=1,
+        clock=lambda: vclock["t"], out=out,
+    )
+    stdout = io.StringIO()
+    with contextlib.redirect_stdout(stdout):
+        plane.observe(_rows("video", 1))
+        plane.observe(_rows("video", 1))
+    text = out.getvalue()
+    assert "actuation[dry-run] intended mods:" in text
+    assert "+ install cookie=1" in text and "[queue queue=1]" in text
+    assert stdout.getvalue() == ""
+
+
+# ---------------------------------------------------------------------------
+# push mode against the accounting FakeSwitch
+# ---------------------------------------------------------------------------
+
+
+def test_push_refusal_accounts_and_degrades():
+    vclock = {"t": 0.0}
+    with _accounting_switch_cls()() as sw:
+        sw.script_refuse(1)
+        plane = _plane(vclock, mode="push", switch=sw, k_install=1)
+        try:
+            plane.observe(_rows("video"))
+            plane.observe(_rows("video"))
+            st = plane.status()
+            # one mod refused by the switch, the rest confirmed — and a
+            # refusing switch is as suspect as a dead one: degrade
+            assert st["ledger"]["refused"] == 1
+            assert st["ledger"]["installed"] == 2
+            assert st["ledger"]["exact"]
+            assert st["state"] == "degraded"
+            assert len(_settle(sw, "refusals", 1)) == 1
+            assert len(sw.live_cookies()) == 2
+        finally:
+            plane.close()
+
+
+def test_push_stalled_barrier_refuses_flush():
+    vclock = {"t": 0.0}
+    with _accounting_switch_cls()() as sw:
+        sw.script_stall_barrier(1)
+        plane = _plane(vclock, mode="push", switch=sw, k_install=1)
+        try:
+            plane.observe(_rows("video"))
+            plane.observe(_rows("video"))
+            st = plane.status()
+            # the barrier reply never came: nothing is confirmed
+            assert st["state"] == "degraded"
+            assert st["ledger"]["refused"] == 3
+            assert st["ledger"]["exact"]
+            assert st["orphan_pairs"] == 3
+        finally:
+            plane.close()
+
+
+def test_switch_add_replace_semantics():
+    """OF1.3 ADD with an existing match+priority replaces the entry —
+    the property reconcile's wipe+install repair leans on."""
+    with _accounting_switch_cls()() as sw:
+        link = SwitchLink(sw.host, sw.port)
+        link.open()
+        try:
+            link.send(compile_install(
+                link.next_xid(), SRC, DST, PolicyAction("queue", 1), 1,
+            ))
+            link.send(compile_install(
+                link.next_xid(), SRC, DST, PolicyAction("drop"), 2,
+            ))
+            assert link.barrier(link.next_xid()) == set()
+        finally:
+            link.close()
+        assert len(_settle(sw, "installs", 2)) == 2
+        assert sw.live_cookies() == {2}
+
+
+# ---------------------------------------------------------------------------
+# blast radius: quarantine retracts exactly the dead namespace's rules,
+# spine-uniformly (python index walk vs native tag scan)
+# ---------------------------------------------------------------------------
+
+
+def _source_rec(t, sid, i):
+    return TelemetryRecord(
+        time=t, datapath="1", in_port=1,
+        eth_src=f"0{sid}:00:00:00:00:{i:02x}", eth_dst="ff:00:00:00:00:01",
+        out_port=2, packets=10 * t + i, bytes=1000 * t + i,
+        source=sid,
+    )
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_quarantine_retracts_exactly_dead_namespace(native):
+    if native:
+        from traffic_classifier_sdn_tpu.native import engine as ne
+
+        if not ne.available():
+            pytest.skip("native engine unavailable")
+    eng = FlowStateEngine(capacity=64, native=native)
+    eng.mark_tick()
+    eng.ingest([
+        _source_rec(1, sid, i) for sid in (1, 2, 3) for i in range(2)
+    ])
+    eng.step()
+    vclock = {"t": 0.0}
+    with _accounting_switch_cls()() as sw:
+        plane = _plane(vclock, mode="push", switch=sw, k_install=1)
+        try:
+            meta = eng.slot_metadata()
+            rows = [
+                (slot, src, dst, "video")
+                for slot, (src, dst) in sorted(meta.items())
+            ]
+            plane.observe(rows)
+            plane.observe(rows)
+            assert plane.status()["installed_rules"] == 6
+            # kill source 2: capture its slots BEFORE eviction releases
+            # them, exactly like cli._evict_dead_namespaces
+            dead_slots = eng.slots_for_source(2)
+            assert len(dead_slots) == 2
+            dead_pairs = {meta[int(s)] for s in dead_slots}
+            plane.retract_source(2, dead_slots)
+            assert eng.evict_source(2) == 2
+            st = plane.status()
+            assert st["installed_rules"] == 4
+            assert st["ledger"]["retracted"] == 2
+            assert st["ledger"]["exact"]
+            deletes = _settle(sw, "deletes", 2)
+            assert {
+                (d["match"]["eth_src"], d["match"]["eth_dst"])
+                for d in deletes
+            } == dead_pairs
+            assert len(sw.live_cookies()) == 4
+            # the surviving namespaces' rules never moved
+            for sid in (1, 3):
+                assert len(eng.slots_for_source(sid)) == 2
+        finally:
+            plane.close()
+
+
+def test_span_filters_foreign_slots():
+    """A fleet member given a source span only ever actuates slots its
+    span owns — foreign rows are invisible to the FSM."""
+    eng = FlowStateEngine(capacity=64)
+    eng.mark_tick()
+    eng.ingest([
+        _source_rec(1, sid, i) for sid in (1, 2) for i in range(2)
+    ])
+    eng.step()
+    vclock = {"t": 0.0}
+    plane = ActuationPlane(
+        parse_policy(SPEC, CLASSES), k_install=1,
+        clock=lambda: vclock["t"],
+        span=frozenset({1}), slots_for_source=eng.slots_for_source,
+        out=io.StringIO(),
+    )
+    meta = eng.slot_metadata()
+    rows = [
+        (slot, src, dst, "video")
+        for slot, (src, dst) in sorted(meta.items())
+    ]
+    plane.observe(rows)
+    plane.observe(rows)
+    st = plane.status()
+    assert st["installed_rules"] == 2    # source 1's flows only
+    assert st["ledger"]["intended"] == 2
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end replay acceptance (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def test_end_to_end_replay_against_fake_switch():
+    """classify → hysteresis-gated install → quarantine retracts
+    exactly the dead namespace's rules → drift rollback demotes →
+    re-promotion re-installs; then an armed ``actuation.send`` stall:
+    observe never blocks past the transport timeout, the ledger stays
+    EXACT, zero rule flaps — and the backoff re-probe reconverges the
+    switch to the plane's installed census."""
+    eng = FlowStateEngine(capacity=64)
+    eng.mark_tick()
+    eng.ingest([
+        _source_rec(1, sid, i) for sid in (1, 2, 3) for i in range(2)
+    ])
+    eng.step()
+    meta = eng.slot_metadata()
+    rows = [
+        (slot, src, dst, "video")
+        for slot, (src, dst) in sorted(meta.items())
+    ]
+    vclock = {"t": 0.0}
+    with _accounting_switch_cls()() as sw:
+        plane = _plane(vclock, mode="push", switch=sw,
+                       k_install=2, k_retract=2, backoff_base_s=1.0)
+        try:
+            # classify → install: labels must hold k_install ticks
+            plane.observe(rows, drift_state="STEADY")
+            assert plane.status()["installed_rules"] == 0
+            plane.observe(rows, drift_state="STEADY")
+            assert plane.status()["installed_rules"] == 6
+            assert len(_settle(sw, "installs", 6)) == 6
+            # quarantine source 2: exactly its two rules retract
+            dead_slots = eng.slots_for_source(2)
+            plane.retract_source(2, dead_slots)
+            eng.evict_source(2)
+            assert plane.status()["installed_rules"] == 4
+            assert len(_settle(sw, "deletes", 2)) == 2
+            assert len(sw.live_cookies()) == 4
+            rows = [r for r in rows if r[0] not in set(map(int, dead_slots))]
+            # drift rollback: hold-and-retract pulls the survivors
+            plane.observe(rows, drift_state="ROLLED_BACK")
+            st = plane.status()
+            assert st["state"] == "demoted"
+            assert st["installed_rules"] == 0
+            _settle(sw, "deletes", 6)
+            assert len(sw.live_cookies()) == 0
+            # re-promotion: streaks re-earn, rules re-install
+            plane.observe(rows, drift_state="PROMOTED")
+            plane.observe(rows)
+            st = plane.status()
+            assert st["state"] == "push"
+            assert st["installed_rules"] == 4
+            assert st["rule_flaps"] == 0
+            assert len(_settle(sw, "installs", 10)) == 10
+            # armed actuation.send stall: a new namespace's install
+            # burst dies on the wire — observe holds cadence (bounded
+            # by the transport timeout), accounting stays exact
+            eng.mark_tick()
+            eng.ingest([_source_rec(2, 4, i) for i in range(2)])
+            eng.step()
+            meta = eng.slot_metadata()
+            rows = [
+                (slot, src, dst, "video")
+                for slot, (src, dst) in sorted(meta.items())
+            ]
+            with faults.installed(faults.FaultPlan(
+                [faults.FaultRule("actuation.send", times=1)], 0,
+            )) as plan:
+                plane.observe(rows)
+                t0 = time.monotonic()
+                plane.observe(rows)  # the armed flush: fault fires
+                held = time.monotonic() - t0
+                assert plan.fires == [("actuation.send", 1)]
+            assert held < 1.0, f"observe stalled {held:.3f}s"
+            st = plane.status()
+            assert st["state"] == "degraded"
+            assert st["ledger"]["exact"]
+            assert st["rule_flaps"] == 0
+            # the new pair re-earns dry; the re-probe reconciles it
+            plane.observe(rows)
+            plane.observe(rows)
+            vclock["t"] += 5.0
+            plane.observe(rows)
+            st = plane.status()
+            assert st["state"] == "push"
+            assert st["installed_rules"] == 6
+            assert st["ledger"]["exact"]
+            assert st["rule_flaps"] == 0
+            deadline = time.monotonic() + 5.0
+            while len(sw.live_cookies()) != 6 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(sw.live_cookies()) == 6
+        finally:
+            plane.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI: transparency + validation
+# ---------------------------------------------------------------------------
+
+
+def _native_checkpoint(tmp_path):
+    from traffic_classifier_sdn_tpu.io import checkpoint as ck
+
+    rng = np.random.RandomState(0)
+    params = gnb.from_numpy({
+        "theta": rng.gamma(2.0, 100.0, (2, 12)),
+        "var": rng.gamma(2.0, 50.0, (2, 12)) + 1.0,
+        "class_prior": np.full(2, 0.5),
+    })
+    path = str(tmp_path / "gnb_ckpt")
+    ck.save_model(path, "gnb", params, classes=("ping", "voice"))
+    return path
+
+
+def _serve(argv):
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        cli.main(argv)
+    return out.getvalue(), err.getvalue()
+
+
+def _common(ckpt):
+    return [
+        "gaussiannb", "--native-checkpoint", ckpt,
+        "--source", "synthetic", "--synthetic-flows", "16",
+        "--capacity", "64", "--print-every", "2", "--max-ticks", "10",
+        "--idle-timeout", "0", "--table-rows", "8",
+    ]
+
+
+@pytest.mark.parametrize("incremental", ["off", "auto"])
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+def test_actuation_dry_run_byte_identical_stdout(
+    tmp_path, pipeline, incremental,
+):
+    """The transparency acceptance: --actuation dry-run stdout is
+    byte-identical to --actuation off (the default) — the intended-mods
+    table rides stderr, classify output is untouched."""
+    common = _common(_native_checkpoint(tmp_path)) + [
+        "--pipeline", pipeline, "--incremental", incremental,
+    ]
+    off_out, _ = _serve(common)
+    dry_out, dry_err = _serve(common + [
+        "--actuation", "dry-run", "--actuation-k-install", "2",
+        "--policy", "ping=queue:1,voice=queue:2",
+    ])
+    assert "Flow ID" in off_out
+    assert dry_out == off_out
+    assert "actuation[dry-run] intended mods:" in dry_err
+    assert "actuation" not in dry_out
+
+
+def test_cli_actuation_validation(tmp_path):
+    with pytest.raises(SystemExit, match="needs --policy"):
+        cli.main(["gaussiannb", "--actuation", "dry-run"])
+    with pytest.raises(SystemExit, match="without --actuation"):
+        cli.main(["gaussiannb", "--policy", "ping=drop"])
+    with pytest.raises(SystemExit, match="needs --actuation-switch"):
+        cli.main(["gaussiannb", "--actuation", "push",
+                  "--policy", "ping=drop"])
+    ckpt = _native_checkpoint(tmp_path)
+    with pytest.raises(SystemExit, match="not in model classes"):
+        _serve(_common(ckpt) + [
+            "--actuation", "dry-run", "--policy", "nosuch=drop",
+        ])
+    with pytest.raises(SystemExit, match="wants HOST:PORT"):
+        _serve(_common(ckpt) + [
+            "--actuation", "push", "--policy", "ping=drop",
+            "--actuation-switch", "nohost",
+        ])
+    with pytest.raises(SystemExit, match="integer source ids"):
+        _serve(_common(ckpt) + [
+            "--actuation", "dry-run", "--policy", "ping=drop",
+            "--actuation-span", "a,b",
+        ])

@@ -1969,3 +1969,231 @@ def test_region_fanin_put_drop_never_tears_sharded_scatter():
         }
     finally:
         gen.close()
+
+
+# ---------------------------------------------------------------------------
+# actuation.send / actuation.barrier / actuation.retract — the flow-rule
+# actuation plane (serving/actuation.py): all three wire seams ABSORBED.
+# A fire degrades the plane to dry-run with exponential-backoff
+# re-probe; the op that died (and every unconfirmed op of its flush) is
+# accounted refused; classification never blocks; and the re-probe's
+# reconcile replays the FSM's view — wiping orphan rules whose
+# retract/refusal never reached the wire — so the switch converges back
+# to exactly the plane's installed census. The rule ledger (intended ==
+# installed + refused + retracted) is asserted at EVERY flush.
+# ---------------------------------------------------------------------------
+
+
+def _accounting_switch():
+    from traffic_classifier_sdn_tpu.scenarios.runner import (
+        _accounting_switch_cls,
+    )
+
+    return _accounting_switch_cls()()
+
+
+def _actuation_plane(switch, vclock, **kw):
+    import io as _io
+
+    from traffic_classifier_sdn_tpu.controller.policy import parse_policy
+    from traffic_classifier_sdn_tpu.serving.actuation import (
+        ActuationPlane,
+        SwitchLink,
+    )
+
+    policy = parse_policy(
+        "video=queue:1,attack=drop", ("video", "attack", "bulk"),
+    )
+    return ActuationPlane(
+        policy, mode="push", k_install=2, k_retract=2,
+        clock=lambda: vclock["t"],
+        link_factory=lambda: SwitchLink(switch.host, switch.port),
+        backoff_base_s=1.0, out=_io.StringIO(), **kw,
+    )
+
+
+_ACT_ROWS = [
+    (0, "aa:00:00:00:00:01", "aa:00:00:00:00:02", "video"),
+    (1, "aa:00:00:00:00:03", "aa:00:00:00:00:04", "video"),
+    (2, "aa:00:00:00:00:05", "aa:00:00:00:00:06", "attack"),
+]
+
+
+def _switch_settles(sw, n, accessor="installs"):
+    deadline = time.monotonic() + 5.0
+    while len(getattr(sw, accessor)()) < n:
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.01)
+
+
+def test_actuation_send_fault_degrades_to_dry_run_exact_ledger():
+    """A fire at actuation.send on the first install burst: the plane
+    degrades to dry-run (the observing serve never blocks), every op
+    of the flush is accounted refused, the switch stays untouched —
+    and after the backoff elapses on the injected clock, the re-probe
+    reconciles the dry-installed rules onto the wire."""
+    vclock = {"t": 0.0}
+    with _accounting_switch() as sw:
+        plane = _actuation_plane(sw, vclock)
+        try:
+            with faults.installed(faults.FaultPlan(
+                [faults.FaultRule("actuation.send", times=1)], SEED,
+            )) as plan:
+                plane.observe(_ACT_ROWS)   # streak 1
+                plane.observe(_ACT_ROWS)   # streak 2 -> flush, fault
+                assert plan.fires == [("actuation.send", 1)]
+            st = plane.status()
+            assert st["state"] == "degraded"
+            assert st["ledger"] == {
+                "intended": 3, "installed": 0, "refused": 3,
+                "retracted": 0, "exact": True,
+            }
+            assert sw.installs() == []
+            # streaks re-earn while degraded: installs resolve dry
+            plane.observe(_ACT_ROWS)
+            plane.observe(_ACT_ROWS)
+            st = plane.status()
+            assert st["state"] == "degraded"
+            assert st["installed_rules"] == 3
+            assert st["ledger"]["installed"] == 3
+            # backoff elapsed -> probe ok -> reconcile onto the wire
+            vclock["t"] += 5.0
+            plane.observe(_ACT_ROWS)
+            st = plane.status()
+            assert st["state"] == "push"
+            assert st["ledger"]["exact"]
+            assert len(sw.live_cookies()) == 3
+        finally:
+            plane.close()
+
+
+def test_actuation_barrier_fault_orphan_mods_wiped_on_reconcile():
+    """A fire at actuation.barrier AFTER the mods went out: the ops
+    are accounted refused (never confirmed) even though they LANDED on
+    the switch — and the re-probe's reconcile wipes those orphan
+    copies before re-asserting intent, so the switch ends with exactly
+    one rule per pair, under cookies the FSM actually tracks."""
+    vclock = {"t": 0.0}
+    with _accounting_switch() as sw:
+        plane = _actuation_plane(sw, vclock)
+        try:
+            with faults.installed(faults.FaultPlan(
+                [faults.FaultRule("actuation.barrier", times=1)], SEED,
+            )) as plan:
+                plane.observe(_ACT_ROWS)
+                plane.observe(_ACT_ROWS)
+                assert plan.fires == [("actuation.barrier", 1)]
+            st = plane.status()
+            assert st["state"] == "degraded"
+            assert st["ledger"]["refused"] == 3
+            # the mods really landed: unconfirmed orphans on the wire
+            _switch_settles(sw, 3)
+            assert len(sw.installs()) == 3
+            assert st["orphan_pairs"] == 3
+            # re-earn dry, then probe + reconcile
+            plane.observe(_ACT_ROWS)
+            plane.observe(_ACT_ROWS)
+            vclock["t"] += 5.0
+            plane.observe(_ACT_ROWS)
+            st = plane.status()
+            assert st["state"] == "push"
+            assert st["installed_rules"] == 3
+            assert st["orphan_pairs"] == 0
+            live = sw.live_cookies()
+            # one rule per pair; the pre-degrade cookies are gone
+            assert len(live) == 3
+            assert live.isdisjoint({1, 2, 3})
+            assert st["ledger"]["exact"]
+        finally:
+            plane.close()
+
+
+def test_actuation_retract_fault_absorbed_and_pair_reconverges():
+    """A fire at actuation.retract while a label change pulls a rule:
+    the delete is accounted refused, the plane degrades, the old rule
+    stays live on the switch (orphan) — and after the re-probe the
+    pair's NEW verdict lands while the reconcile wipe clears the
+    orphan, leaving exactly one rule for the pair."""
+    vclock = {"t": 0.0}
+    with _accounting_switch() as sw:
+        plane = _actuation_plane(sw, vclock)
+        try:
+            plane.observe(_ACT_ROWS)
+            plane.observe(_ACT_ROWS)
+            st = plane.status()
+            assert st["installed_rules"] == 3
+            _switch_settles(sw, 3)
+            flipped = [(0, _ACT_ROWS[0][1], _ACT_ROWS[0][2], "attack")] \
+                + _ACT_ROWS[1:]
+            with faults.installed(faults.FaultPlan(
+                [faults.FaultRule("actuation.retract", times=1)], SEED,
+            )) as plan:
+                plane.observe(flipped)   # deviation 1
+                plane.observe(flipped)   # deviation 2 -> retract, fault
+                assert plan.fires == [("actuation.retract", 1)]
+            st = plane.status()
+            assert st["state"] == "degraded"
+            assert st["ledger"]["refused"] == 1 and st["ledger"]["exact"]
+            # the refused delete left the old rule live
+            assert len(sw.live_cookies()) == 3
+            # the pair's new verdict re-earns (dry while degraded)...
+            plane.observe(flipped)
+            st = plane.status()
+            assert st["installed_rules"] == 3
+            # a label-retract followed by re-install IS a rule flap
+            assert st["rule_flaps"] == 1
+            # ...and the re-probe reconverges the wire: one rule per
+            # pair, the orphan wiped, the new attack rule live
+            vclock["t"] += 5.0
+            plane.observe(flipped)
+            st = plane.status()
+            assert st["state"] == "push"
+            assert st["orphan_pairs"] == 0
+            assert len(sw.live_cookies()) == 3
+            assert st["ledger"]["exact"]
+        finally:
+            plane.close()
+
+
+def test_actuation_probabilistic_any_seed_ledger_exact_never_raises():
+    """Probability-scheduled fires at ALL THREE actuation wire seams
+    (any TCSDN_CHAOS_SEED): whatever subset fires, observe() never
+    raises, the rule ledger is exact at EVERY tick, and once the wire
+    is quiet again the re-probe reconverges the switch to exactly the
+    plane's installed census — no orphans, no lost rules."""
+    vclock = {"t": 0.0}
+    rng = np.random.RandomState(SEED)
+    with _accounting_switch() as sw:
+        plane = _actuation_plane(sw, vclock)
+        try:
+            with faults.installed(faults.FaultPlan([
+                faults.FaultRule("actuation.send", p=0.3, times=None),
+                faults.FaultRule("actuation.barrier", p=0.3, times=None),
+                faults.FaultRule("actuation.retract", p=0.3, times=None),
+            ], SEED)):
+                for t in range(40):
+                    rows = [
+                        # pair 0 oscillates on a 3-tick period, pair 1
+                        # is stable, pair 2 wanders over all classes
+                        (0, _ACT_ROWS[0][1], _ACT_ROWS[0][2],
+                         "video" if (t // 3) % 2 else "attack"),
+                        _ACT_ROWS[1],
+                        (2, _ACT_ROWS[2][1], _ACT_ROWS[2][2],
+                         ["attack", "video", "bulk"][rng.randint(3)]),
+                    ]
+                    plane.observe(rows)
+                    assert plane.status()["ledger"]["exact"]
+                    vclock["t"] += 1.0
+            # quiet wire: give the backoff ladder room to re-probe
+            for _ in range(6):
+                vclock["t"] += 60.0
+                plane.observe([_ACT_ROWS[1]])
+            st = plane.status()
+            assert st["state"] == "push"
+            assert st["ledger"]["exact"]
+            assert st["orphan_pairs"] == 0
+            _switch_settles(sw, st["installed_rules"])
+            assert len(sw.live_cookies()) == st["installed_rules"]
+        finally:
+            plane.close()

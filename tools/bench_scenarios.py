@@ -7,12 +7,15 @@ Each scenario (traffic_classifier_sdn_tpu/scenarios/library.py) is a
 declarative phase timeline — flash crowd, source flap storm,
 cumulative-counter reset storm, novel-class wave + boundary-hugging
 evasion, mass-eviction churn spike, queue-saturation flood, device
-wedge — run through the fan-in tier × native ingest × incremental
-serving stack with the relevant ladders live, and scored against its
-gates: cadence p50, EXACT per-source drop accounting (zero silent
-drops), e2e p99 via the latency-provenance waterfall, required state
-transitions observed in the flight recorder, and open-world ground
-truth where the scenario injects novelty.
+wedge, label flap storm vs the actuation hysteresis — run through the
+fan-in tier × native ingest × incremental serving stack with the
+relevant ladders live (the flap storm pushes real flow-mods at an
+in-process AccountingSwitch), and scored against its gates: cadence
+p50, EXACT per-source drop accounting (zero silent drops), e2e p99
+via the latency-provenance waterfall, required state transitions
+observed in the flight recorder, open-world ground truth where the
+scenario injects novelty, and — where actuation is armed — zero rule
+flaps with an exact rule ledger.
 
 Writes docs/artifacts/scenario_matrix_cpu.json (tools/tpu_day.sh arms
 the scenario_matrix_tpu.json variant) and EXITS NONZERO on any gate

@@ -66,6 +66,7 @@ GROUPS_KEYS=(
   "obs:obs_stamp or sigusr1"
   "obsdev:perf_ring or profiler"
   "openset:openset_score or openset_calibrate or openset_rebase or openset_probabilistic"
+  "actuation:actuation_send or actuation_barrier or actuation_retract or actuation_probabilistic"
 )
 
 fail=0
@@ -86,7 +87,8 @@ done
 
 # scenario campaign group: the composed adversarial timelines
 # (tests/test_scenarios.py — flash crowd, flap storm, reset storm,
-# novel wave, mass eviction, queue flood, device wedge) under the same
+# novel wave, mass eviction, queue flood, device wedge, label flap
+# storm vs the actuation hysteresis) under the same
 # locktrace witness. Each scenario drives the REAL fan-in pumps ×
 # serve loop × ladder threads, so its schedules double as lock-order
 # evidence; one sweep suffices — the timelines are deterministic on

@@ -521,6 +521,53 @@ def _build_parser() -> argparse.ArgumentParser:
         "loop resumes watching)",
     )
     p.add_argument(
+        "--actuation", choices=("off", "dry-run", "push"), default="off",
+        help="actuation tier (serving/actuation.py): compile per-class "
+        "--policy actions into OF1.3 flow-mods, hysteresis-gated so a "
+        "label must hold for --actuation-k-install consecutive render "
+        "ticks before its rule installs (an open-set 'unknown' blip or "
+        "single-tick flip never touches the switch), and retracted "
+        "only after --actuation-k-retract deviating ticks. 'dry-run' "
+        "renders intended mods to stderr + ring events without a "
+        "socket; 'push' programs the switch at --actuation-switch and "
+        "degrades itself to dry-run with backoff re-probe on ANY "
+        "actuation failure. 'off' (default) is byte-transparent: "
+        "stdout is identical with the tier absent. Single-device "
+        "serves only (the sharded render has no per-row label surface "
+        "to gate on)",
+    )
+    p.add_argument(
+        "--policy", default=None, metavar="SPEC",
+        help="declarative per-class actions for --actuation: comma-"
+        "separated CLASS=ACTION clauses where ACTION is queue:N (QoS "
+        "queue), meter:N (rate limit), drop, or mirror:P (copy to "
+        "port P, forward normally). Classes without a clause are "
+        "observe-only; 'unknown' may never carry one",
+    )
+    p.add_argument(
+        "--actuation-switch", default=None, metavar="HOST:PORT",
+        help="switch address for --actuation push (the OF1.3 peer the "
+        "actuation plane dials; tools/fake_switch.py AccountingSwitch "
+        "speaks the server side for replay tests)",
+    )
+    p.add_argument(
+        "--actuation-k-install", type=int, default=3, metavar="K",
+        help="consecutive stable-label render ticks before a rule "
+        "installs (default 3)",
+    )
+    p.add_argument(
+        "--actuation-k-retract", type=int, default=3, metavar="K",
+        help="consecutive deviating render ticks before an installed "
+        "rule retracts (default 3); a deviation episode that ends "
+        "sooner is a suppressed flap",
+    )
+    p.add_argument(
+        "--actuation-span", default=None, metavar="SIDS",
+        help="comma-separated source ids this serve may actuate "
+        "(fleet blast radius: members only program rules for slots "
+        "their own span owns; default: every source)",
+    )
+    p.add_argument(
         "--warmup", action="store_true",
         help="AOT-compile the serving programs at startup "
         "(serving/warmup.py: donated scatter per batch bucket, feature "
@@ -793,6 +840,22 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
         sys.exit(
             "--drift-follow needs --drift auto (the follower IS the "
             "drift loop, adopting peers' rotation members)"
+        )
+    if args.actuation != "off" and not args.policy:
+        sys.exit("--actuation needs --policy (the per-class action spec)")
+    if args.policy and args.actuation == "off":
+        sys.exit(
+            "--policy without --actuation does nothing — pass "
+            "--actuation dry-run|push (off is the byte-transparent "
+            "default)"
+        )
+    if args.actuation == "push" and not args.actuation_switch:
+        sys.exit("--actuation push needs --actuation-switch HOST:PORT")
+    if args.actuation != "off" and sharded:
+        sys.exit(
+            "--actuation is single-device: the hysteresis tier rides "
+            "the per-row label render plus the open-set/drift gates, "
+            "which the sharded spine's fused read programs don't expose"
         )
 
     name = SUBCOMMAND_ALIASES[args.subcommand]
@@ -1200,6 +1263,54 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             metrics=m, recorder=recorder, tracer=tracer,
         )
 
+    # Actuation tier (serving/actuation.py): built AFTER the open-set
+    # gate extended the class list, so --policy validates against the
+    # same names every render decodes (and 'unknown' is rejectable by
+    # name). The plane only ever *observes* rendered rows — stdout is
+    # byte-identical to --actuation off by construction, and every
+    # actuation failure is absorbed into dry-run + backoff re-probe.
+    actuation = None
+    if args.actuation != "off":
+        from .controller.policy import parse_policy
+        from .serving.actuation import ActuationPlane, SwitchLink
+
+        try:
+            policy = parse_policy(args.policy, tuple(model.classes.names))
+        except ValueError as e:
+            sys.exit(str(e))
+        link_factory = None
+        if args.actuation == "push":
+            sw_host, _, sw_port = args.actuation_switch.rpartition(":")
+            if not sw_host or not sw_port.isdigit():
+                sys.exit("--actuation-switch wants HOST:PORT")
+
+            def link_factory(host=sw_host, port=int(sw_port)):
+                return SwitchLink(host, port)
+
+        span = None
+        if args.actuation_span:
+            try:
+                span = frozenset(
+                    int(s) for s in args.actuation_span.split(",")
+                    if s.strip()
+                )
+            except ValueError:
+                sys.exit(
+                    "--actuation-span wants comma-separated integer "
+                    "source ids"
+                )
+        actuation = ActuationPlane(
+            policy, mode=args.actuation,
+            k_install=args.actuation_k_install,
+            k_retract=args.actuation_k_retract,
+            link_factory=link_factory,
+            span=span,
+            slots_for_source=(
+                engine.slots_for_source if span is not None else None
+            ),
+            metrics=m, recorder=recorder,
+        )
+
     server = None
     health = None
     probe_out: dict = {}
@@ -1232,6 +1343,10 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             # the rejection tier's self-report: state, calibrated
             # threshold, rejection counters
             health.set_openset(openset.status)
+        if actuation is not None:
+            # the actuation block: live state (push/dry-run/degraded/
+            # demoted), rule FSM census, the exact ledger, flap counts
+            health.set_actuation(actuation.status)
         if lat is not None:
             # the live e2e budget: p50/p99 since emit + dominant stage
             health.set_latency(lat.status)
@@ -1314,7 +1429,7 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
                         probe_out=probe_out, degrade=degrade_surface,
                         drift=drift, drift_feed=drift_feed, inc=inc,
                         lat=lat, usr1=usr1, openset=openset, dev=dev,
-                        perf=perf)
+                        perf=perf, actuation=actuation)
     except BaseException as e:
         # the crash-forensics moment: record the terminal exception and
         # freeze the ring — safely outside any signal-handler frame.
@@ -1372,6 +1487,11 @@ def _run_classify_armed(args, lock_witness, sync_witness=None) -> None:
             # unregister the monitoring listeners + restore the
             # dispatch logger — a finished run must not haunt the next
             dev.detach()
+        if actuation is not None:
+            # the plane only closes its switch link — installed rules
+            # stay (a serve restart must not blackhole live traffic by
+            # retracting QoS rules it will re-earn in seconds)
+            actuation.close()
         if degrade_surface is not None:
             # the view closes both the live (possibly promoted) ladder
             # and the boot one; without drift it IS the boot ladder
@@ -1536,7 +1656,8 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                 use_native, dropped_seen, tracer, recorder=None,
                 health=None, probe_out=None, degrade=None,
                 drift=None, drift_feed=None, inc=None, lat=None,
-                usr1=None, openset=None, dev=None, perf=None) -> None:
+                usr1=None, openset=None, dev=None, perf=None,
+                actuation=None) -> None:
     from .ingest.fanin import RawTick
     from .utils.profiling import trace
 
@@ -1670,7 +1791,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                             and probe_out.get("fanin") is not None):
                         _evict_dead_namespaces(
                             probe_out["fanin"], engine, m, pipe,
-                            recorder, lat=lat,
+                            recorder, lat=lat, actuation=actuation,
                         )
                     ticks += 1
                     m.inc("ticks")
@@ -1700,7 +1821,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                 feature_stage, sharded,
                                 degrade=degrade, drift=drift,
                                 drift_feed=drift_feed, inc=inc,
-                                lat=lat,
+                                lat=lat, actuation=actuation,
                             )
                         elif sharded:
                             # the sharded tick's whole read side
@@ -1745,6 +1866,7 @@ def _serve_loop(args, engine, model, predict, serve_params, m, sharded,
                                     engine, model, predict,
                                     serve_params, args, tracer,
                                     degrade=degrade, inc=inc, lat=lat,
+                                    drift=drift, actuation=actuation,
                                 )
                             if drift is not None:
                                 # off the hot path: the tick's labels
@@ -1865,7 +1987,7 @@ def _begin_tick_provenance(lat, batch, probe_out) -> None:
 
 
 def _evict_dead_namespaces(tier, engine, m, pipe, recorder,
-                           lat=None) -> None:
+                           lat=None, actuation=None) -> None:
     """Evict namespaces whose source-death quarantine expired (fan-in
     tier, ingest/fanin.py). Deferred while a pipelined render is in
     flight — a released slot's metadata must outlive its render, the
@@ -1880,6 +2002,12 @@ def _evict_dead_namespaces(tier, engine, m, pipe, recorder,
         # namespace tags (tck_slots_for_source) — the old native
         # degrade-to-idle-timeout fallback (and its
         # source_evictions_skipped counter) is gone
+        if actuation is not None:
+            # blast radius: the dead namespace's flow rules retract
+            # with its slots — captured BEFORE evict_source releases
+            # them (a released slot could be reused next tick and the
+            # retraction would name the wrong flow)
+            actuation.retract_source(sid, engine.slots_for_source(sid))
         n = engine.evict_source(sid)
         if lat is not None:
             # the namespace's rows are gone: pending latency entries
@@ -1915,7 +2043,7 @@ def _feed_sharded_capture(engine, gate, rows) -> None:
 def _dispatch_render(args, engine, model, predict, serve_params, m,
                      tracer, pipe, feature_stage, sharded,
                      degrade=None, drift=None, drift_feed=None,
-                     inc=None, lat=None) -> None:
+                     inc=None, lat=None, actuation=None) -> None:
     """Host-stage half of one pipelined render tick: dispatch the read
     side against THIS tick's table and stage the device-stage job.
     Output is byte-identical to the serial render of the same tick —
@@ -2026,9 +2154,11 @@ def _dispatch_render(args, engine, model, predict, serve_params, m,
             with tracer.span("render"):
                 if args.table_rows > 0:
                     _print_ranked(engine, model, rows, read.n_flows,
-                                  stale=stale)
+                                  stale=stale, actuation=actuation,
+                                  drift=drift)
                 else:
-                    _print_full(model, rows, stale=stale)
+                    _print_full(model, rows, stale=stale,
+                                actuation=actuation, drift=drift)
             if lat is not None:
                 lat.render_visible(seal)
         if drift is not None:
@@ -2050,7 +2180,23 @@ def _stale_fields(fields, rows, stale):
             [tuple(r) + ("STALE",) for r in rows])
 
 
-def _print_full(model, rows, stale=False) -> None:
+def _observe_actuation(actuation, rows, stale, drift) -> None:
+    """Feed one rendered tick's ``(slot, src, dst, label)`` rows to the
+    actuation plane, with the freshness verdict (STALE render) and the
+    drift loop's current state riding along — the three signals the
+    hysteresis tier gates on. A no-op without the tier; never raises
+    and never touches stdout, so every render stays byte-identical to
+    ``--actuation off``."""
+    if actuation is None:
+        return
+    actuation.observe(
+        rows, stale=stale,
+        drift_state=drift.state if drift is not None else None,
+    )
+
+
+def _print_full(model, rows, stale=False, actuation=None,
+                drift=None) -> None:
     """Render the unbounded (``--table-rows 0``) table from a
     ``serving.pipeline.FullRead`` row list — the device-stage
     counterpart of ``_print_table``'s full branch."""
@@ -2067,10 +2213,17 @@ def _print_full(model, rows, stale=False) -> None:
     ]
     fields, out = _stale_fields(CLASSIFIER_FIELDS, out, stale)
     print(render_table(fields, out), flush=True)
+    _observe_actuation(
+        actuation,
+        [(slot, src, dst, names[c] if c < len(names) else "?")
+         for slot, src, dst, c, _f, _r in rows],
+        stale, drift,
+    )
 
 
 def _print_table(engine, model, predict, serve_params, args,
-                 tracer, degrade=None, inc=None, lat=None) -> None:
+                 tracer, degrade=None, inc=None, lat=None,
+                 drift=None, actuation=None) -> None:
     import jax
 
     from .utils.table import CLASSIFIER_FIELDS, render_table, status_str
@@ -2124,7 +2277,7 @@ def _print_table(engine, model, predict, serve_params, args,
         with tracer.span("render"):
             _print_ranked(
                 engine, model, engine.render_sample(labels, limit),
-                n_flows, stale=stale,
+                n_flows, stale=stale, actuation=actuation, drift=drift,
             )
         if lat is not None:
             lat.render_visible(seal)
@@ -2153,17 +2306,25 @@ def _print_table(engine, model, predict, serve_params, args,
         print(render_table(fields, rows), flush=True)
     if lat is not None:
         lat.render_visible(seal)
+    _observe_actuation(
+        actuation,
+        [(slot, src, dst, label) for slot, src, dst, label, *_ in rows],
+        stale, drift,
+    )
 
 
-def _print_ranked(engine, model, ranked, n_flows, stale=False) -> None:
+def _print_ranked(engine, model, ranked, n_flows, stale=False,
+                  actuation=None, drift=None) -> None:
     """Render activity-ranked ``(slot, label, fwd, rev)`` rows — the shared
     table surface for the single-device and mesh-sharded serve loops."""
     sample = engine.slot_metadata(slots=[s for s, *_ in ranked])
-    _print_ranked_resolved(model, ranked, sample, n_flows, stale=stale)
+    _print_ranked_resolved(model, ranked, sample, n_flows, stale=stale,
+                           actuation=actuation, drift=drift)
 
 
 def _print_ranked_resolved(model, ranked, sample, n_flows,
-                           stale=False) -> None:
+                           stale=False, actuation=None,
+                           drift=None) -> None:
     """``_print_ranked`` with the slot→(src, dst) sample already
     resolved — the pipelined sharded eviction path resolves it on the
     host stage (the lookup must precede any slot reuse)."""
@@ -2185,6 +2346,11 @@ def _print_ranked_resolved(model, ranked, sample, n_flows,
     if n_flows > len(rows):
         print(f"... showing {len(rows)} of {n_flows} tracked flows",
               flush=True)
+    _observe_actuation(
+        actuation,
+        [(slot, src, dst, label) for slot, src, dst, label, *_ in rows],
+        stale, drift,
+    )
 
 
 def _run_train(args) -> None:

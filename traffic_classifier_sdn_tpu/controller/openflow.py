@@ -33,8 +33,11 @@ OFPT_PACKET_OUT = 13
 OFPT_FLOW_MOD = 14
 OFPT_MULTIPART_REQUEST = 18
 OFPT_MULTIPART_REPLY = 19
+OFPT_BARRIER_REQUEST = 20
+OFPT_BARRIER_REPLY = 21
 
 # ports / groups / buffers
+OFPP_NORMAL = 0xFFFFFFFA
 OFPP_CONTROLLER = 0xFFFFFFFD
 OFPP_FLOOD = 0xFFFFFFFB
 OFPP_ANY = 0xFFFFFFFF
@@ -44,6 +47,7 @@ OFPTT_ALL = 0xFF
 
 # flow-mod commands
 OFPFC_ADD = 0
+OFPFC_DELETE = 3
 
 # multipart types
 OFPMP_FLOW = 1
@@ -51,7 +55,12 @@ OFPMP_PORT_STATS = 4
 
 # instruction / action types
 OFPIT_APPLY_ACTIONS = 4
+OFPIT_METER = 6
 OFPAT_OUTPUT = 0
+OFPAT_SET_QUEUE = 21
+
+# error types (the two the actuation plane distinguishes)
+OFPET_FLOW_MOD_FAILED = 5
 
 # OXM (match TLV) basic-class fields
 OXM_CLASS_BASIC = 0x8000
@@ -136,8 +145,66 @@ def action_output(port: int, max_len: int = 0xFFFF) -> bytes:
     return struct.pack("!HHIH6x", OFPAT_OUTPUT, 16, port, max_len)
 
 
+def action_set_queue(queue_id: int) -> bytes:
+    return struct.pack("!HHI", OFPAT_SET_QUEUE, 8, queue_id)
+
+
 def instruction_apply_actions(actions: bytes) -> bytes:
     return struct.pack("!HH4x", OFPIT_APPLY_ACTIONS, 8 + len(actions)) + actions
+
+
+def instruction_meter(meter_id: int) -> bytes:
+    return struct.pack("!HHI", OFPIT_METER, 8, meter_id)
+
+
+def decode_instructions(instructions: bytes) -> list[dict]:
+    """Structured view of an instruction list: one dict per instruction.
+
+    apply_actions carries its actions decoded in order (output ports,
+    queue ids); meter carries its meter id. Unknown instruction or
+    action types decode as ``{"type": <int>}`` — never dropped, so a
+    golden round-trip sees everything the encoder emitted.
+    """
+    out: list[dict] = []
+    off = 0
+    n = len(instructions)
+    while off + 8 <= n:
+        itype, ilen = struct.unpack_from("!HH", instructions, off)
+        if ilen < 8 or off + ilen > n:
+            raise ValueError(f"bad instruction length {ilen}")
+        if itype == OFPIT_APPLY_ACTIONS:
+            actions: list[dict] = []
+            a = off + 8
+            end = off + ilen
+            while a + 8 <= end:
+                atype, alen = struct.unpack_from("!HH", instructions, a)
+                if alen < 8 or a + alen > end:
+                    raise ValueError(f"bad action length {alen}")
+                if atype == OFPAT_OUTPUT:
+                    actions.append({
+                        "type": "output",
+                        "port": struct.unpack_from("!I", instructions, a + 4)[0],
+                    })
+                elif atype == OFPAT_SET_QUEUE:
+                    actions.append({
+                        "type": "set_queue",
+                        "queue_id": struct.unpack_from(
+                            "!I", instructions, a + 4
+                        )[0],
+                    })
+                else:
+                    actions.append({"type": atype})
+                a += alen
+            out.append({"type": "apply_actions", "actions": actions})
+        elif itype == OFPIT_METER:
+            out.append({
+                "type": "meter",
+                "meter_id": struct.unpack_from("!I", instructions, off + 4)[0],
+            })
+        else:
+            out.append({"type": itype})
+        off += ilen
+    return out
 
 
 def decode_output_port(instructions: bytes) -> int | None:
@@ -191,10 +258,11 @@ def parse_features_reply(body: bytes) -> int:
 
 def flow_mod(xid: int, priority: int, match: bytes, instructions: bytes,
              buffer_id: int = OFP_NO_BUFFER, table_id: int = 0,
-             command: int = OFPFC_ADD) -> bytes:
+             command: int = OFPFC_ADD, cookie: int = 0,
+             cookie_mask: int = 0) -> bytes:
     body = struct.pack(
         "!QQBBHHHIIIH2x",
-        0, 0,  # cookie, cookie_mask
+        cookie, cookie_mask,
         table_id, command,
         0, 0,  # idle, hard timeout
         priority, buffer_id, OFPP_ANY, OFPG_ANY, 0,
@@ -212,7 +280,37 @@ def parse_flow_mod(body: bytes) -> dict:
     return {
         "priority": priority, "command": command, "buffer_id": buffer_id,
         "match": match, "instructions": body[off:],
+        "cookie": cookie, "cookie_mask": cookie_mask, "table_id": table_id,
     }
+
+
+def barrier_request(xid: int) -> bytes:
+    return message(OFPT_BARRIER_REQUEST, xid)
+
+
+def barrier_reply(xid: int) -> bytes:
+    return message(OFPT_BARRIER_REPLY, xid)
+
+
+def error_msg(xid: int, err_type: int, code: int,
+              offending: bytes = b"") -> bytes:
+    """OFPT_ERROR carrying (a prefix of) the offending message — the
+    spec mandates at least its header, which is how the sender maps a
+    refusal back to the flow-mod it issued."""
+    return message(
+        OFPT_ERROR, xid,
+        struct.pack("!HH", err_type, code) + offending[:64],
+    )
+
+
+def parse_error(body: bytes) -> dict:
+    """→ {type, code, offending_xid} — offending_xid recovered from the
+    embedded original header when present (None otherwise)."""
+    err_type, code = struct.unpack_from("!HH", body)
+    offending_xid = None
+    if len(body) >= 4 + OFP_HEADER.size:
+        _, _, _, offending_xid = OFP_HEADER.unpack_from(body, 4)
+    return {"type": err_type, "code": code, "offending_xid": offending_xid}
 
 
 def packet_out(xid: int, buffer_id: int, in_port: int, actions: bytes,

@@ -635,6 +635,18 @@ class FlowStateEngine(HostSpine):
         (self.batcher if self.native else self.index).release_slots(slots)
         return int(slots.size)
 
+    def slots_for_source(self, source: int) -> "np.ndarray":
+        """The slots a source's namespace currently owns, spine-
+        uniformly (Python index walk or native tag scan). The actuation
+        plane's blast-radius hooks read this: quarantine retraction
+        captures a namespace's slot set BEFORE ``evict_source`` releases
+        it, and a fleet member's source span filters rendered rows."""
+        if self.native:
+            return self.batcher.slots_for_source(source).astype(np.int64)
+        return np.asarray(
+            sorted(self.index.slots_for_source(source)), np.int64
+        )
+
     def evict_source(self, source: int) -> int:
         """Evict every flow in one telemetry source's namespace — the
         blast-radius boundary of the fan-in tier (ingest/fanin.py): a
@@ -659,9 +671,5 @@ class FlowStateEngine(HostSpine):
         self._tails.pop(source, None)
         if self.native:
             self.batcher.reset_tail(source)
-            slots = self.batcher.slots_for_source(source).astype(np.int64)
-        else:
-            slots = np.asarray(
-                sorted(self.index.slots_for_source(source)), np.int64
-            )
+        slots = self.slots_for_source(source)
         return self._clear_and_release(slots)

@@ -135,6 +135,7 @@ class HealthState:
         self._sources = None
         self._latency = None
         self._device = None
+        self._actuation = None
         self._obs_port: int | None = None
 
     def model_loaded(self) -> None:
@@ -207,6 +208,17 @@ class HealthState:
         with self._lock:
             self._device = status_fn
 
+    def set_actuation(self, status_fn) -> None:
+        """``status_fn() -> dict`` (serving/actuation.ActuationPlane
+        .status): the actuation tier's self-report — mode, live state
+        (push/dry-run/degraded/demoted), the rule FSM census, the exact
+        intended == installed + refused + retracted ledger, and the
+        flap counters — folded into /healthz as an ``actuation``
+        object. Informational like ``device``: a degraded plane keeps
+        serving classifications, so it never flips the verdict."""
+        with self._lock:
+            self._actuation = status_fn
+
     def set_obs_port(self, port: int) -> None:
         """The exposition server's ACTUAL bound port — the /healthz
         self-reference. With ``--obs-port 0`` (ephemeral bind) this is
@@ -257,6 +269,7 @@ class HealthState:
             sources = self._sources
             latency = self._latency
             device = self._device
+            actuation = self._actuation
             obs_port = self._obs_port
             model_loaded = self._model_loaded_at
             model_promoted = self._model_promoted_at
@@ -361,6 +374,11 @@ class HealthState:
                 report["device"] = device()
             except Exception as e:  # noqa: BLE001 — health must not crash
                 report["device"] = {"armed": False, "error": str(e)}
+        if actuation is not None:
+            try:
+                report["actuation"] = actuation()
+            except Exception as e:  # noqa: BLE001 — health must not crash
+                report["actuation"] = {"state": "unknown", "error": str(e)}
         if obs_port is not None:
             report["obs_port"] = obs_port
         return healthy, report

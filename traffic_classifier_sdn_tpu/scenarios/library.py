@@ -54,7 +54,10 @@ from .timeline import (
     gate_known_accept,
     gate_namespace_evicted,
     gate_restart_refused,
+    gate_rule_accounting,
+    gate_rules_installed,
     gate_unknown_recall,
+    gate_zero_rule_flaps,
 )
 
 _PROFILES = ("t1", "cpu")
@@ -446,6 +449,111 @@ def device_wedge_degrade(profile: str = "t1") -> Scenario:
     )
 
 
+# -- 8 · label flap storm vs the actuation hysteresis ------------------------
+
+def label_flap_storm(profile: str = "t1") -> Scenario:
+    """Class-boundary oscillation vs the actuation plane (F14): a
+    stable population earns its flow-rules, an oscillating population
+    flips label every tick (its per-tick deltas alternate between the
+    lightest and heaviest class pools — the classifier cannot hold a
+    verdict), and a novel wave joins mid-run to blip the open-set
+    ``unknown`` through the rendered table. Push mode against the
+    AccountingSwitch with an ``actuation.send`` fault mid-storm: the
+    plane must degrade to dry-run, re-probe on the virtual clock,
+    reconcile, and re-earn its installs — with ZERO rule flaps, the
+    rule ledger exact, and the serve cadence untouched throughout."""
+    t1 = _check_profile(profile)
+    fpc = 2 if t1 else 4               # stable flows per class (4 classes)
+    osc_flows = 4 if t1 else 8
+    novel_flows = 2 if t1 else 4
+    calibrate = 4 if t1 else 6
+    storm = 6 if t1 else 10
+    wave = 6 if t1 else 8
+    pools = synthetic_delta_pools(4)
+    stable = ClassWorkload(pools, flows_per_class=fpc, seed=0)
+    # the oscillator: same conversations every tick, but the pool its
+    # deltas draw from alternates between the lightest and heaviest
+    # class shape — cumulative counters stay monotonic (no wrap
+    # artifacts), the per-tick features swing ~64x, and the label
+    # cannot complete an install streak
+    keys = sorted(pools)
+    osc_pools = {"osc": pools[keys[0]]}
+    osc = ClassWorkload(
+        osc_pools, flows_per_class=osc_flows, seed=7,
+        mac_base=4 * len(stable.labels),
+    )
+    novel = ClassWorkload(
+        {"novel": novel_delta_pool(pools)},
+        flows_per_class=novel_flows, seed=2,
+        mac_base=4 * len(stable.labels) + 4 * len(osc.labels),
+    )
+    stable_feed = _records_feed([stable])
+    wave_feed = _records_feed([novel], start_tick=calibrate + storm)
+
+    def osc_feed(_i: int, n={"i": 0}) -> bytes:
+        i = n["i"]
+        n["i"] = i + 1
+        osc_pools["osc"] = pools[keys[0]] if i % 2 else pools[keys[-1]]
+        return b"".join(format_line(r) for r in osc.tick())
+
+    def feed(i: int) -> bytes:
+        return stable_feed(i) + osc_feed(i) + wave_feed(i)
+
+    n_flows = len(stable.labels) + len(osc.labels)
+    return Scenario(
+        id="label_flap_storm",
+        title="label flap storm vs the actuation hysteresis",
+        phases=(
+            Phase("calibrate", calibrate),
+            Phase("storm", storm),
+            Phase("wave", wave),
+        ),
+        sources=(_feed_spec(0, feed, "flap-storm"),),
+        capacity=max(256, 8 * (n_flows + novel_flows)),
+        table_rows=2 * (n_flows + novel_flows),
+        n_classes=4,
+        openset={
+            "margin": 3.0,
+            "calibration_rows": 2 * n_flows,
+        },
+        actuation={
+            # every class carries a clause: any stable verdict earns a
+            # rule, so the hysteresis is exercised on the whole table
+            "policy": ("class0=queue:1,class1=queue:2,"
+                       "class2=meter:5,class3=drop"),
+            "mode": "push",
+            "k_install": 3,
+            "k_retract": 3,
+            "backoff_base_s": 1.0,
+        },
+        # mid-storm wire fault: the 3rd pushed mod dies — the first
+        # install burst must degrade to dry-run, not break accounting
+        fault_rules=(
+            {"site": "actuation.send", "after": 2, "times": 1},
+        ),
+        gates=(
+            gate_zero_rule_flaps(min_suppressed=1),
+            gate_rule_accounting(),
+            gate_rules_installed(len(stable.labels)),
+            gate_events(required=(
+                "actuation.install",
+                "actuation.flap_suppressed",
+                "actuation.degrade",
+                "actuation.probe",
+                "actuation.reconcile",
+                "fault.fire",
+                "openset.reject",
+            )),
+            gate_cadence(1.0),
+            gate_accounting(),
+            gate_drops(expect=False),
+        ),
+        notes=(f"{len(stable.labels)} stable + {len(osc.labels)} "
+               f"oscillating conversations; novel wave at tick "
+               f"{calibrate + storm}"),
+    )
+
+
 SCENARIOS = {
     "flash_crowd": flash_crowd,
     "source_flap_storm": source_flap_storm,
@@ -454,6 +562,7 @@ SCENARIOS = {
     "mass_eviction_churn": mass_eviction_churn,
     "queue_saturation_flood": queue_saturation_flood,
     "device_wedge_degrade": device_wedge_degrade,
+    "label_flap_storm": label_flap_storm,
 }
 
 

@@ -31,9 +31,12 @@ manifest carrying the timeline position the run ended at.
 
 from __future__ import annotations
 
+import importlib
+import io
 import json
 import os
 import random
+import sys
 import time
 from dataclasses import dataclass, field
 
@@ -64,6 +67,16 @@ _TRACE_KINDS = (
     "openset.reject",
     "latency.slo_breach",
     "fault.fire",
+    "actuation.install",
+    "actuation.retract",
+    "actuation.refused",
+    "actuation.flap_suppressed",
+    "actuation.degrade",
+    "actuation.probe",
+    "actuation.reconcile",
+    "actuation.demote",
+    "actuation.repromote",
+    "actuation.quarantine",
 )
 
 
@@ -81,6 +94,7 @@ class RunContext:
     inc: object = None
     openset: object = None
     degrade: object = None
+    actuation: object = None
     n_classes: int = 4
     tick: int = 0
     phase: int = 0
@@ -169,6 +183,65 @@ def _compose_serve(sc: Scenario, m: Metrics, recorder: FlightRecorder,
     return inc, openset, degrade
 
 
+def _accounting_switch_cls():
+    """tools/fake_switch.AccountingSwitch — the dev harness lives
+    outside the package on purpose (it is a test double, not a serve
+    component), so the push-mode scenario resolves it off the repo's
+    tools/ directory when it is not already importable."""
+    try:
+        return importlib.import_module("fake_switch").AccountingSwitch
+    except ImportError:
+        tools = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "tools",
+        )
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        return importlib.import_module("fake_switch").AccountingSwitch
+
+
+def _arm_actuation(sc: Scenario, m: Metrics, recorder: FlightRecorder,
+                   clock) -> tuple:
+    """Build the scenario's actuation plane exactly as cli.py would:
+    policy parsed against the scenario's class names (plus ``unknown``
+    when the open-set tier is armed), dry-run by default, push mode
+    against an in-process AccountingSwitch the runner owns. Returns
+    ``(plane, switch, names)`` — all None/() when the scenario does
+    not arm actuation."""
+    if sc.actuation is None:
+        return None, None, ()
+    from ..controller.policy import parse_policy
+    from ..serving.actuation import ActuationPlane, SwitchLink
+
+    names = tuple(f"class{i}" for i in range(sc.n_classes))
+    if sc.openset is not None:
+        names = names + ("unknown",)
+    policy = parse_policy(sc.actuation["policy"], names)
+    mode = sc.actuation.get("mode", "dry-run")
+    switch = None
+    link_factory = None
+    if mode == "push":
+        switch = _accounting_switch_cls()()
+        switch.start()
+
+        def link_factory():
+            return SwitchLink(switch.host, switch.port)
+
+    plane = ActuationPlane(
+        policy, mode=mode,
+        k_install=int(sc.actuation.get("k_install", 3)),
+        k_retract=int(sc.actuation.get("k_retract", 3)),
+        clock=clock, link_factory=link_factory,
+        backoff_base_s=float(sc.actuation.get("backoff_base_s", 1.0)),
+        metrics=m, recorder=recorder,
+        # the dry-run intended-mods table is operator UX; the
+        # scorecard reads the ledger and the ring instead
+        out=io.StringIO(),
+    )
+    return plane, switch, names
+
+
 def run_scenario(sc: Scenario, *, native: str = "auto",
                  obs_dir: str | None = None) -> dict:
     """Run one scenario timeline through the real serve loop; returns
@@ -212,10 +285,12 @@ def run_scenario(sc: Scenario, *, native: str = "auto",
     inc, openset, degrade = _compose_serve(
         sc, m, recorder, engine, vclock,
     )
+    actuation, switch, act_names = _arm_actuation(sc, m, recorder, clock)
     ctx = RunContext(
         scenario=sc, tier=tier, engine=engine, metrics=m,
         recorder=recorder, lat=lat, inc=inc, openset=openset,
-        degrade=degrade, n_classes=sc.n_classes, vclock=vclock,
+        degrade=degrade, actuation=actuation, n_classes=sc.n_classes,
+        vclock=vclock,
     )
     ctx.obs["tick_wall_s"] = []
     ctx.obs["evicted_slots"] = 0
@@ -305,8 +380,20 @@ def run_scenario(sc: Scenario, *, native: str = "auto",
                 labels = inc.labels()
                 jax.block_until_ready(labels)
                 lat.mark_device(seal)
-                engine.render_sample(labels, sc.table_rows)
+                rendered = engine.render_sample(labels, sc.table_rows)
                 lat.render_visible(seal)
+                if actuation is not None:
+                    # the plane sees what the serve renders: the same
+                    # (slot, src, dst, label-name) rows cli.py feeds it
+                    meta = engine.slot_metadata(
+                        slots=[r[0] for r in rendered],
+                    )
+                    actuation.observe([
+                        (slot, *meta[slot],
+                         act_names[c] if c < len(act_names) else "?")
+                        for slot, c, _fa, _ra in rendered
+                        if slot in meta
+                    ])
                 wall = time.perf_counter() - t0
                 ctx.obs["tick_wall_s"].append(wall)
                 devs = dev.sample()
@@ -329,6 +416,10 @@ def run_scenario(sc: Scenario, *, native: str = "auto",
         tier.stop()
         if degrade is not None:
             degrade.close()
+        if actuation is not None:
+            actuation.close()
+        if switch is not None:
+            switch.stop()
         if perf is not None:
             perf.flush()
         dev.detach()
@@ -364,6 +455,16 @@ def run_scenario(sc: Scenario, *, native: str = "auto",
         "engine": "native" if use_native else "python",
         "device": dev.status(),
     }
+    if actuation is not None:
+        card["actuation"] = actuation.status()
+        if switch is not None:
+            card["switch"] = {
+                "installs": switch.installs(),
+                "deletes": switch.deletes(),
+                "refusals": switch.refusals(),
+                "live_rules": len(switch.live_cookies()),
+                "barriers": switch.barriers,
+            }
     if not passed:
         for r in results:
             if not r.passed:

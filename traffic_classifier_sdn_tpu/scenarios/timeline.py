@@ -129,6 +129,11 @@ class Scenario:
     n_classes: int = 4
     openset: dict | None = None  # {"margin":…, "calibration_rows":…}
     degrade: dict | None = None  # {"deadline":…, "probe_every":…, …}
+    # arm the actuation plane (serving/actuation.py): {"policy": SPEC,
+    # "mode": "dry-run"|"push", "k_install":…, "k_retract":…,
+    # "backoff_base_s":…}. Push mode runs against an in-process
+    # AccountingSwitch (tools/fake_switch.py) the runner owns.
+    actuation: dict | None = None
     idle_evict_s: float | None = None
     e2e_slo_s: float = 0.0
     # run the tier on REAL time instead of the virtual clock: required
@@ -383,6 +388,58 @@ def gate_known_accept(known_macs, max_reject: float = 0.05) -> Gate:
         )
 
     return Gate("known_accept", fn)
+
+
+def gate_rule_accounting() -> Gate:
+    """The actuation ledger is EXACT: every rule the plane ever
+    intended is accounted as installed, refused, or retracted —
+    ``intended == installed + retracted + refused`` — including the
+    rules pushed before a mid-run degrade and the retractions after a
+    quarantine."""
+
+    def fn(ctx) -> GateResult:
+        st = ctx.actuation.status()
+        led = st["ledger"]
+        return GateResult(
+            "rule_accounting_exact", bool(led["exact"]), led, None,
+            f"plane ended {st['state']}",
+        )
+
+    return Gate("rule_accounting_exact", fn)
+
+
+def gate_zero_rule_flaps(min_suppressed: int = 1) -> Gate:
+    """The hysteresis contract under oscillating labels: ZERO rule
+    flaps (a re-install of a pair whose rule was label-retracted) —
+    while ``flaps_suppressed`` proves the storm actually reached the
+    plane (at least ``min_suppressed`` broken streaks / ended
+    deviation episodes; a quiet run must not pass vacuously)."""
+
+    def fn(ctx) -> GateResult:
+        st = ctx.actuation.status()
+        flaps = int(st["rule_flaps"])
+        suppressed = int(st["flaps_suppressed"])
+        ok = flaps == 0 and suppressed >= min_suppressed
+        return GateResult(
+            "rule_flaps_zero", ok, flaps, 0,
+            f"{suppressed} flaps suppressed"
+            + ("" if suppressed >= min_suppressed else
+               f" (< {min_suppressed}: storm never reached the plane)"),
+        )
+
+    return Gate("rule_flaps_zero", fn)
+
+
+def gate_rules_installed(min_rules: int = 1) -> Gate:
+    """The plane actually programmed the switch: at least ``min_rules``
+    installs landed over the run (zero-flap gates must not pass by
+    never installing anything)."""
+
+    def fn(ctx) -> GateResult:
+        n = int(ctx.actuation.status()["ledger"]["installed"])
+        return GateResult("rules_installed", n >= min_rules, n, min_rules)
+
+    return Gate("rules_installed", fn)
 
 
 def gate_namespace_evicted(sid: int) -> Gate:

@@ -204,6 +204,30 @@ SITES: dict[str, str] = {
         "are never touched — the gate stays byte-transparent until a "
         "calibration actually lands"
     ),
+    "actuation.send": (
+        "serving/actuation.ActuationPlane flow-mod send — the switch "
+        "socket wedges or refuses a mod mid-write; ABSORBED: the plane "
+        "degrades itself to dry-run (in-flight ops resolve as refused, "
+        "accounting stays exact) and re-probes the switch on an "
+        "exponential backoff while classify serves every tick "
+        "byte-identically to --actuation off"
+    ),
+    "actuation.barrier": (
+        "serving/actuation.ActuationPlane barrier collection — the "
+        "barrier reply confirming a pushed batch is lost or the read "
+        "fails; ABSORBED: the batch's unresolved ops are counted "
+        "refused (never silently 'installed'), the plane degrades to "
+        "dry-run and re-probes on backoff; the serve cadence never "
+        "blocks on the dead barrier"
+    ),
+    "actuation.retract": (
+        "serving/actuation.ActuationPlane retraction push — the DELETE "
+        "undoing an installed rule cannot be sent (quarantine, "
+        "rollback-demotion, or label-change retract); ABSORBED: the op "
+        "resolves refused, the rule is dropped from the installed view "
+        "(the switch may hold it until re-probe reconciles), and the "
+        "plane degrades to dry-run with backoff re-probe"
+    ),
 }
 
 
